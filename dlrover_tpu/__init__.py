@@ -1,0 +1,24 @@
+"""dlrover_tpu: a TPU-native elastic training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of DLRover
+(intelligent-machine-learning/dlrover): elastic fault-tolerant distributed
+training, flash (host-DRAM async) checkpointing, auto parallelism over
+device meshes, dynamic data sharding, node health diagnosis, and an
+accelerated model/op library — all built TPU-first.
+
+Layer map (mirrors reference SURVEY.md §1, re-architected for TPU):
+
+  master/   job control plane: node & rendezvous management, data sharding,
+            auto-scale, diagnosis (reference: dlrover/python/master)
+  agent/    per-host elastic agent: worker supervision, checkpoint saver
+            daemon, monitors (reference: dlrover/python/elastic_agent)
+  trainer/  user-facing APIs: CLI launcher, flash-checkpoint engines,
+            elastic data/trainer (reference: dlrover/trainer)
+  parallel/ mesh + sharding strategy library — the TPU answer to ATorch's
+            auto_accelerate (reference: atorch/atorch/auto)
+  models/   flagship model families (Llama, GPT-2, MoE) written for pjit
+  ops/      Pallas TPU kernels: flash attention, ring attention, quant
+  common/   typed control-plane messages, RPC, node model, storage
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,149 @@
+"""Control-plane RPC: one gRPC service, two RPCs (`report`, `get`).
+
+Reference parity: dlrover/proto/elastic_training.proto `service Master`
+(report/get) + dlrover/python/common/grpc.py. The reference pickles typed
+dataclasses into a proto envelope; we skip protoc entirely by registering
+generic method handlers with pickle (de)serializers — same wire philosophy,
+zero codegen. All traffic is intra-job control plane (master <-> agents).
+"""
+
+import pickle
+import threading
+from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import grpc
+
+from dlrover_tpu.common.log import default_logger as logger
+
+SERVICE_NAME = "dlrover_tpu.Master"
+GET_METHOD = f"/{SERVICE_NAME}/get"
+REPORT_METHOD = f"/{SERVICE_NAME}/report"
+
+GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", 256 * 1024 * 1024),
+    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+]
+
+
+@dataclass
+class Envelope:
+    """What actually crosses the wire for both RPCs."""
+
+    node_id: int = -1
+    node_type: str = ""
+    payload: Any = None
+
+
+@dataclass
+class ReplyEnvelope:
+    success: bool = True
+    reason: str = ""
+    payload: Any = None
+
+
+def _dumps(obj) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _loads(data: bytes):
+    return pickle.loads(data)
+
+
+class MasterServicerBase:
+    """Subclass and implement get()/report(). Runs inside the master."""
+
+    def get(self, envelope: Envelope) -> ReplyEnvelope:  # pragma: no cover
+        raise NotImplementedError
+
+    def report(self, envelope: Envelope) -> ReplyEnvelope:  # pragma: no cover
+        raise NotImplementedError
+
+    # grpc-facing wrappers -------------------------------------------------
+    def _get_rpc(self, request: Envelope, context) -> ReplyEnvelope:
+        try:
+            return self.get(request)
+        except Exception as e:  # noqa: BLE001 — control plane must not die
+            logger.exception("error handling get(%s)", type(request.payload))
+            return ReplyEnvelope(success=False, reason=str(e))
+
+    def _report_rpc(self, request: Envelope, context) -> ReplyEnvelope:
+        try:
+            return self.report(request)
+        except Exception as e:  # noqa: BLE001
+            logger.exception(
+                "error handling report(%s)", type(request.payload)
+            )
+            return ReplyEnvelope(success=False, reason=str(e))
+
+
+def build_master_server(
+    servicer: MasterServicerBase,
+    port: int,
+    max_workers: int = 64,
+) -> grpc.Server:
+    """Create (not start) the gRPC server hosting the 2-RPC service."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="master-rpc"
+        ),
+        options=GRPC_OPTIONS,
+    )
+    handlers = {
+        "get": grpc.unary_unary_rpc_method_handler(
+            servicer._get_rpc,
+            request_deserializer=_loads,
+            response_serializer=_dumps,
+        ),
+        "report": grpc.unary_unary_rpc_method_handler(
+            servicer._report_rpc,
+            request_deserializer=_loads,
+            response_serializer=_dumps,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+    bound = server.add_insecure_port(f"0.0.0.0:{port}")
+    if bound == 0:
+        raise RuntimeError(f"cannot bind master RPC port {port}")
+    return server
+
+
+class MasterStub:
+    """Low-level client for the 2-RPC service (used by MasterClient)."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self._addr = addr
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+        self._get = self._channel.unary_unary(
+            GET_METHOD,
+            request_serializer=_dumps,
+            response_deserializer=_loads,
+        )
+        self._report = self._channel.unary_unary(
+            REPORT_METHOD,
+            request_serializer=_dumps,
+            response_deserializer=_loads,
+        )
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def get(
+        self, payload, node_id=-1, node_type="", timeout=None
+    ) -> ReplyEnvelope:
+        req = Envelope(node_id=node_id, node_type=node_type, payload=payload)
+        return self._get(req, timeout=timeout or self._timeout)
+
+    def report(
+        self, payload, node_id=-1, node_type="", timeout=None
+    ) -> ReplyEnvelope:
+        req = Envelope(node_id=node_id, node_type=node_type, payload=payload)
+        return self._report(req, timeout=timeout or self._timeout)
+
+    def close(self):
+        self._channel.close()

@@ -1,0 +1,131 @@
+"""Framework-wide constants and enums.
+
+Reference parity: dlrover/python/common/constants.py (NodeType, NodeStatus,
+DistributionStrategy, RendezvousName, ...). Re-scoped for a TPU deployment:
+"node" here is a TPU host (one JAX process controlling its local chips);
+"PS" roles are kept for the sparse/embedding path.
+"""
+
+import os
+
+
+class NodeType:
+    MASTER = "master"
+    WORKER = "worker"
+    PS = "ps"
+    CHIEF = "chief"
+    EVALUATOR = "evaluator"
+
+
+class NodeStatus:
+    INITIAL = "initial"
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+    UNKNOWN = "unknown"
+    # breakdown of FAILED for relaunch policy
+    OOM = "oom"
+
+    @classmethod
+    def is_terminal(cls, status):
+        return status in (cls.SUCCEEDED, cls.FAILED, cls.DELETED)
+
+
+class NodeEventType:
+    ADDED = "added"
+    MODIFIED = "modified"
+    DELETED = "deleted"
+
+
+class NodeExitReason:
+    SUCCEEDED = "succeeded"
+    KILLED = "killed"
+    OOM = "oom"
+    FATAL_ERROR = "fatal_error"
+    HARDWARE_ERROR = "hardware_error"  # relaunch on a *different* host
+    RELAUNCHED = "relaunched"
+    UNKNOWN_ERROR = "unknown_error"
+
+
+class JobStage:
+    INIT = "init"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    STOPPED = "stopped"
+
+
+class DistributionStrategy:
+    """How the job parallelizes. SPMD is the TPU-native allreduce analogue;
+    PS is kept for the sparse-embedding path."""
+
+    SPMD = "spmd"  # reference: AllreduceStrategy
+    PS = "ps"
+    LOCAL = "local"
+
+
+class RendezvousName:
+    TRAINING = "training"
+    NETWORK_CHECK = "network-check"
+
+
+class TrainingExceptionLevel:
+    PROCESS_ERROR = "process_error"
+    NODE_ERROR = "node_error"
+    RDZV_ERROR = "rdzv_error"
+    WARNING = "warning"
+    INFO = "info"
+
+
+class PlatformType:
+    LOCAL = "local"
+    KUBERNETES = "kubernetes"
+    RAY = "ray"
+
+
+class JobConstant:
+    RDZV_JOIN_TIMEOUT_DEFAULT = 600
+    HEARTBEAT_INTERVAL_SECS = 15
+    MASTER_CLIENT_TIMEOUT_SECS = 30
+    TRAINING_AGENT_LOOP_INTERVAL_SECS = 5
+    PENDING_NODE_TIMEOUT_SECS = 900
+    NODE_CHECK_TIMEOUT_SECS = 300
+
+
+class CheckpointConstant:
+    TRACKER_FILE = "latest_checkpointed_iteration.txt"
+    MODEL_STATES_NAME = "model_states"
+    OPTIM_STATES_NAME = "optim_states"
+    DONE_FILE_PREFIX = ".done_"
+    SAVE_TIMEOUT_SECS = 600
+
+
+class ConfigPath:
+    """Files through which master-pushed runtime configs reach the trainer."""
+
+    ENV_PARAL_CONFIG = "DLROVER_TPU_PARAL_CONFIG_PATH"
+    DEFAULT_PARAL_CONFIG = "/tmp/dlrover_tpu/paral_config.json"
+    ENV_RUNTIME_METRICS = "DLROVER_TPU_RUNTIME_METRICS_PATH"
+    DEFAULT_RUNTIME_METRICS = "/tmp/dlrover_tpu/runtime_metrics.json"
+
+
+class NodeEnv:
+    """Environment variables the agent sets for worker processes."""
+
+    JOB_NAME = "DLROVER_TPU_JOB_NAME"
+    MASTER_ADDR = "DLROVER_TPU_MASTER_ADDR"
+    NODE_ID = "DLROVER_TPU_NODE_ID"
+    NODE_RANK = "DLROVER_TPU_NODE_RANK"
+    NODE_NUM = "DLROVER_TPU_NODE_NUM"
+    COORDINATOR_ADDR = "DLROVER_TPU_COORDINATOR_ADDR"
+    RESTART_COUNT = "DLROVER_TPU_RESTART_COUNT"
+    MOCK_ERR_RANK = "DLROVER_TPU_MOCK_ERR_RANK"
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default

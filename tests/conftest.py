@@ -1,0 +1,17 @@
+"""Test environment: force a virtual 8-device CPU mesh before JAX import.
+
+Test strategy mirrors the reference (SURVEY.md §4):
+  tier 1 — in-process master + real gRPC (tests hit real RPC);
+  tier 2 — multi-device JAX on the CPU backend (8 virtual devices);
+  tier 3 — fault injection: kill a worker proc, assert recovery.
+"""
+
+import os
+
+# Must run before any jax import anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
