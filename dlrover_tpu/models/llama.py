@@ -1,0 +1,298 @@
+"""Llama-family decoder, written TPU-first.
+
+Reference parity: the reference trains Llama-2 through HF transformers +
+ATorch rewrites (atorch/examples/llama2, atorch FA adapters
+modules/transformer/layers.py:1353 `LlamaAttentionFA`). Here the model is
+a pure-JAX functional transformer designed for pjit/GSPMD:
+
+- layers are STACKED (leading axis = n_layers) and applied with
+  `lax.scan` → one compiled layer body, fast compile, natural remat point;
+- params live in f32 (optimizer precision), compute casts to bf16 (MXU);
+- attention goes through ops.attention (Pallas flash kernel on TPU);
+- every weight has a PartitionSpec rule (Megatron-style TP + FSDP axes),
+  activations carry sharding constraints on (batch, seq, heads).
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dlrover_tpu.ops.attention import dot_product_attention
+from dlrover_tpu.parallel.sharding import constrain
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    mlp_dim: int = 11008
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16          # compute dtype
+    param_dtype: Any = jnp.float32     # storage dtype
+    remat: bool = True                 # checkpoint each layer in scan
+    attn_impl: str = "auto"            # auto | flash | reference
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    # ---- presets (sizes follow the reference's benchmark configs) ----
+    @classmethod
+    def llama2_7b(cls, **kw) -> "LlamaConfig":
+        return cls(**kw)
+
+    @classmethod
+    def llama2_13b(cls, **kw) -> "LlamaConfig":
+        return cls(
+            dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+            mlp_dim=13824, **kw,
+        )
+
+    @classmethod
+    def llama2_70b(cls, **kw) -> "LlamaConfig":
+        return cls(
+            dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+            mlp_dim=28672, max_seq_len=4096, **kw,
+        )
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        """Test-size model: runs on the 8-device CPU mesh in seconds."""
+        defaults = dict(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            mlp_dim=128, max_seq_len=128, remat=False,
+            attn_impl="reference",
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
+    """Stacked-layer param pytree. All layer weights have a leading
+    n_layers axis consumed by lax.scan."""
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    L, D, M = cfg.n_layers, cfg.dim, cfg.mlp_dim
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    pd = cfg.param_dtype
+
+    def norm_init(*shape):
+        return jnp.ones(shape, pd)
+
+    def dense_init(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, pd) / math.sqrt(fan_in)
+        )
+
+    ks = jax.random.split(k_layers, 7)
+    params = {
+        "embed": {
+            "weight": jax.random.normal(
+                k_embed, (cfg.vocab_size, D), pd
+            ) * 0.02,
+        },
+        "layers": {
+            "attn_norm": norm_init(L, D),
+            "wq": dense_init(ks[0], (L, D, H * hd), D),
+            "wk": dense_init(ks[1], (L, D, KV * hd), D),
+            "wv": dense_init(ks[2], (L, D, KV * hd), D),
+            "wo": dense_init(ks[3], (L, H * hd, D), H * hd),
+            "mlp_norm": norm_init(L, D),
+            "w_gate": dense_init(ks[4], (L, D, M), D),
+            "w_up": dense_init(ks[5], (L, D, M), D),
+            "w_down": dense_init(ks[6], (L, M, D), M),
+        },
+        "final_norm": {"scale": norm_init(D)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {
+            "weight": dense_init(k_out, (D, cfg.vocab_size), D)
+        }
+    return params
+
+
+def partition_rules(cfg: LlamaConfig):
+    """(path_regex, PartitionSpec) — layer weights have leading L axis.
+
+    Megatron-style TP: column-parallel wq/wk/wv/w_gate/w_up shard the
+    output dim on "tensor"; row-parallel wo/w_down shard the input dim.
+    FSDP shards the other dim; vocab sharded on tensor for embed/head.
+    """
+    return [
+        (r"embed/weight", P("tensor", "fsdp")),
+        (r"layers/wq", P(None, "fsdp", "tensor")),
+        (r"layers/wk", P(None, "fsdp", "tensor")),
+        (r"layers/wv", P(None, "fsdp", "tensor")),
+        (r"layers/wo", P(None, "tensor", "fsdp")),
+        (r"layers/w_gate", P(None, "fsdp", "tensor")),
+        (r"layers/w_up", P(None, "fsdp", "tensor")),
+        (r"layers/w_down", P(None, "tensor", "fsdp")),
+        (r"layers/(attn|mlp)_norm", P(None, None)),
+        (r"final_norm/scale", P(None)),
+        (r"lm_head/weight", P("fsdp", "tensor")),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding on [B, S, H, D]."""
+    d = x.shape[-1]
+    freqs = jnp.exp(
+        -math.log(theta) * jnp.arange(0, d, 2, dtype=jnp.float32) / d
+    )
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # [B,S,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
+    """One decoder block on [B, S, D] activations."""
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    b, s, _ = x.shape
+    # matmul weights compute in bf16 on the MXU; norms stay in
+    # param dtype (_rms_norm does its own f32 math)
+    lp = {
+        k: v.astype(cfg.dtype)
+        for k, v in layer_params.items()
+        if not k.endswith("_norm")
+    }
+
+    h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(b, s, H, hd)
+    k = (h @ lp["wk"]).reshape(b, s, KV, hd)
+    v = (h @ lp["wv"]).reshape(b, s, KV, hd)
+    q = constrain(q, mesh, ("data", "fsdp"), "seq", "tensor", None)
+    k = constrain(k, mesh, ("data", "fsdp"), "seq", "tensor", None)
+    v = constrain(v, mesh, ("data", "fsdp"), "seq", "tensor", None)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = dot_product_attention(
+        q, k, v, causal=True, impl=cfg.attn_impl
+    )
+    attn = attn.reshape(b, s, H * hd)
+    x = x + constrain(
+        attn @ lp["wo"], mesh, ("data", "fsdp"), "seq", None
+    )
+
+    h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"])
+    up = h @ lp["w_up"]
+    ff = constrain(
+        gate * up, mesh, ("data", "fsdp"), "seq", "tensor"
+    )
+    x = x + constrain(
+        ff @ lp["w_down"], mesh, ("data", "fsdp"), "seq", None
+    )
+    return x
+
+
+def apply(
+    cfg: LlamaConfig,
+    params: Params,
+    tokens: jax.Array,
+    mesh=None,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Forward pass: tokens [B, S] int32 → logits [B, S, vocab] f32."""
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    x = params["embed"]["weight"].astype(cfg.dtype)[tokens]
+    x = constrain(x, mesh, ("data", "fsdp"), "seq", None)
+
+    def body(carry, layer_params):
+        y = _layer(cfg, mesh, carry, layer_params, positions)
+        return y, None
+
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    x, _ = jax.lax.scan(body, x, params["layers"])
+
+    x = _rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        head = params["embed"]["weight"].astype(cfg.dtype).T
+    else:
+        head = params["lm_head"]["weight"].astype(cfg.dtype)
+    logits = (x @ head).astype(jnp.float32)
+    return constrain(logits, mesh, ("data", "fsdp"), "seq", "tensor")
+
+
+def loss_fn(
+    cfg: LlamaConfig,
+    params: Params,
+    batch: Dict[str, jax.Array],
+    mesh=None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross entropy. batch: tokens [B,S], optional loss_mask."""
+    tokens = batch["tokens"]
+    logits = apply(cfg, params, tokens[:, :-1], mesh=mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, targets[..., None], axis=-1
+    ).squeeze(-1)
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask[:, 1:].astype(nll.dtype)
+        total = jnp.maximum(mask.sum(), 1.0)
+        loss = (nll * mask).sum() / total
+        weight = total
+    else:
+        loss = nll.mean()
+        weight = jnp.asarray(nll.size, jnp.float32)
+    # loss_weight lets grad-accum weight microbatches by token count
+    return loss, {"loss": loss, "loss_weight": weight}
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    L, D, M, V = cfg.n_layers, cfg.dim, cfg.mlp_dim, cfg.vocab_size
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    per_layer = (
+        D * H * hd + 2 * D * KV * hd + H * hd * D + 3 * D * M + 2 * D
+    )
+    total = V * D + L * per_layer + D
+    if not cfg.tie_embeddings:
+        total += D * V
+    return total
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Approx training FLOPs/token: 6*N + attention term (for MFU)."""
+    n = num_params(cfg)
+    attn = 12 * cfg.n_layers * cfg.dim * seq_len
+    return 6.0 * n + attn

@@ -1,0 +1,102 @@
+"""Attention entry point: dispatches to the Pallas TPU flash kernel on TPU
+and a fused-softmax jnp reference elsewhere (CPU tests, debugging).
+
+Reference parity: ATorch integrates CUDA flash-attention by patching HF
+modules (atorch/atorch/modules/transformer/layers.py FA adapters). Here
+attention is a first-class op the models call directly.
+
+Shapes follow the TPU-friendly layout [batch, seq, heads, head_dim].
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _kv_repeat(k: jax.Array, n_rep: int) -> jax.Array:
+    """Grouped-query attention: repeat KV heads to match Q heads."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d))
+    return k.reshape(b, s, h * n_rep, d)
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Plain XLA attention, softmax in f32. [B, S, H, D] in and out."""
+    orig_dtype = q.dtype
+    n_rep = q.shape[2] // k.shape[2]
+    k = _kv_repeat(k, n_rep)
+    v = _kv_repeat(v, n_rep)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    q_len, k_len = logits.shape[-2], logits.shape[-1]
+    if causal:
+        q_pos = jnp.arange(q_len)[:, None] + (k_len - q_len)
+        k_pos = jnp.arange(k_len)[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    if segment_ids is not None:
+        seg_mask = (
+            segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        )
+        logits = jnp.where(seg_mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(orig_dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.lru_cache(maxsize=1)
+def _tpu_available() -> bool:
+    try:
+        # "axon" is this image's TPU-tunnel backend name
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Main entry. impl: 'auto' | 'flash' | 'reference'.
+
+    'auto' uses the Pallas flash kernel on TPU when shapes allow
+    (seq % block == 0, head_dim tile-able), else the XLA reference.
+    """
+    if impl == "reference":
+        return reference_attention(q, k, v, causal, scale, segment_ids)
+    if impl in ("auto", "flash"):
+        from dlrover_tpu.ops import flash_attention as fa
+
+        if impl == "flash" and segment_ids is not None:
+            raise ValueError(
+                "flash attention does not support segment_ids yet; "
+                "use impl='reference' for packed sequences"
+            )
+        if impl == "flash" or (
+            _tpu_available() and fa.supports(q, k, segment_ids)
+        ):
+            return fa.flash_attention(
+                q, k, v, causal=causal, scale=scale
+            )
+        return reference_attention(q, k, v, causal, scale, segment_ids)
+    raise ValueError(f"unknown attention impl: {impl}")

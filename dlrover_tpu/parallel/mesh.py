@@ -1,0 +1,141 @@
+"""Device mesh construction — the TPU replacement for process groups.
+
+Reference parity: atorch/atorch/distributed/distributed.py:323
+`create_parallel_group([("tensor",4),("pipeline",2),("data",2)])` builds
+nested NCCL groups. Here the same parallel-mode product is ONE
+`jax.sharding.Mesh`; named mesh axes replace named process groups and XLA
+emits the collectives over ICI/DCN.
+
+Canonical axis order (outermost → innermost over the device list):
+``("pipe", "data", "fsdp", "expert", "seq", "tensor")`` — tensor parallelism
+innermost so its collectives ride nearest-neighbor ICI links.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_ORDER: Tuple[str, ...] = (
+    "pipe",
+    "data",
+    "fsdp",
+    "expert",
+    "seq",
+    "tensor",
+)
+
+# Axes over which the global batch is split.
+BATCH_AXES: Tuple[str, ...] = ("data", "fsdp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative parallelism layout. Sizes multiply to the device count;
+    any axis may be 1 (present but inert — keeps PartitionSpecs uniform)."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+    pipe: int = 1
+
+    @property
+    def axis_sizes(self) -> Dict[str, int]:
+        return {
+            "pipe": self.pipe,
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "expert": self.expert,
+            "seq": self.seq,
+            "tensor": self.tensor,
+        }
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for s in self.axis_sizes.values():
+            n *= s
+        return n
+
+    @property
+    def batch_shards(self) -> int:
+        return self.data * self.fsdp
+
+    def with_updates(self, **kw) -> "MeshSpec":
+        cur = {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "seq": self.seq,
+            "expert": self.expert,
+            "pipe": self.pipe,
+        }
+        cur.update(kw)
+        return MeshSpec(**cur)
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        if devices is None:
+            devices = jax.devices()
+        n = self.num_devices
+        if n > len(devices):
+            raise ValueError(
+                f"MeshSpec needs {n} devices, only {len(devices)} available"
+            )
+        devices = list(devices)[:n]
+        shape = tuple(self.axis_sizes[a] for a in AXIS_ORDER)
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                shape, devices=devices
+            )
+        except (ValueError, AssertionError):
+            # CPU/virtual devices: topology-aware layout unavailable.
+            dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, AXIS_ORDER)
+
+    @classmethod
+    def fit(
+        cls,
+        n_devices: int,
+        tensor: int = 1,
+        seq: int = 1,
+        expert: int = 1,
+        pipe: int = 1,
+        data: int = 1,
+    ) -> "MeshSpec":
+        """Fill the fsdp axis with whatever devices remain — the default
+        strategy (reference default: FSDP/zero over all ranks)."""
+        used = tensor * seq * expert * pipe * data
+        if n_devices % used:
+            raise ValueError(
+                f"{n_devices} devices not divisible by {used} "
+                f"(tensor*seq*expert*pipe*data)"
+            )
+        return cls(
+            data=data,
+            fsdp=n_devices // used,
+            tensor=tensor,
+            seq=seq,
+            expert=expert,
+            pipe=pipe,
+        )
+
+
+def batch_spec(extra: Tuple = ()) -> PartitionSpec:
+    """PartitionSpec for [batch, ...] arrays: batch split over data+fsdp."""
+    return PartitionSpec(BATCH_AXES, *extra)
+
+
+def named(mesh: Mesh, spec: PartitionSpec) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def local_mesh_spec(n_devices: Optional[int] = None) -> MeshSpec:
+    """Pure data-parallel mesh over local devices (the dev default)."""
+    if n_devices is None:
+        n_devices = jax.local_device_count()
+    return MeshSpec.fit(n_devices)

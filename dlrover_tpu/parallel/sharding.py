@@ -1,0 +1,111 @@
+"""Sharding-rule machinery: regex path rules → PartitionSpec pytrees.
+
+Reference parity: ATorch expresses sharding as torch module rewrites
+(atorch/atorch/auto/opt_lib/*, modules/distributed_modules/layers.py); here
+a "strategy" is just a table of `(path_regex, PartitionSpec)` rules applied
+to the param pytree — GSPMD does the rest. This is the core of the
+auto_accelerate replacement.
+"""
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Rules = Sequence[Tuple[str, PartitionSpec]]
+
+
+def path_str(path) -> str:
+    """jax.tree_util key path → 'layers/attn/wq' style string."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def spec_for_path(path: str, rules: Rules) -> PartitionSpec:
+    """First matching rule wins; no match → fully replicated."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return PartitionSpec()
+
+
+def tree_specs(tree: Any, rules: Rules) -> Any:
+    """PartitionSpec pytree matching `tree`'s structure."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: spec_for_path(path_str(path), rules), tree
+    )
+
+
+def _filter_spec(spec: PartitionSpec, mesh: Mesh, shape) -> PartitionSpec:
+    """Drop mesh axes of size 1 / absent and dims not divisible by their
+    axis product — keeps one rule table valid for every mesh shape."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for dim_idx, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        kept = [a for a in axes if sizes.get(a, 1) > 1]
+        prod = 1
+        for a in kept:
+            prod *= sizes[a]
+        if (
+            not kept
+            or dim_idx >= len(shape)
+            or prod <= 0
+            or shape[dim_idx] % prod != 0
+        ):
+            out.append(None)
+        elif len(kept) == 1:
+            out.append(kept[0])
+        else:
+            out.append(tuple(kept))
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def tree_shardings(
+    tree: Any, mesh: Mesh, rules: Rules
+) -> Any:
+    """NamedSharding pytree for `tree` under `mesh` (specs auto-filtered
+    to the mesh's live axes and each leaf's shape)."""
+
+    def _leaf(path, leaf):
+        spec = spec_for_path(path_str(path), rules)
+        shape = getattr(leaf, "shape", ())
+        return NamedSharding(mesh, _filter_spec(spec, mesh, shape))
+
+    return jax.tree_util.tree_map_with_path(_leaf, tree)
+
+
+def shard_tree(tree: Any, mesh: Mesh, rules: Rules) -> Any:
+    """Place a host-resident pytree onto the mesh per the rules."""
+    shardings = tree_shardings(tree, mesh, rules)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), tree, shardings
+    )
+
+
+def constrain(x, mesh: Optional[Mesh], *spec_entries) -> Any:
+    """with_sharding_constraint that degrades to identity without a mesh
+    and filters dead axes — safe to call inside any model code."""
+    if mesh is None:
+        return x
+    spec = _filter_spec(PartitionSpec(*spec_entries), mesh, x.shape)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

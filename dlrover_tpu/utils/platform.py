@@ -1,0 +1,33 @@
+"""Platform helpers: backend selection + device facts.
+
+This image registers TPU backends at interpreter boot via sitecustomize
+and forces `jax_platforms` through jax.config (env vars lose). Worker
+processes that must run on CPU (tests, local simulation) set
+DLROVER_TPU_FORCE_CPU=1 and call `ensure_cpu_if_forced()` before any
+backend use.
+"""
+
+import os
+
+FORCE_CPU_ENV = "DLROVER_TPU_FORCE_CPU"
+
+
+def ensure_cpu_if_forced():
+    if os.environ.get(FORCE_CPU_ENV) != "1":
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already initialized
+        pass
+
+
+def backend_name() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def is_tpu() -> bool:
+    return backend_name() not in ("cpu",)

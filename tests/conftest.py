@@ -1,17 +1,30 @@
-"""Test environment: force a virtual 8-device CPU mesh before JAX import.
+"""Test environment: force a virtual 8-device CPU mesh.
 
 Test strategy mirrors the reference (SURVEY.md §4):
   tier 1 — in-process master + real gRPC (tests hit real RPC);
   tier 2 — multi-device JAX on the CPU backend (8 virtual devices);
   tier 3 — fault injection: kill a worker proc, assert recovery.
+
+This image boots every interpreter with an `axon` TPU backend registered
+via sitecustomize, and register() overrides the JAX_PLATFORMS *env var*
+with `jax.config.update("jax_platforms", "axon,cpu")` — so the env var
+alone cannot keep tests off the (single, shared, slow-to-dial) TPU
+tunnel. The config update below wins because it runs after registration
+and before any backend is initialized.
 """
 
 import os
 
-# Must run before any jax import anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Subprocesses spawned by tests (agent workers) read this to apply the
+# same override — see dlrover_tpu.utils.platform.ensure_cpu_if_forced().
+os.environ["DLROVER_TPU_FORCE_CPU"] = "1"
+
+import jax  # noqa: E402  (must come after the env setup above)
+
+jax.config.update("jax_platforms", "cpu")
