@@ -87,6 +87,8 @@ class PlatformType:
 
 class JobConstant:
     RDZV_JOIN_TIMEOUT_DEFAULT = 600
+    # after min_nodes joined, wait this long for more before completing
+    RDZV_WAITING_TIMEOUT = 3
     HEARTBEAT_INTERVAL_SECS = 15
     MASTER_CLIENT_TIMEOUT_SECS = 30
     TRAINING_AGENT_LOOP_INTERVAL_SECS = 5

@@ -1,0 +1,99 @@
+"""In-master KV store + named sync barriers.
+
+Reference parity: dlrover/python/master/elastic_training/kv_store_service.py
+(`KVStoreService`) and sync_service.py (`SyncService`). The KV store backs
+rendezvous barrier semantics for workers (the torch-c10d-Store role); on TPU
+it additionally serves as the host-level coordination store used before
+`jax.distributed.init` (the gloo-equivalent control path, SURVEY.md §2.7).
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+
+class KVStoreService:
+    """Thread-safe bytes KV store living inside the master process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._store: Dict[str, bytes] = {}
+
+    def set(self, key: str, value: bytes):
+        with self._cond:
+            self._store[key] = value
+            self._cond.notify_all()
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            return self._store.get(key, b"")
+
+    def add(self, key: str, delta: int) -> int:
+        """Atomic counter add (torch Store `add` semantics)."""
+        with self._cond:
+            cur = int(self._store.get(key, b"0") or b"0")
+            cur += delta
+            self._store[key] = str(cur).encode()
+            self._cond.notify_all()
+            return cur
+
+    def wait(self, key: str, timeout: float = 300.0) -> bytes:
+        """Block until `key` exists (torch Store `wait` semantics)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while key not in self._store:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"kv_store wait({key!r}) timed out")
+                self._cond.wait(remaining)
+            return self._store[key]
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._store.pop(key, None) is not None
+
+    def clear(self):
+        with self._lock:
+            self._store.clear()
+
+
+class SyncService:
+    """Named barriers across workers.
+
+    Reference parity: master/elastic_training/sync_service.py:26 — workers
+    `join` a named sync; once every expected worker joined, the sync is
+    reached; `finish` marks it explicitly done (the reference's
+    barrier/notify split).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._joined: Dict[str, Set[int]] = {}
+        self._finished: Set[str] = set()
+        self._expected: Optional[int] = None
+
+    def set_expected_workers(self, n: Optional[int]):
+        with self._lock:
+            self._expected = n
+
+    def join(self, sync_name: str, node_id: int) -> bool:
+        """Returns True when the sync is now complete."""
+        with self._lock:
+            members = self._joined.setdefault(sync_name, set())
+            members.add(node_id)
+            if self._expected is not None and len(members) >= self._expected:
+                self._finished.add(sync_name)
+            return sync_name in self._finished
+
+    def finish(self, sync_name: str):
+        with self._lock:
+            self._finished.add(sync_name)
+
+    def reached(self, sync_name: str) -> bool:
+        with self._lock:
+            return sync_name in self._finished
+
+    def members(self, sync_name: str) -> List[int]:
+        with self._lock:
+            return sorted(self._joined.get(sync_name, ()))

@@ -1,0 +1,94 @@
+"""Speed monitor: global-step throughput + straggler baseline + hang input.
+
+Reference parity: dlrover/python/master/monitor/speed_monitor.py:43
+(`SpeedMonitor` — `collect_global_step` :81, running-speed window,
+straggler baseline). Workers report (step, timestamp); the monitor keeps a
+sliding window of (steps/sec) samples and exposes job throughput, which
+drives the auto-scaler and hang detection.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Set, Tuple
+
+
+class SpeedMonitor:
+    def __init__(self, window: int = 10):
+        self._lock = threading.Lock()
+        self._global_step = 0
+        self._global_step_ts = 0.0
+        self._init_step = 0
+        self._start_ts = time.time()
+        self._speeds: Deque[Tuple[float, float]] = deque(maxlen=window)
+        self._worker_steps: Dict[int, Tuple[int, float]] = {}
+        self._worker_start: Dict[int, float] = {}
+        self._paused: Set[int] = set()
+        self.first_step_ts: float = 0.0
+
+    # ---- ingestion -------------------------------------------------------
+
+    def collect_global_step(self, step: int, ts: Optional[float] = None):
+        ts = ts or time.time()
+        with self._lock:
+            if self._global_step_ts and step > self._global_step:
+                dt = ts - self._global_step_ts
+                if dt > 0:
+                    self._speeds.append(
+                        ((step - self._global_step) / dt, ts)
+                    )
+            if not self.first_step_ts and step > 0:
+                self.first_step_ts = ts
+            self._global_step = max(self._global_step, step)
+            self._global_step_ts = ts
+
+    def collect_worker_step(
+        self, node_id: int, step: int, ts: Optional[float] = None
+    ):
+        ts = ts or time.time()
+        with self._lock:
+            self._worker_steps[node_id] = (step, ts)
+        self.collect_global_step(step, ts)
+
+    def add_running_worker(self, node_id: int):
+        with self._lock:
+            self._worker_start.setdefault(node_id, time.time())
+
+    def remove_running_worker(self, node_id: int):
+        with self._lock:
+            self._worker_start.pop(node_id, None)
+            self._worker_steps.pop(node_id, None)
+
+    # ---- queries ---------------------------------------------------------
+
+    @property
+    def global_step(self) -> int:
+        return self._global_step
+
+    @property
+    def running_speed(self) -> float:
+        """Steps/sec over the sliding window."""
+        with self._lock:
+            if not self._speeds:
+                return 0.0
+            return sum(s for s, _ in self._speeds) / len(self._speeds)
+
+    def all_worker_steps(self) -> Dict[int, int]:
+        with self._lock:
+            return {nid: s for nid, (s, _) in self._worker_steps.items()}
+
+    def step_stalled(self, timeout: float) -> bool:
+        """No global-step progress within `timeout` while workers run —
+        the primary hang signal (feeds the diagnosis inference chain)."""
+        with self._lock:
+            if not self._worker_start:
+                return False
+            if not self._global_step_ts:
+                oldest = min(self._worker_start.values())
+                return time.time() - oldest > timeout
+            return time.time() - self._global_step_ts > timeout
+
+    def reset_running_speed_monitor(self):
+        with self._lock:
+            self._speeds.clear()
+            self._global_step_ts = 0.0

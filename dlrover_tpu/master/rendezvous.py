@@ -1,0 +1,281 @@
+"""Master-side rendezvous: membership rounds, rank assignment, node checks.
+
+Reference parity: dlrover/python/master/elastic_training/rdzv_manager.py —
+`RendezvousManager` ABC (:58), `ElasticTrainingRendezvousManager` (:329),
+`NetworkCheckRendezvousManager` (:390), `_detect_stragglers` (:607).
+
+TPU framing: a "comm world" here is the set of hosts that will call
+`jax.distributed.init(coordinator, num_processes, process_id)` — node_rank
+maps to process_id and the lowest rank hosts the coordinator. Every new
+round therefore implies a JAX runtime re-init + re-jit on the members
+(handled by the agent), which is the TPU analogue of rebuilding NCCL
+process groups.
+"""
+
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import JobConstant, RendezvousName
+from dlrover_tpu.common.log import default_logger as logger
+
+# world: node_rank -> (node_id, local_world_size, node_addr)
+CommWorld = Dict[int, Tuple[int, int, str]]
+
+
+@dataclass
+class _WaitingNode:
+    node_id: int
+    node_rank: int  # rank *requested* (-1 = assign)
+    local_world_size: int
+    node_addr: str
+    join_time: float
+
+
+class RendezvousManager:
+    """Round-based membership. Nodes join the waiting set; once
+    min_nodes joined (and either max_nodes joined or the waiting period
+    lapsed), the round completes and the waiting set becomes the world."""
+
+    def __init__(self, name: str = RendezvousName.TRAINING):
+        self.name = name
+        self._lock = threading.Lock()
+        self._min_nodes = 1
+        self._max_nodes = 1
+        self._node_unit = 1
+        self._waiting_timeout = JobConstant.RDZV_WAITING_TIMEOUT
+        self._waiting: Dict[int, _WaitingNode] = {}
+        self._world: CommWorld = {}
+        self._round = 0
+        self._latest_join_time = 0.0
+        self._start_round_time = 0.0
+
+    def update_rdzv_params(
+        self,
+        min_nodes: int,
+        max_nodes: int,
+        waiting_timeout: float = None,
+        node_unit: int = 1,
+    ):
+        with self._lock:
+            self._min_nodes = min_nodes
+            self._max_nodes = max_nodes
+            self._node_unit = max(1, node_unit)
+            if waiting_timeout is not None:
+                self._waiting_timeout = waiting_timeout
+
+    # ---- joining ---------------------------------------------------------
+
+    def join_rendezvous(
+        self,
+        node_id: int,
+        local_world_size: int,
+        node_rank: int = -1,
+        node_addr: str = "",
+    ) -> int:
+        """Add node to the waiting set; returns the upcoming round."""
+        with self._lock:
+            now = time.time()
+            if not self._waiting:
+                self._start_round_time = now
+            self._waiting[node_id] = _WaitingNode(
+                node_id, node_rank, local_world_size, node_addr, now
+            )
+            self._latest_join_time = now
+            return self._round
+
+    def remove_node(self, node_id: int):
+        with self._lock:
+            self._waiting.pop(node_id, None)
+            # a member death invalidates the current world
+            if any(nid == node_id for nid, _, _ in self._world.values()):
+                self._world = {}
+
+    def num_nodes_waiting(self) -> int:
+        """Workers poll this to learn a membership change is pending
+        (reference: _membership_changed training.py:720)."""
+        with self._lock:
+            if self._world and self._waiting:
+                return len(self._waiting)
+            return 0
+
+    # ---- round completion ------------------------------------------------
+
+    def _rdzv_completed(self) -> bool:
+        """Caller holds the lock. Reference semantics
+        (_check_rdzv_completed rdzv_manager.py:135): complete immediately
+        at max_nodes; at >= min_nodes complete once the waiting window
+        since the last join lapsed; round node count to node_unit."""
+        n = len(self._waiting)
+        if n >= self._max_nodes:
+            return True
+        if n >= self._min_nodes:
+            waited = time.time() - self._latest_join_time
+            return waited >= self._waiting_timeout
+        return False
+
+    def _build_world(self) -> CommWorld:
+        """Caller holds the lock: assign ranks, honoring requested ranks
+        first, then filling gaps by join order; respect node_unit."""
+        n = len(self._waiting)
+        usable = (n // self._node_unit) * self._node_unit
+        nodes = sorted(self._waiting.values(), key=lambda w: w.join_time)[
+            :usable
+        ]
+        world: CommWorld = {}
+        taken = set()
+        unassigned = []
+        for w in nodes:
+            if w.node_rank >= 0 and w.node_rank not in taken:
+                world[w.node_rank] = (
+                    w.node_id,
+                    w.local_world_size,
+                    w.node_addr,
+                )
+                taken.add(w.node_rank)
+            else:
+                unassigned.append(w)
+        rank = 0
+        for w in unassigned:
+            while rank in taken:
+                rank += 1
+            world[rank] = (w.node_id, w.local_world_size, w.node_addr)
+            taken.add(rank)
+        for w in nodes:
+            self._waiting.pop(w.node_id, None)
+        return dict(sorted(world.items()))
+
+    def get_comm_world(
+        self, node_id: int
+    ) -> Tuple[int, int, CommWorld]:
+        """(round, group, world). Empty world = still waiting.
+
+        Order matters: a node present in the *waiting set* has rejoined
+        since the current world formed (e.g. its worker restarted) and
+        must be answered with a NEW round, not the stale world —
+        otherwise `num_nodes_waiting` stays >0 and every member keeps
+        restarting forever.
+        """
+        with self._lock:
+            if node_id not in self._waiting and self._world and any(
+                nid == node_id for nid, _, _ in self._world.values()
+            ):
+                return self._round, 0, dict(self._world)
+            if self._rdzv_completed():
+                self._world = self._build_world()
+                self._round += 1
+                logger.info(
+                    "rendezvous %s round %d completed: %d nodes",
+                    self.name,
+                    self._round,
+                    len(self._world),
+                )
+                return self._round, 0, dict(self._world)
+            return self._round, 0, {}
+
+    @property
+    def world(self) -> CommWorld:
+        with self._lock:
+            return dict(self._world)
+
+    @property
+    def round(self) -> int:
+        return self._round
+
+
+class ElasticTrainingRendezvousManager(RendezvousManager):
+    """The main training rendezvous (reference :329 — behavior is the
+    base manager's; kept as a named subclass for parity/clarity)."""
+
+    def __init__(self):
+        super().__init__(RendezvousName.TRAINING)
+
+
+class NetworkCheckRendezvousManager(RendezvousManager):
+    """Pre-flight node-check rendezvous: pairs nodes into groups over two
+    rounds and aggregates reported bench times into fault/straggler sets.
+
+    Reference parity: rdzv_manager.py:390 (`get_comm_world` :415 pairs via
+    `_group_nodes` :452 — round 0 stride pairs, round 1 shifted so every
+    suspect pairs a known-good node), `check_fault_node` :557,
+    `get_straggler` :589, `_detect_stragglers` :607 (slowest/fastest time
+    ratio vs threshold).
+    """
+
+    STRAGGLER_RATIO = 1.5
+
+    def __init__(self):
+        super().__init__(RendezvousName.NETWORK_CHECK)
+        self._node_times: Dict[int, Dict[int, float]] = {}  # round->id->t
+        self._node_status: Dict[int, Dict[int, bool]] = {}
+        self._check_round = 0
+
+    def _group_nodes(self, ranks: List[int], round_idx: int):
+        """Round 0: adjacent pairs. Round 1: shift by one so each node
+        gets a different partner (a good partner exonerates a node whose
+        round-0 group failed)."""
+        if len(ranks) <= 2:
+            return [ranks]
+        groups = []
+        if round_idx % 2 == 0:
+            it = ranks
+        else:
+            it = ranks[1:] + ranks[:1]
+        for i in range(0, len(it) - 1, 2):
+            groups.append([it[i], it[i + 1]])
+        if len(it) % 2 == 1:
+            groups[-1].append(it[-1])
+        return groups
+
+    def get_check_groups(self, round_idx: int) -> List[List[int]]:
+        with self._lock:
+            ranks = sorted(self._world.keys())
+            return self._group_nodes(ranks, round_idx)
+
+    def report_network_check(
+        self, node_id: int, normal: bool, elapsed: float
+    ):
+        with self._lock:
+            self._node_times.setdefault(self._check_round, {})[
+                node_id
+            ] = elapsed
+            self._node_status.setdefault(self._check_round, {})[
+                node_id
+            ] = normal
+
+    def next_check_round(self):
+        with self._lock:
+            self._check_round += 1
+
+    def check_fault_nodes(self) -> List[int]:
+        """Nodes abnormal in every round they reported."""
+        with self._lock:
+            if not self._node_status:
+                return []
+            fault: Dict[int, bool] = {}
+            for statuses in self._node_status.values():
+                for nid, ok in statuses.items():
+                    fault[nid] = fault.get(nid, True) and (not ok)
+            return sorted(nid for nid, bad in fault.items() if bad)
+
+    def get_stragglers(self) -> List[int]:
+        """Straggler = best reported time still > ratio * global fastest."""
+        with self._lock:
+            best: Dict[int, float] = {}
+            for times in self._node_times.values():
+                for nid, t in times.items():
+                    if t <= 0:
+                        continue
+                    best[nid] = min(best.get(nid, math.inf), t)
+            if len(best) < 2:
+                return []
+            fastest = min(best.values())
+            if fastest <= 0:
+                return []
+            return sorted(
+                nid
+                for nid, t in best.items()
+                if t / fastest > self.STRAGGLER_RATIO
+            )

@@ -1,0 +1,262 @@
+"""Master gRPC servicer: dispatch `get`/`report` on message type.
+
+Reference parity: dlrover/python/master/servicer.py:72 (`MasterServicer`,
+`get` :99, `report` :305) — one big type-dispatch over the ~60 message
+dataclasses. Handlers delegate to the managers the master wires in.
+"""
+
+import time
+from typing import Optional
+
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.comm import (
+    Envelope,
+    MasterServicerBase,
+    ReplyEnvelope,
+)
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.kv_store import KVStoreService, SyncService
+from dlrover_tpu.master.monitor.error_monitor import (
+    ErrorRecord,
+    SimpleErrorMonitor,
+)
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.node_manager import JobNodeManager
+from dlrover_tpu.master.rendezvous import (
+    ElasticTrainingRendezvousManager,
+    NetworkCheckRendezvousManager,
+)
+from dlrover_tpu.master.shard.task_manager import TaskManager
+
+
+class MasterServicer(MasterServicerBase):
+    def __init__(
+        self,
+        task_manager: Optional[TaskManager] = None,
+        node_manager: Optional[JobNodeManager] = None,
+        speed_monitor: Optional[SpeedMonitor] = None,
+        error_monitor: Optional[SimpleErrorMonitor] = None,
+        kv_store: Optional[KVStoreService] = None,
+        sync_service: Optional[SyncService] = None,
+        rdzv_managers: Optional[dict] = None,
+    ):
+        self.task_manager = task_manager or TaskManager()
+        self.node_manager = node_manager or JobNodeManager()
+        self.speed_monitor = speed_monitor or SpeedMonitor()
+        self.error_monitor = error_monitor or SimpleErrorMonitor()
+        self.kv_store = kv_store or KVStoreService()
+        self.sync_service = sync_service or SyncService()
+        self.rdzv_managers = rdzv_managers or {
+            "training": ElasticTrainingRendezvousManager(),
+            "network-check": NetworkCheckRendezvousManager(),
+        }
+        self.paral_config = msg.ParallelConfig()
+        self.run_configs = {}
+        self._ckpt_steps = {}  # path -> latest committed step
+        self.job_stage = "init"
+
+    def _rdzv(self, name: str):
+        return self.rdzv_managers[name]
+
+    # ------------------------------------------------------------------
+    # get: queries
+    # ------------------------------------------------------------------
+
+    def get(self, env: Envelope) -> ReplyEnvelope:
+        req = env.payload
+        if isinstance(req, msg.GetDatasetTask):
+            task = self.task_manager.get_task(
+                req.node_id, req.dataset_name
+            )
+            return ReplyEnvelope(payload=task)
+        if isinstance(req, msg.DatasetEpochQuery):
+            ds = self.task_manager.get_dataset(req.dataset_name)
+            if ds is None:
+                return ReplyEnvelope(
+                    success=False, reason="unknown dataset"
+                )
+            return ReplyEnvelope(
+                payload=msg.DatasetEpochResponse(
+                    epoch=ds.epoch(), finished=ds.finished()
+                )
+            )
+        if isinstance(req, msg.ShardCheckpointRequest):
+            content = self.task_manager.checkpoint_dataset(
+                req.dataset_name
+            )
+            return ReplyEnvelope(
+                payload=msg.ShardCheckpointResponse(content=content)
+            )
+        if isinstance(req, msg.GetCommWorld):
+            rdzv = self._rdzv(req.rdzv_name)
+            rnd, group, world = rdzv.get_comm_world(req.node_id)
+            return ReplyEnvelope(
+                payload=msg.CommWorldResponse(
+                    round=rnd, group=group, world=world
+                )
+            )
+        if isinstance(req, msg.NumNodesWaiting):
+            rdzv = self._rdzv(req.rdzv_name)
+            return ReplyEnvelope(
+                payload=msg.NumNodesWaitingResponse(
+                    waiting_num=rdzv.num_nodes_waiting()
+                )
+            )
+        if isinstance(req, msg.NetworkCheckQuery):
+            rdzv = self._rdzv("network-check")
+            if req.query == "fault":
+                nodes = rdzv.check_fault_nodes()
+            else:
+                nodes = rdzv.get_stragglers()
+            return ReplyEnvelope(
+                payload=msg.NetworkCheckQueryResponse(nodes=nodes)
+            )
+        if isinstance(req, msg.KeyValueQuery):
+            return ReplyEnvelope(
+                payload=msg.KeyValuePair(
+                    key=req.key, value=self.kv_store.get(req.key)
+                )
+            )
+        if isinstance(req, msg.SyncQuery):
+            return ReplyEnvelope(
+                payload=msg.SyncQueryResponse(
+                    reached=self.sync_service.reached(req.sync_name)
+                )
+            )
+        if isinstance(req, msg.CkptLatestStepQuery):
+            step = self._ckpt_steps.get(req.path, -1)
+            return ReplyEnvelope(
+                payload=msg.CkptLatestStepResponse(step=step)
+            )
+        if isinstance(req, msg.ParallelConfigRequest):
+            return ReplyEnvelope(payload=self.paral_config)
+        if isinstance(req, msg.JobStageQuery):
+            return ReplyEnvelope(
+                payload=msg.JobStageResponse(stage=self.job_stage)
+            )
+        if isinstance(req, msg.ElasticRunConfigQuery):
+            return ReplyEnvelope(
+                payload=msg.ElasticRunConfigResponse(
+                    configs=dict(self.run_configs)
+                )
+            )
+        return ReplyEnvelope(
+            success=False, reason=f"unknown get: {type(req).__name__}"
+        )
+
+    # ------------------------------------------------------------------
+    # report: state updates
+    # ------------------------------------------------------------------
+
+    def report(self, env: Envelope) -> ReplyEnvelope:
+        req = env.payload
+        if isinstance(req, msg.DatasetShardParams):
+            self.task_manager.new_dataset(
+                req.dataset_name,
+                req.dataset_size,
+                req.shard_size,
+                req.num_epochs,
+                req.shuffle,
+                req.storage_type,
+                req.task_type,
+            )
+            return ReplyEnvelope()
+        if isinstance(req, msg.ReportTaskResult):
+            ok = self.task_manager.report_task(
+                req.dataset_name, req.task_id, req.success
+            )
+            return ReplyEnvelope(success=ok)
+        if isinstance(req, msg.RestoreShardCheckpoint):
+            self.task_manager.restore_dataset(
+                req.dataset_name, req.content
+            )
+            return ReplyEnvelope()
+        if isinstance(req, msg.JoinRendezvous):
+            rdzv = self._rdzv(req.rdzv_name)
+            rnd = rdzv.join_rendezvous(
+                req.node_id,
+                req.local_world_size,
+                req.node_rank,
+                req.node_addr,
+            )
+            return ReplyEnvelope(
+                payload=msg.JoinRendezvousResponse(round=rnd)
+            )
+        if isinstance(req, msg.NetworkCheckResult):
+            rdzv = self._rdzv("network-check")
+            rdzv.report_network_check(
+                req.node_id, req.normal, req.elapsed_time
+            )
+            return ReplyEnvelope()
+        if isinstance(req, msg.NodeMeta):
+            from dlrover_tpu.common.node import Node
+
+            node = Node(req.type, req.id, rank_index=req.rank)
+            node.host_addr = req.addr
+            self.node_manager.add_node(node)
+            return ReplyEnvelope()
+        if isinstance(req, msg.NodeStatusReport):
+            self.node_manager.update_node_status(
+                req.node_type, req.node_id, req.status, req.exit_reason
+            )
+            if req.status == NodeStatus.RUNNING:
+                self.speed_monitor.add_running_worker(req.node_id)
+            elif NodeStatus.is_terminal(req.status):
+                self.speed_monitor.remove_running_worker(req.node_id)
+                self.task_manager.recover_tasks(req.node_id)
+                self._rdzv("training").remove_node(req.node_id)
+            return ReplyEnvelope()
+        if isinstance(req, msg.HeartBeat):
+            self.node_manager.report_heartbeat(
+                req.node_type, req.node_id, req.timestamp
+            )
+            return ReplyEnvelope(payload=msg.HeartbeatResponse())
+        if isinstance(req, msg.GlobalStep):
+            self.speed_monitor.collect_worker_step(
+                req.node_id, req.step, req.timestamp
+            )
+            return ReplyEnvelope()
+        if isinstance(req, msg.ResourceStats):
+            node = self.node_manager.get_node(
+                req.node_type, req.node_id
+            )
+            if node is not None:
+                node.used_resource.cpu = req.cpu_percent
+                node.used_resource.memory_mb = req.memory_mb
+            return ReplyEnvelope()
+        if isinstance(req, msg.ModelInfo):
+            self.run_configs["model_info"] = str(req)
+            return ReplyEnvelope()
+        if isinstance(req, msg.TrainingExceptionReport):
+            handled = self.error_monitor.process_error(
+                ErrorRecord(
+                    req.node_id,
+                    req.node_type,
+                    req.level,
+                    req.error_data,
+                    req.restart_count,
+                )
+            )
+            return ReplyEnvelope(success=handled)
+        if isinstance(req, msg.KeyValuePair):
+            self.kv_store.set(req.key, req.value)
+            return ReplyEnvelope()
+        if isinstance(req, msg.SyncJoin):
+            done = self.sync_service.join(req.sync_name, req.node_id)
+            return ReplyEnvelope(
+                payload=msg.SyncQueryResponse(reached=done)
+            )
+        if isinstance(req, msg.SyncFinish):
+            self.sync_service.finish(req.sync_name)
+            return ReplyEnvelope()
+        if isinstance(req, msg.CkptSaveStep):
+            prev = self._ckpt_steps.get(req.path, -1)
+            self._ckpt_steps[req.path] = max(prev, req.step)
+            return ReplyEnvelope()
+        if isinstance(req, msg.DiagnosisReport):
+            self.run_configs.setdefault("diagnosis", "")
+            return ReplyEnvelope()
+        return ReplyEnvelope(
+            success=False, reason=f"unknown report: {type(req).__name__}"
+        )

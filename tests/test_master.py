@@ -1,0 +1,219 @@
+"""Control-plane tests: real LocalJobMaster + real gRPC + MasterClient.
+
+Mirrors the reference's test tier 1 (dlrover/python/tests/test_utils.py
+`start_local_master` + test_master_client.py): an in-process master with a
+real gRPC server, exercised through the client.
+"""
+
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.master.rendezvous import NetworkCheckRendezvousManager
+from dlrover_tpu.master.shard.dataset_splitter import (
+    StreamingDatasetSplitter,
+    TableDatasetSplitter,
+    TextDatasetSplitter,
+)
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(num_nodes=1)
+    m.start()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def client(master):
+    c = MasterClient(master.addr, node_id=0, node_type="worker")
+    yield c
+    c.close()
+
+
+class TestKVAndSync:
+    def test_kv_roundtrip(self, client):
+        client.kv_set("alpha", b"beta")
+        assert client.kv_get("alpha") == b"beta"
+        assert client.kv_get("missing") == b""
+
+    def test_sync_barrier(self, client):
+        assert client.sync_join("warmup", node_rank=0) is True
+        assert client.sync_finished("warmup") is True
+
+
+class TestDataSharding:
+    def test_task_lifecycle(self, client):
+        client.report_dataset_params("ds", dataset_size=100, shard_size=30)
+        seen = []
+        while True:
+            task = client.get_task("ds")
+            if not task.exists:
+                break
+            seen.append((task.shard_start, task.shard_end))
+            client.report_task_result("ds", task.task_id)
+        assert seen == [(0, 30), (30, 60), (60, 90), (90, 100)]
+        epoch = client.get_dataset_epoch("ds")
+        assert epoch.finished
+
+    def test_failed_task_requeued(self, client):
+        client.report_dataset_params("ds2", dataset_size=10, shard_size=10)
+        t1 = client.get_task("ds2")
+        client.report_task_result("ds2", t1.task_id, success=False)
+        t2 = client.get_task("ds2")
+        assert (t2.shard_start, t2.shard_end) == (t1.shard_start, t1.shard_end)
+
+    def test_shard_checkpoint_roundtrip(self, client):
+        client.report_dataset_params("ds3", dataset_size=40, shard_size=10)
+        t = client.get_task("ds3")  # one task in flight
+        content = client.get_shard_checkpoint("ds3")
+        assert content
+        client.restore_shard_checkpoint("ds3", content)
+        # in-flight task was requeued by the restore
+        starts = set()
+        while True:
+            task = client.get_task("ds3")
+            if not task.exists:
+                break
+            starts.add(task.shard_start)
+            client.report_task_result("ds3", task.task_id)
+        assert t.shard_start in starts
+        assert len(starts) == 4
+
+
+class TestNodeLifecycle:
+    def test_status_and_heartbeat(self, client, master):
+        client.register_node(rank=0)
+        client.report_node_status(NodeStatus.RUNNING)
+        client.report_heart_beat()
+        nm = master.servicer.node_manager
+        node = nm.get_node("worker", 0)
+        assert node.status == NodeStatus.RUNNING
+
+    def test_dead_node_detection(self, master, client):
+        nm = master.servicer.node_manager
+        nm.heartbeat_timeout = 0.05
+        client.register_node(rank=0)
+        client.report_node_status(NodeStatus.RUNNING)
+        client.report_heart_beat()
+        time.sleep(0.1)
+        dead = nm.process_dead_nodes()
+        assert [n.id for n in dead] == [0]
+        # heartbeat-killed node is relaunchable -> goes PENDING
+        assert nm.get_node("worker", 0).status == NodeStatus.PENDING
+
+    def test_step_reporting(self, client, master):
+        client.report_global_step(10)
+        time.sleep(0.01)
+        client.report_global_step(20)
+        sm = master.servicer.speed_monitor
+        assert sm.global_step == 20
+        assert sm.running_speed > 0
+
+
+class TestRendezvous:
+    def test_single_node_world(self, client):
+        client.join_rendezvous(local_world_size=4, node_addr="h0:1234")
+        rnd, _, world = client.get_comm_world()
+        assert rnd == 1
+        assert world == {0: (0, 4, "h0:1234")}
+
+    def test_two_node_ranks(self, master):
+        for r in master.servicer.rdzv_managers.values():
+            r.update_rdzv_params(min_nodes=2, max_nodes=2)
+        c0 = MasterClient(master.addr, node_id=0)
+        c1 = MasterClient(master.addr, node_id=1)
+        c0.join_rendezvous(local_world_size=4, node_addr="h0:1")
+        _, _, world = c0.get_comm_world()
+        assert world == {}  # still waiting for node 1
+        c1.join_rendezvous(local_world_size=4, node_addr="h1:1")
+        _, _, world = c1.get_comm_world()
+        assert set(world) == {0, 1}
+        # membership-change signal: node 2 joins after the round formed
+        c2 = MasterClient(master.addr, node_id=2)
+        c2.join_rendezvous(local_world_size=4)
+        assert c0.num_nodes_waiting() == 1
+        for c in (c0, c1, c2):
+            c.close()
+
+
+class TestNetworkCheck:
+    def test_fault_and_straggler(self, client):
+        client.report_network_check(normal=True, elapsed=1.0)
+        c1 = MasterClient(client._stub.addr, node_id=1)
+        c1.report_network_check(normal=False, elapsed=10.0)
+        assert client.check_fault_nodes() == [1]
+        assert client.check_stragglers() == [1]
+        c1.close()
+
+    def test_group_pairing(self):
+        rdzv = NetworkCheckRendezvousManager()
+        ranks = list(range(5))
+        g0 = rdzv._group_nodes(ranks, 0)
+        g1 = rdzv._group_nodes(ranks, 1)
+        assert sorted(sum(g0, [])) == ranks
+        assert sorted(sum(g1, [])) == ranks
+        assert g0 != g1  # partners differ between rounds
+
+
+class TestSplitters:
+    def test_table_splitter(self):
+        sp = TableDatasetSplitter("t", 25, 10, num_epochs=2)
+        sp.create_shards()
+        assert [(s.start, s.end) for s in sp.get_shards()] == [
+            (0, 10),
+            (10, 20),
+            (20, 25),
+        ]
+        assert not sp.epoch_finished()
+        sp.create_shards()
+        assert sp.epoch_finished()
+
+    def test_text_splitter_shuffle(self):
+        sp = TextDatasetSplitter("t", 20, 8, shuffle=True)
+        sp.create_shards()
+        ids = sorted(
+            i for s in sp.get_shards() for i in s.record_indices
+        )
+        assert ids == list(range(20))
+
+    def test_streaming_splitter(self):
+        sp = StreamingDatasetSplitter("s", shard_size=10)
+        sp.add_records(25)
+        sp.create_shards()
+        assert [(s.start, s.end) for s in sp.get_shards()] == [
+            (0, 10),
+            (10, 20),
+        ]
+        sp.end_stream()
+        sp.create_shards()
+        assert [(s.start, s.end) for s in sp.get_shards()] == [(20, 25)]
+        assert sp.epoch_finished()
+
+
+class TestCkptCoordination:
+    def test_latest_step(self, client):
+        assert client.get_ckpt_latest_step("/ckpt") == -1
+        client.report_ckpt_saved(100, "/ckpt")
+        client.report_ckpt_saved(50, "/ckpt")  # stale report ignored
+        assert client.get_ckpt_latest_step("/ckpt") == 100
+
+
+class TestJobCompletion:
+    def test_workers_succeeded_completes_job(self, master, client):
+        client.register_node(rank=0)
+        client.report_node_status(NodeStatus.RUNNING)
+        client.report_node_status(NodeStatus.SUCCEEDED)
+        assert master._poll_once() is True
+        assert master.exit_code == 0
+
+    def test_fatal_error_fails_job(self, master, client):
+        client.register_node(rank=0)
+        client.report_node_status(NodeStatus.RUNNING)
+        client.report_node_status(NodeStatus.FAILED, "fatal_error")
+        assert master._poll_once() is True
+        assert master.exit_code == 1
