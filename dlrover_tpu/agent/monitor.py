@@ -1,0 +1,122 @@
+"""Agent-side monitors: host resources + training-step relay.
+
+Reference parity: elastic_agent/monitor/resource.py:86 (`ResourceMonitor`,
+psutil/pynvml → master) and monitor/training.py:77 (`TorchTrainingMonitor`
+— reads a metrics file the trainer writes, forwards steps + heartbeats).
+The trainer writes {"step": N, "timestamp": t} to
+ConfigPath.RUNTIME_METRICS; keeping the relay in the agent means step
+reporting survives a wedged trainer (the silence itself is the signal).
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import ConfigPath
+from dlrover_tpu.common.log import default_logger as logger
+
+try:
+    import psutil
+except ImportError:  # pragma: no cover
+    psutil = None
+
+
+class ResourceMonitor:
+    """Periodic CPU/mem usage reports to the master."""
+
+    def __init__(
+        self, client: MasterClient, interval: float = 15.0
+    ):
+        self.client = client
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="resource-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def _sample(self):
+        if psutil is None:
+            return 0.0, 0
+        cpu = psutil.cpu_percent(interval=None)
+        mem = psutil.virtual_memory()
+        return cpu, int(mem.used / (1024 * 1024))
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                cpu, mem_mb = self._sample()
+                self.client.report_resource_stats(cpu, mem_mb)
+            except Exception:  # noqa: BLE001
+                logger.debug("resource report failed", exc_info=True)
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+
+
+class TrainingMonitor:
+    """Relay trainer-written step metrics to the master."""
+
+    def __init__(
+        self,
+        client: MasterClient,
+        metrics_path: Optional[str] = None,
+        interval: float = 10.0,
+    ):
+        self.client = client
+        self.metrics_path = metrics_path or os.environ.get(
+            ConfigPath.ENV_RUNTIME_METRICS,
+            ConfigPath.DEFAULT_RUNTIME_METRICS,
+        )
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_step = -1
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="training-monitor", daemon=True
+        )
+        self._thread.start()
+
+    def _read_step(self) -> Optional[int]:
+        try:
+            with open(self.metrics_path) as f:
+                data = json.load(f)
+            return int(data.get("step", -1))
+        except (OSError, ValueError, json.JSONDecodeError):
+            return None
+
+    def _loop(self):
+        while not self._stop.is_set():
+            step = self._read_step()
+            if step is not None and step > self._last_step:
+                try:
+                    self.client.report_global_step(step)
+                    self._last_step = step
+                except Exception:  # noqa: BLE001
+                    logger.debug("step report failed", exc_info=True)
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+
+
+def write_step_metrics(step: int, path: Optional[str] = None, **extra):
+    """Trainer-side helper: publish the current step for the agent."""
+    path = path or os.environ.get(
+        ConfigPath.ENV_RUNTIME_METRICS, ConfigPath.DEFAULT_RUNTIME_METRICS
+    )
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {"step": step, "timestamp": time.time(), **extra}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)

@@ -1,0 +1,97 @@
+"""Pre-flight node health check: compute + collective micro-bench.
+
+Reference parity: NodeCheckElasticAgent training.py:910 (run :951,
+_run_node_check :1009), node_health_check :1119, comm_perf_check :1138,
+and the device benches dlrover/trainer/torch/node_check/{nvidia_gpu.py,
+utils.py:45 bm_allgather, mock_error :36}.
+
+TPU version: the bench runs a jitted bf16 matmul chain (MXU exercise) and
+a psum/all_gather over local devices (ICI exercise); elapsed time is
+reported to the master's NetworkCheckRendezvousManager, which aggregates
+fault/straggler sets across rounds. `MOCK_ERR_RANK` injects a failure for
+chaos tests (reference utils.py:36).
+"""
+
+import os
+import time
+from typing import Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def matmul_collective_bench(
+    size: int = 1024, iters: int = 8
+) -> Tuple[bool, float]:
+    """(healthy, elapsed_seconds). Runs on whatever backend is live."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        n_local = jax.local_device_count()
+
+        @jax.jit
+        def chain(x):
+            for _ in range(4):
+                x = jnp.tanh(x @ x)
+            return x
+
+        x = jnp.ones((size, size), jnp.bfloat16)
+        chain(x).block_until_ready()  # compile outside the timed region
+
+        if n_local > 1:
+            mesh_devices = jax.local_devices()
+
+            @jax.pmap
+            def allgather(y):
+                return jax.lax.all_gather(y, axis_name="i")
+
+            y = jnp.ones((n_local, size // n_local, size), jnp.bfloat16)
+            allgather(y).block_until_ready()
+
+        start = time.monotonic()
+        for _ in range(iters):
+            out = chain(x)
+        out.block_until_ready()
+        if n_local > 1:
+            for _ in range(iters):
+                g = allgather(y)
+            jax.tree_util.tree_map(
+                lambda a: a.block_until_ready(), g
+            )
+        elapsed = time.monotonic() - start
+        return True, elapsed
+    except Exception:  # noqa: BLE001 — any device error = unhealthy node
+        logger.exception("node check bench failed")
+        return False, 0.0
+
+
+def _mock_error() -> bool:
+    """Chaos hook: DLROVER_TPU_MOCK_ERR_RANK=<node_id> fails that node."""
+    mock = os.environ.get(NodeEnv.MOCK_ERR_RANK, "")
+    node_id = os.environ.get(NodeEnv.NODE_ID, "-1")
+    return bool(mock) and mock == node_id
+
+
+def node_health_check(client: MasterClient, config=None) -> bool:
+    """Two check rounds against the network-check rendezvous; returns
+    False if the master marks this node faulty."""
+    for round_idx in range(2):
+        normal, elapsed = matmul_collective_bench()
+        if _mock_error():
+            normal, elapsed = False, 0.0
+        client.report_network_check(normal=normal, elapsed=elapsed)
+        logger.info(
+            "node check round %d: normal=%s elapsed=%.3fs",
+            round_idx,
+            normal,
+            elapsed,
+        )
+    fault_nodes = client.check_fault_nodes()
+    if client.node_id in fault_nodes:
+        return False
+    stragglers = client.check_stragglers()
+    if client.node_id in stragglers:
+        logger.warning("this node is a straggler (continuing)")
+    return True

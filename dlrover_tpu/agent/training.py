@@ -1,0 +1,371 @@
+"""Elastic training agent: per-host supervisor of the JAX worker process.
+
+Reference parity: dlrover/python/elastic_agent/torch/training.py —
+`MasterRendezvousHandler` (:182, next_rendezvous :253),
+`ElasticTrainingAgent` (:365, _invoke_run :584, _restart_workers :713,
+_membership_changed :720), `launch_agent` :780, `ElasticLaunchConfig` :119.
+
+TPU re-design: torchelastic restarts N local ranks and rebuilds NCCL; here
+each host runs ONE JAX process (it owns all local TPU chips), and a new
+rendezvous round means the agent restarts that process with fresh
+`jax.distributed.init` coordinates (coordinator = rank-0 host). The agent —
+not the training process — owns the flash-checkpoint staging memory, so a
+training-process crash never loses the in-memory checkpoint.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.constants import (
+    JobConstant,
+    NodeEnv,
+    NodeStatus,
+    TrainingExceptionLevel,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import find_free_port
+
+CommWorld = Dict[int, Tuple[int, int, str]]
+
+
+@dataclass
+class ElasticLaunchConfig:
+    """Reference ElasticLaunchConfig training.py:119, trimmed to the TPU
+    shape: one worker process per host."""
+
+    min_nodes: int = 1
+    max_nodes: int = 1
+    nproc_per_node: int = 1
+    max_restarts: int = 3
+    monitor_interval: float = (
+        JobConstant.TRAINING_AGENT_LOOP_INTERVAL_SECS
+    )
+    rdzv_timeout: float = JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT
+    network_check: bool = False
+    node_unit: int = 1
+    job_name: str = "job"
+    log_dir: Optional[str] = None
+
+    def auto_configure_params(self):
+        """Reference :156 — network check implies at least 2 nodes."""
+        if self.network_check and self.max_nodes < 2:
+            self.network_check = False
+
+
+class MasterRendezvousHandler:
+    """Join the master rendezvous and block for the comm world.
+
+    Reference: MasterRendezvousHandler training.py:182. The returned
+    world maps node_rank -> (node_id, local_world_size, node_addr);
+    rank 0's addr hosts the jax.distributed coordinator.
+    """
+
+    def __init__(
+        self,
+        client: MasterClient,
+        rdzv_name: str = "training",
+        timeout: float = JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT,
+        poll_interval: float = 0.5,
+    ):
+        self.client = client
+        self.rdzv_name = rdzv_name
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+
+    def next_rendezvous(
+        self, local_world_size: int = 1, node_addr: str = ""
+    ) -> Tuple[int, int, CommWorld]:
+        """Returns (round, node_rank, world). Blocks until the round
+        forms or raises TimeoutError."""
+        self.client.join_rendezvous(
+            local_world_size=local_world_size,
+            rdzv_name=self.rdzv_name,
+            node_addr=node_addr,
+        )
+        deadline = time.monotonic() + self.timeout
+        while time.monotonic() < deadline:
+            rnd, _, world = self.client.get_comm_world(self.rdzv_name)
+            if world:
+                for rank, (nid, _, _) in world.items():
+                    if nid == self.client.node_id:
+                        return rnd, rank, world
+                # round formed without us (node_unit rounding) — rejoin
+                self.client.join_rendezvous(
+                    local_world_size=local_world_size,
+                    rdzv_name=self.rdzv_name,
+                    node_addr=node_addr,
+                )
+            time.sleep(self.poll_interval)
+        raise TimeoutError(
+            f"rendezvous {self.rdzv_name!r} did not complete in "
+            f"{self.timeout}s"
+        )
+
+
+class WorkerProcess:
+    """One supervised training process."""
+
+    def __init__(self, proc: subprocess.Popen, env: Dict[str, str]):
+        self.proc = proc
+        self.env = env
+        self.start_time = time.time()
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def terminate(self, grace: float = 10.0):
+        if self.proc.poll() is not None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(grace)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+
+class ElasticTrainingAgent:
+    """Supervise the local worker; restart on failure or membership change.
+
+    The run loop mirrors reference `_invoke_run` training.py:584:
+      1. rendezvous -> world;
+      2. start worker with JAX coordination env;
+      3. monitor: on FAILED report + (maybe) restart; on master signaling
+         waiting nodes (_membership_changed :720), restart into a new
+         round; on SUCCEEDED report and exit.
+    """
+
+    def __init__(
+        self,
+        config: ElasticLaunchConfig,
+        entrypoint: List[str],
+        client: Optional[MasterClient] = None,
+        host_addr: str = "127.0.0.1",
+    ):
+        self.config = config
+        self.entrypoint = entrypoint
+        self.client = client or MasterClient.singleton()
+        self.host_addr = host_addr
+        self.rdzv = MasterRendezvousHandler(
+            self.client, timeout=config.rdzv_timeout
+        )
+        self.worker: Optional[WorkerProcess] = None
+        self.restart_count = 0
+        self._stop = threading.Event()
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._coordinator_port = find_free_port()
+        # flash-checkpoint plumbing: the agent owns the IPC server, the
+        # shm staging segment and the async saver so checkpoints survive
+        # trainer crashes (reference AsyncCheckpointSaver in the agent,
+        # ckpt_saver.py:345)
+        from dlrover_tpu.agent.ckpt_saver import AsyncCheckpointSaver
+        from dlrover_tpu.common.multi_process import LocalSocketServer
+
+        self._ipc = LocalSocketServer(config.job_name)
+        self._ipc.start()
+        self.ckpt_saver = AsyncCheckpointSaver(
+            job_name=config.job_name,
+            node_rank=0,
+            master_client=self.client,
+        )
+        self.ckpt_saver.start()
+
+    # ---- heartbeats ------------------------------------------------------
+
+    def _heartbeat_loop(self):
+        while not self._stop.is_set():
+            try:
+                resp = self.client.report_heart_beat()
+                if resp.action == "stop":
+                    logger.info("master requested stop")
+                    self._stop.set()
+            except Exception:  # noqa: BLE001
+                logger.warning("heartbeat failed", exc_info=True)
+            self._stop.wait(JobConstant.HEARTBEAT_INTERVAL_SECS)
+
+    def _start_heartbeats(self):
+        if self._heartbeat_thread is None:
+            self._heartbeat_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name="agent-heartbeat",
+                daemon=True,
+            )
+            self._heartbeat_thread.start()
+
+    # ---- worker lifecycle ------------------------------------------------
+
+    def _worker_env(
+        self, rnd: int, node_rank: int, world: CommWorld
+    ) -> Dict[str, str]:
+        """JAX coordination env for the worker process. The coordinator
+        lives on the rank-0 host at a port the rank-0 agent allocated and
+        published in its rendezvous addr ("host:port")."""
+        coord_addr = world[0][2]
+        num_procs = len(world)
+        env = dict(os.environ)
+        if env.get("DLROVER_TPU_FORCE_CPU") == "1":
+            # keep CPU-forced workers (tests, local sim) off the TPU
+            # boot hook: sitecustomize imports jax+axon when this is
+            # set, costing ~2s per spawn and dialing the shared tunnel
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        env.update(
+            {
+                NodeEnv.JOB_NAME: self.config.job_name,
+                NodeEnv.MASTER_ADDR: self.client._stub.addr,
+                NodeEnv.NODE_ID: str(self.client.node_id),
+                NodeEnv.NODE_RANK: str(node_rank),
+                NodeEnv.NODE_NUM: str(num_procs),
+                NodeEnv.COORDINATOR_ADDR: coord_addr,
+                NodeEnv.RESTART_COUNT: str(self.restart_count),
+                "DLROVER_TPU_RDZV_ROUND": str(rnd),
+            }
+        )
+        return env
+
+    def _start_worker(self) -> Tuple[int, CommWorld]:
+        node_addr = f"{self.host_addr}:{self._coordinator_port}"
+        rnd, node_rank, world = self.rdzv.next_rendezvous(
+            local_world_size=self.config.nproc_per_node,
+            node_addr=node_addr,
+        )
+        env = self._worker_env(rnd, node_rank, world)
+        log_path = None
+        stdout = stderr = None
+        if self.config.log_dir:
+            os.makedirs(self.config.log_dir, exist_ok=True)
+            log_path = os.path.join(
+                self.config.log_dir,
+                f"worker_{node_rank}_r{self.restart_count}.log",
+            )
+            stdout = open(log_path, "ab")
+            stderr = subprocess.STDOUT
+        self.ckpt_saver.update_topology(node_rank, len(world))
+        proc = subprocess.Popen(
+            self.entrypoint,
+            env=env,
+            stdout=stdout,
+            stderr=stderr,
+        )
+        self.worker = WorkerProcess(proc, env)
+        self.client.report_node_status(NodeStatus.RUNNING)
+        logger.info(
+            "started worker pid=%d rank=%d world=%d round=%d%s",
+            proc.pid,
+            node_rank,
+            len(world),
+            rnd,
+            f" log={log_path}" if log_path else "",
+        )
+        return rnd, world
+
+    def _stop_worker(self):
+        if self.worker is not None:
+            self.worker.terminate()
+            self.worker = None
+
+    def _membership_changed(self) -> bool:
+        try:
+            return self.client.num_nodes_waiting() > 0
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _restart_worker(self) -> Tuple[int, CommWorld]:
+        """Reference _restart_workers :713."""
+        self._stop_worker()
+        return self._start_worker()
+
+    # ---- main loop -------------------------------------------------------
+
+    def run(self) -> int:
+        self._start_heartbeats()
+        self.client.register_node()
+        rnd, world = self._start_worker()
+        try:
+            return self._monitor_loop()
+        finally:
+            self._stop.set()
+            self._stop_worker()
+            self.ckpt_saver.stop()
+            self._ipc.stop()
+
+    def _monitor_loop(self) -> int:
+        while not self._stop.is_set():
+            time.sleep(self.config.monitor_interval)
+            code = self.worker.poll() if self.worker else None
+            if code is None:
+                if self._membership_changed():
+                    logger.info(
+                        "membership change detected — restarting worker "
+                        "into a new rendezvous round"
+                    )
+                    self.restart_count += 1
+                    self._restart_worker()
+                continue
+            if code == 0:
+                logger.info("worker succeeded")
+                self.client.report_node_status(NodeStatus.SUCCEEDED)
+                return 0
+            # failure path: persist any staged shm checkpoint first
+            # (reference _save_ckpt_to_storage training.py:674)
+            logger.warning("worker exited with code %d", code)
+            try:
+                self.ckpt_saver.save_shm_to_storage()
+            except Exception:  # noqa: BLE001
+                logger.exception("crash-path checkpoint persist failed")
+            self.client.report_failure(
+                f"worker exit code {code}",
+                TrainingExceptionLevel.PROCESS_ERROR,
+                self.restart_count,
+            )
+            if self.restart_count >= self.config.max_restarts:
+                # fatal_error marks the node unrecoverable on the master
+                # (reference: _should_relaunch dist_job_manager.py:593)
+                self.client.report_node_status(
+                    NodeStatus.FAILED, "fatal_error"
+                )
+                return code
+            self.restart_count += 1
+            logger.info(
+                "restarting worker (%d/%d)",
+                self.restart_count,
+                self.config.max_restarts,
+            )
+            self._restart_worker()
+        self._stop_worker()
+        return 0
+
+    def stop(self):
+        self._stop.set()
+
+
+def launch_agent(
+    config: ElasticLaunchConfig,
+    entrypoint: List[str],
+    master_addr: str,
+    node_id: int = 0,
+    host_addr: str = "127.0.0.1",
+) -> int:
+    """Reference launch_agent training.py:780: build client + agent, run
+    optional pre-flight node check, then supervise training."""
+    config.auto_configure_params()
+    client = MasterClient(master_addr, node_id=node_id)
+    if config.network_check:
+        from dlrover_tpu.agent.node_check import node_health_check
+
+        ok = node_health_check(client, config)
+        if not ok:
+            logger.error("node failed pre-flight health check")
+            client.report_node_status(NodeStatus.FAILED, "hardware_error")
+            return 3
+    agent = ElasticTrainingAgent(
+        config, entrypoint, client, host_addr=host_addr
+    )
+    return agent.run()

@@ -1,0 +1,165 @@
+"""Elastic-agent tests: worker supervision, restart-on-failure, and the
+fault-injection tier (kill a worker process, assert recovery) — mirrors
+dlrover/python/tests/test_elastic_training_agent.py + the chaos scenarios
+(SURVEY.md §4 tier 3).
+"""
+
+import os
+import signal
+import sys
+import textwrap
+import time
+
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training import (
+    ElasticLaunchConfig,
+    ElasticTrainingAgent,
+    MasterRendezvousHandler,
+)
+from dlrover_tpu.common.constants import NodeEnv, NodeStatus
+from dlrover_tpu.master.master import LocalJobMaster
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(num_nodes=1)
+    m.start()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def client(master):
+    c = MasterClient(master.addr, node_id=0, node_type="worker")
+    yield c
+    c.close()
+
+
+def _script(tmp_path, body: str) -> str:
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+def _agent(config, script, client):
+    return ElasticTrainingAgent(
+        config, [sys.executable, script], client
+    )
+
+
+class TestRendezvousHandler:
+    def test_next_rendezvous_assigns_rank(self, client):
+        h = MasterRendezvousHandler(client, timeout=10)
+        rnd, rank, world = h.next_rendezvous(
+            local_world_size=2, node_addr="127.0.0.1:9999"
+        )
+        assert rnd == 1
+        assert rank == 0
+        assert world[0] == (0, 2, "127.0.0.1:9999")
+
+
+class TestAgentLifecycle:
+    def test_successful_worker(self, tmp_path, client, master):
+        script = _script(tmp_path, "print('ok')")
+        config = ElasticLaunchConfig(monitor_interval=0.1)
+        agent = _agent(config, script, client)
+        assert agent.run() == 0
+        node = master.servicer.node_manager.get_node("worker", 0)
+        assert node.status == NodeStatus.SUCCEEDED
+
+    def test_worker_env_propagated(self, tmp_path, client):
+        out = tmp_path / "env.txt"
+        script = _script(
+            tmp_path,
+            f"""
+            import os
+            keys = ["{NodeEnv.NODE_RANK}", "{NodeEnv.NODE_NUM}",
+                    "{NodeEnv.COORDINATOR_ADDR}", "{NodeEnv.MASTER_ADDR}"]
+            with open({str(out)!r}, "w") as f:
+                f.write(",".join(os.environ.get(k, "MISSING") for k in keys))
+            """,
+        )
+        config = ElasticLaunchConfig(monitor_interval=0.1)
+        agent = _agent(config, script, client)
+        assert agent.run() == 0
+        rank, num, coord, addr = out.read_text().split(",")
+        assert rank == "0"
+        assert num == "1"
+        assert ":" in coord
+        assert addr == client._stub.addr
+
+    def test_restart_on_failure_then_succeed(self, tmp_path, client):
+        """Worker fails on first run, succeeds after restart — the
+        process-restart recovery path (reference ~75% of faults)."""
+        marker = tmp_path / "attempt"
+        script = _script(
+            tmp_path,
+            f"""
+            import os, sys
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                sys.exit(7)
+            """,
+        )
+        config = ElasticLaunchConfig(max_restarts=2, monitor_interval=0.1)
+        agent = _agent(config, script, client)
+        assert agent.run() == 0
+        assert agent.restart_count == 1
+
+    def test_max_restarts_exceeded(self, tmp_path, client, master):
+        script = _script(tmp_path, "import sys; sys.exit(3)")
+        config = ElasticLaunchConfig(max_restarts=1, monitor_interval=0.1)
+        agent = _agent(config, script, client)
+        assert agent.run() == 3
+        node = master.servicer.node_manager.get_node("worker", 0)
+        assert node.status in (NodeStatus.FAILED, NodeStatus.PENDING)
+        # failure was reported to the error monitor
+        assert master.servicer.error_monitor.recent()
+
+    def test_kill_signal_recovery(self, tmp_path, client):
+        """Chaos tier: worker killed by SIGKILL mid-run recovers
+        (reference fault_tolerance_exps.md process-kill scenario)."""
+        marker = tmp_path / "attempt"
+        script = _script(
+            tmp_path,
+            f"""
+            import os, time
+            marker = {str(marker)!r}
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os.kill(os.getpid(), 9)
+            """,
+        )
+        config = ElasticLaunchConfig(max_restarts=2, monitor_interval=0.1)
+        agent = _agent(config, script, client)
+        assert agent.run() == 0
+        assert agent.restart_count == 1
+
+
+class TestElasticRunCLI:
+    def test_end_to_end_local(self, tmp_path):
+        """dlrover-tpu-run with no master configured: node 0 spawns the
+        local master, agent supervises, job succeeds."""
+        from dlrover_tpu.trainer.elastic_run import main
+
+        script = tmp_path / "train.py"
+        script.write_text("print('trained')\n")
+        code = main(
+            [
+                "--nnodes",
+                "1",
+                "--max-restarts",
+                "1",
+                str(script),
+            ]
+        )
+        assert code == 0
+
+    def test_parse_nnodes(self):
+        from dlrover_tpu.trainer.elastic_run import parse_nnodes
+
+        assert parse_nnodes("4") == (4, 4)
+        assert parse_nnodes("2:8") == (2, 8)
