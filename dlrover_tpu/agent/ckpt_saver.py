@@ -1,0 +1,361 @@
+"""Flash Checkpoint saver daemon (agent side) + shared-memory handler.
+
+Reference parity: dlrover/python/elastic_agent/torch/ckpt_saver.py —
+`SharedMemoryHandler` (:210), `AsyncCheckpointSaver` (:345, factory thread
+start_async_saving_ckpt :410), `CommonDirCheckpointSaver` (:774,
+save_step_checkpoint / commit_checkpoint), done-file two-phase commit,
+tracker file.
+
+TPU re-design: the staged state is a flat {path: np.ndarray} of the
+host's *addressable shards* of sharded jax.Arrays (device→host DMA done
+by the trainer engine). The shm segment is a /dev/shm file that survives
+a trainer crash; the agent persists it asynchronously and runs the commit
+protocol through the master's KV-store-free filesystem dance (done files
++ tracker), identical to the reference.
+"""
+
+import json
+import os
+import pickle
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.constants import CheckpointConstant
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.multi_process import (
+    LocalSocketServer,
+    SharedDict,
+    SharedLock,
+    SharedMemorySegment,
+    SharedQueue,
+)
+from dlrover_tpu.common.storage import (
+    CheckpointStorage,
+    get_checkpoint_storage,
+)
+
+CKPT_META_NAME = "ckpt_meta"
+CKPT_QUEUE_NAME = "ckpt_save_events"
+CKPT_LOCK_NAME = "ckpt_shm_lock"
+
+
+@dataclass
+class TensorMeta:
+    path: str  # flattened pytree path, "params/layers/wq"
+    shape: Tuple[int, ...]
+    dtype: str
+    offset: int
+    nbytes: int
+
+
+@dataclass
+class CheckpointMeta:
+    step: int = -1
+    save_path: str = ""
+    tensors: List[TensorMeta] = field(default_factory=list)
+    aux: bytes = b""  # pickled non-array leaves + treedef info
+    total_bytes: int = 0
+
+
+def shm_segment_name(job_name: str, node_rank: int) -> str:
+    return f"dlrover_tpu_ckpt_{job_name}_{node_rank}"
+
+
+class SharedMemoryHandler:
+    """Write/read a flat {path: np.ndarray} state into the shm segment.
+
+    Reference: SharedMemoryHandler ckpt_saver.py:210 (_traverse_copy_to_shm
+    :175 equivalent is `save_flat_state`).
+    """
+
+    def __init__(self, job_name: str, node_rank: int = 0):
+        self.job_name = job_name
+        self.node_rank = node_rank
+        self.seg_name = shm_segment_name(job_name, node_rank)
+        self._segment: Optional[SharedMemorySegment] = None
+        self.meta_dict = SharedDict(
+            f"{CKPT_META_NAME}_{node_rank}", job_name
+        )
+        self.lock = SharedLock(
+            f"{CKPT_LOCK_NAME}_{node_rank}", job_name
+        )
+
+    # ---- write path (trainer) -------------------------------------------
+
+    def save_flat_state(
+        self,
+        step: int,
+        flat: Dict[str, np.ndarray],
+        save_path: str = "",
+        aux: bytes = b"",
+    ):
+        tensors = []
+        offset = 0
+        for path, arr in flat.items():
+            arr = np.ascontiguousarray(arr)
+            tensors.append(
+                TensorMeta(
+                    path, tuple(arr.shape), str(arr.dtype), offset,
+                    arr.nbytes,
+                )
+            )
+            offset += arr.nbytes
+        if self._segment is None or self._segment.size < offset:
+            if self._segment is not None:
+                self._segment.close()
+            self._segment = SharedMemorySegment(
+                self.seg_name, size=max(offset, 1), create=True
+            )
+        buf = self._segment.buf
+        for tm, arr in zip(tensors, flat.values()):
+            buf[tm.offset : tm.offset + tm.nbytes] = np.ascontiguousarray(
+                arr
+            ).tobytes()
+        meta = CheckpointMeta(
+            step=step,
+            save_path=save_path,
+            tensors=tensors,
+            aux=aux,
+            total_bytes=offset,
+        )
+        self.meta_dict.set("meta", pickle.dumps(meta))
+
+    # ---- read path (agent saver / trainer restore) ----------------------
+
+    def get_meta(self) -> Optional[CheckpointMeta]:
+        raw = self.meta_dict.get("meta")
+        return pickle.loads(raw) if raw else None
+
+    def load_flat_state(
+        self,
+    ) -> Tuple[Optional[CheckpointMeta], Dict[str, np.ndarray]]:
+        meta = self.get_meta()
+        if meta is None or meta.step < 0:
+            return None, {}
+        if self._segment is None:
+            if not SharedMemorySegment.exists(self.seg_name):
+                return None, {}
+            self._segment = SharedMemorySegment(self.seg_name)
+        buf = self._segment.buf
+        flat = {}
+        for tm in meta.tensors:
+            raw = bytes(buf[tm.offset : tm.offset + tm.nbytes])
+            flat[tm.path] = np.frombuffer(
+                raw, dtype=np.dtype(tm.dtype)
+            ).reshape(tm.shape)
+        return meta, flat
+
+    def close(self, unlink: bool = False):
+        if self._segment is not None:
+            if unlink:
+                self._segment.unlink()
+            else:
+                self._segment.close()
+            self._segment = None
+
+
+class AsyncCheckpointSaver:
+    """Agent-resident daemon: drains save events, persists shm to storage,
+    runs the done-file commit protocol.
+
+    Reference: AsyncCheckpointSaver ckpt_saver.py:345 +
+    CommonDirCheckpointSaver :774. One saver per host; `node_rank`/
+    `num_nodes` drive the commit barrier (rank 0 writes the tracker once
+    every host's done file exists).
+    """
+
+    _singleton = None
+
+    def __init__(
+        self,
+        job_name: str = "default",
+        node_rank: int = 0,
+        num_nodes: int = 1,
+        storage: Optional[CheckpointStorage] = None,
+        master_client=None,
+    ):
+        self.job_name = job_name
+        self.node_rank = node_rank
+        self.num_nodes = num_nodes
+        self.storage = storage or get_checkpoint_storage()
+        self.master_client = master_client
+        self.shm_handler = SharedMemoryHandler(job_name, node_rank)
+        self.event_queue = SharedQueue(CKPT_QUEUE_NAME, job_name)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_persisted_step = -1
+
+    # ---- lifecycle -------------------------------------------------------
+
+    @classmethod
+    def start_async_saving_ckpt(cls, **kw) -> "AsyncCheckpointSaver":
+        """Factory: one daemon thread per agent process (reference :410)."""
+        if cls._singleton is None:
+            cls._singleton = cls(**kw)
+            cls._singleton.start()
+        return cls._singleton
+
+    @classmethod
+    def reset(cls):
+        if cls._singleton is not None:
+            cls._singleton.stop()
+            cls._singleton = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._saver_loop, name="ckpt-saver", daemon=True
+        )
+        self._thread.start()
+
+    def update_topology(self, node_rank: int, num_nodes: int):
+        """Re-point the saver after a rendezvous round changed this
+        host's rank or the world size (commit barrier + shm name)."""
+        if node_rank != self.node_rank:
+            self.shm_handler.close()
+            self.shm_handler = SharedMemoryHandler(
+                self.job_name, node_rank
+            )
+        self.node_rank = node_rank
+        self.num_nodes = num_nodes
+
+    def stop(self):
+        self._stop.set()
+
+    # ---- persist path ----------------------------------------------------
+
+    def _saver_loop(self):
+        while not self._stop.is_set():
+            try:
+                event = self.event_queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            except (ConnectionError, OSError):
+                time.sleep(1.0)
+                continue
+            try:
+                self._handle_event(event)
+            except Exception:  # noqa: BLE001 — saver must survive
+                logger.exception("checkpoint persist failed")
+
+    def _handle_event(self, event: dict):
+        step = event["step"]
+        path = event["path"]
+        t0 = time.monotonic()
+        self.save_step_checkpoint(step, path)
+        logger.info(
+            "persisted checkpoint step=%d to %s in %.2fs",
+            step,
+            path,
+            time.monotonic() - t0,
+        )
+
+    def save_step_checkpoint(self, step: int, path: str):
+        """Persist the current shm state for `step` under `path/step/`."""
+        with self.shm_handler.lock:
+            meta, flat = self.shm_handler.load_flat_state()
+            if meta is None or meta.step != step:
+                logger.warning(
+                    "shm holds step %s, wanted %d — skipping persist",
+                    meta.step if meta else None,
+                    step,
+                )
+                return
+            step_dir = os.path.join(path, str(step))
+            self.storage.makedirs(step_dir)
+            self.persist_to_storage(step_dir, meta, flat)
+        self.commit_checkpoint(step, path)
+        self.last_persisted_step = step
+
+    def persist_to_storage(
+        self, step_dir: str, meta: CheckpointMeta, flat: dict
+    ):
+        """One .npz per host shard + pickled aux."""
+        shard_file = os.path.join(
+            step_dir, f"host_{self.node_rank}.npz"
+        )
+        import io
+
+        bio = io.BytesIO()
+        np.savez(bio, **flat)
+        self.storage.write(bio.getvalue(), shard_file)
+        aux_file = os.path.join(
+            step_dir, f"aux_{self.node_rank}.pkl"
+        )
+        self.storage.write(meta.aux, aux_file)
+
+    # ---- commit protocol -------------------------------------------------
+
+    def commit_checkpoint(
+        self, step: int, path: str, timeout: float = None
+    ):
+        """Two-phase: every host writes `.done_{rank}`; rank 0 waits for
+        all, then atomically updates the tracker file and notifies the
+        master (reference commit_checkpoint + update_tracker_file)."""
+        timeout = timeout or CheckpointConstant.SAVE_TIMEOUT_SECS
+        step_dir = os.path.join(path, str(step))
+        done_file = os.path.join(
+            step_dir,
+            f"{CheckpointConstant.DONE_FILE_PREFIX}{self.node_rank}",
+        )
+        self.storage.write(b"1", done_file)
+        if self.node_rank != 0:
+            return
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            done = [
+                f
+                for f in self.storage.listdir(step_dir)
+                if f.startswith(CheckpointConstant.DONE_FILE_PREFIX)
+            ]
+            if len(done) >= self.num_nodes:
+                break
+            time.sleep(0.1)
+        else:
+            logger.error(
+                "commit timeout: %d/%d done files for step %d",
+                len(done),
+                self.num_nodes,
+                step,
+            )
+            self.storage.commit(step, False)
+            return
+        tracker = os.path.join(path, CheckpointConstant.TRACKER_FILE)
+        self.storage.write(str(step), tracker)
+        self.storage.commit(step, True)
+        if self.master_client is not None:
+            try:
+                self.master_client.report_ckpt_saved(step, path)
+            except Exception:  # noqa: BLE001
+                logger.warning("ckpt step report failed", exc_info=True)
+
+    # ---- crash path ------------------------------------------------------
+
+    def save_shm_to_storage(self):
+        """Called by the agent when the trainer dies: persist whatever
+        step is staged in shm if newer than the last persisted one
+        (reference _save_ckpt_to_storage training.py:674)."""
+        meta = self.shm_handler.get_meta()
+        if meta is None or meta.step < 0 or not meta.save_path:
+            return
+        if meta.step <= self.last_persisted_step:
+            return
+        logger.info(
+            "trainer died — persisting staged shm checkpoint step=%d",
+            meta.step,
+        )
+        self.save_step_checkpoint(meta.step, meta.save_path)
+
+
+def read_tracker_step(storage: CheckpointStorage, path: str) -> int:
+    raw = storage.read(
+        os.path.join(path, CheckpointConstant.TRACKER_FILE), "r"
+    )
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return -1

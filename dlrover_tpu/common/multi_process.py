@@ -1,0 +1,368 @@
+"""On-host IPC between the agent and the training process.
+
+Reference parity: dlrover/python/common/multi_process.py — unix-socket
+served `SharedLock` (:227), `SharedQueue` (:348), `SharedDict` (:455).
+One `LocalSocketServer` runs in the agent process and hosts any number of
+named locks/queues/dicts; trainer-side proxies speak a tiny pickled
+request protocol. POSIX shared memory is handled separately by
+`SharedMemorySegment` (mmap over /dev/shm — deliberately NOT
+multiprocessing.shared_memory, whose resource tracker unlinks segments
+when the *creating* process dies; flash checkpoint requires the segment
+to outlive a crashed trainer).
+"""
+
+import mmap
+import os
+import pickle
+import queue as _queue
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+SOCKET_DIR = os.environ.get(
+    "DLROVER_TPU_SOCK_DIR", "/tmp/dlrover_tpu/sockets"
+)
+
+
+def socket_path(job_name: str) -> str:
+    os.makedirs(SOCKET_DIR, exist_ok=True)
+    return os.path.join(SOCKET_DIR, f"{job_name}.sock")
+
+
+# ---------------------------------------------------------------------------
+# wire helpers: length-prefixed pickle frames
+# ---------------------------------------------------------------------------
+
+
+def _send_msg(sock: socket.socket, obj: Any):
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+# ---------------------------------------------------------------------------
+# server (agent side)
+# ---------------------------------------------------------------------------
+
+
+class LocalSocketServer:
+    """Hosts named locks, queues and dicts for one job on one host."""
+
+    def __init__(self, job_name: str = "default"):
+        self.path = socket_path(job_name)
+        self._locks: Dict[str, threading.Lock] = {}
+        self._lock_owners: Dict[str, str] = {}
+        self._queues: Dict[str, _queue.Queue] = {}
+        self._dicts: Dict[str, dict] = {}
+        self._meta_lock = threading.Lock()
+        self._server: Optional[socketserver.ThreadingUnixStreamServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # object accessors (server side) --------------------------------------
+
+    def _lock(self, name) -> threading.Lock:
+        with self._meta_lock:
+            return self._locks.setdefault(name, threading.Lock())
+
+    def _queue(self, name) -> _queue.Queue:
+        with self._meta_lock:
+            return self._queues.setdefault(name, _queue.Queue())
+
+    def _dict(self, name) -> dict:
+        with self._meta_lock:
+            return self._dicts.setdefault(name, {})
+
+    # request handling -----------------------------------------------------
+
+    def _handle(self, req: dict) -> Any:
+        kind, name, op = req["kind"], req["name"], req["op"]
+        if kind == "lock":
+            lock = self._lock(name)
+            if op == "acquire":
+                ok = lock.acquire(
+                    blocking=req.get("blocking", True),
+                    timeout=req.get("timeout", -1),
+                )
+                if ok:
+                    self._lock_owners[name] = req.get("owner", "")
+                return ok
+            if op == "release":
+                try:
+                    lock.release()
+                    self._lock_owners.pop(name, None)
+                    return True
+                except RuntimeError:
+                    return False
+            if op == "locked":
+                return lock.locked()
+        elif kind == "queue":
+            q = self._queue(name)
+            if op == "put":
+                q.put(req["value"])
+                return True
+            if op == "get":
+                try:
+                    return ("ok", q.get(timeout=req.get("timeout")))
+                except _queue.Empty:
+                    return ("empty", None)
+            if op == "size":
+                return q.qsize()
+        elif kind == "dict":
+            d = self._dict(name)
+            if op == "set":
+                d[req["key"]] = req["value"]
+                return True
+            if op == "get":
+                return d.get(req["key"])
+            if op == "update":
+                d.update(req["value"])
+                return True
+            if op == "dump":
+                return dict(d)
+            if op == "pop":
+                return d.pop(req["key"], None)
+        elif kind == "server" and op == "ping":
+            return "pong"
+        raise ValueError(f"bad request {kind}/{op}")
+
+    def start(self):
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        handle = self._handle
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # one connection, many requests
+                while True:
+                    try:
+                        req = _recv_msg(self.request)
+                    except (ConnectionError, EOFError):
+                        return
+                    try:
+                        result = handle(req)
+                        _send_msg(self.request, ("ok", result))
+                    except Exception as e:  # noqa: BLE001
+                        _send_msg(self.request, ("err", str(e)))
+
+        self._server = socketserver.ThreadingUnixStreamServer(
+            self.path, Handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="local-ipc-server",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("local IPC server on %s", self.path)
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+
+# ---------------------------------------------------------------------------
+# client proxies (trainer side)
+# ---------------------------------------------------------------------------
+
+
+class _Proxy:
+    kind = ""
+
+    def __init__(self, name: str, job_name: str = "default"):
+        self.name = name
+        self.job_name = job_name
+        self._sock: Optional[socket.socket] = None
+        self._sock_lock = threading.Lock()
+
+    def _connect(self):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(socket_path(self.job_name))
+        self._sock = s
+
+    def _request(self, op: str, **kw) -> Any:
+        with self._sock_lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    _send_msg(
+                        self._sock,
+                        {
+                            "kind": self.kind,
+                            "name": self.name,
+                            "op": op,
+                            **kw,
+                        },
+                    )
+                    status, result = _recv_msg(self._sock)
+                    if status == "err":
+                        raise RuntimeError(result)
+                    return result
+                except (ConnectionError, OSError):
+                    self._sock = None
+                    if attempt:
+                        raise
+        return None
+
+
+class SharedLock(_Proxy):
+    """Reference SharedLock multi_process.py:227."""
+
+    kind = "lock"
+
+    def acquire(self, blocking=True, timeout=-1) -> bool:
+        return bool(
+            self._request(
+                "acquire",
+                blocking=blocking,
+                timeout=timeout,
+                owner=str(os.getpid()),
+            )
+        )
+
+    def release(self) -> bool:
+        return bool(self._request("release"))
+
+    def locked(self) -> bool:
+        return bool(self._request("locked"))
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class SharedQueue(_Proxy):
+    """Reference SharedQueue multi_process.py:348."""
+
+    kind = "queue"
+
+    def put(self, value: Any):
+        self._request("put", value=value)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        status, value = self._request("get", timeout=timeout)
+        if status == "empty":
+            raise _queue.Empty
+        return value
+
+    def qsize(self) -> int:
+        return int(self._request("size"))
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+
+class SharedDict(_Proxy):
+    """Reference SharedDict multi_process.py:455."""
+
+    kind = "dict"
+
+    def set(self, key: str, value: Any):
+        self._request("set", key=key, value=value)
+
+    def get(self, key: str) -> Any:
+        return self._request("get", key=key)
+
+    def update(self, mapping: dict):
+        self._request("update", value=mapping)
+
+    def dump(self) -> dict:
+        return self._request("dump")
+
+    def pop(self, key: str) -> Any:
+        return self._request("pop", key=key)
+
+
+def server_alive(job_name: str, timeout: float = 1.0) -> bool:
+    try:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout)
+        s.connect(socket_path(job_name))
+        _send_msg(s, {"kind": "server", "name": "", "op": "ping"})
+        status, result = _recv_msg(s)
+        s.close()
+        return result == "pong"
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# POSIX shared memory segment (mmap over /dev/shm)
+# ---------------------------------------------------------------------------
+
+SHM_DIR = os.environ.get("DLROVER_TPU_SHM_DIR", "/dev/shm")
+
+
+class SharedMemorySegment:
+    """Named byte buffer that survives the death of any single process.
+
+    The segment is a plain file in /dev/shm (tmpfs) mapped with mmap —
+    it persists until `unlink()` regardless of which process created it,
+    which is the property flash checkpoint needs (reference keeps shm
+    alive in the *agent*, ckpt_saver.py:210 SharedMemoryHandler).
+    """
+
+    def __init__(self, name: str, size: int = 0, create: bool = False):
+        self.name = name
+        self.path = os.path.join(SHM_DIR, name.replace("/", "_"))
+        if create:
+            fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o600)
+            try:
+                cur = os.fstat(fd).st_size
+                if size > cur:
+                    os.ftruncate(fd, size)
+                self.size = max(size, cur)
+                self.buf = mmap.mmap(fd, self.size)
+            finally:
+                os.close(fd)
+        else:
+            fd = os.open(self.path, os.O_RDWR)
+            try:
+                self.size = os.fstat(fd).st_size
+                self.buf = mmap.mmap(fd, self.size)
+            finally:
+                os.close(fd)
+
+    @classmethod
+    def exists(cls, name: str) -> bool:
+        return os.path.exists(
+            os.path.join(SHM_DIR, name.replace("/", "_"))
+        )
+
+    def close(self):
+        try:
+            self.buf.close()
+        except BufferError:  # outstanding memoryviews
+            pass
+
+    def unlink(self):
+        self.close()
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,125 @@
+"""Checkpoint storage abstraction + deletion strategies.
+
+Reference parity: dlrover/python/common/storage.py — `CheckpointStorage`
+ABC (:24, write/read/listdir/commit), `PosixDiskStorage` (:128), deletion
+strategies (:189-258 `KeepLatestStepStrategy`, `KeepStepIntervalStrategy`).
+"""
+
+import os
+import shutil
+from typing import List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class CheckpointDeletionStrategy:
+    def clean_up(self, step: int, delete_func):
+        raise NotImplementedError
+
+
+class KeepLatestStepStrategy(CheckpointDeletionStrategy):
+    """Keep only the newest `max_to_keep` step directories."""
+
+    def __init__(self, max_to_keep: int = 3, checkpoint_dir: str = ""):
+        self.max_to_keep = max(1, max_to_keep)
+        self.checkpoint_dir = checkpoint_dir
+        self._steps: List[int] = []
+
+    def clean_up(self, step: int, delete_func):
+        if step in self._steps:
+            return
+        self._steps.append(step)
+        self._steps.sort()
+        while len(self._steps) > self.max_to_keep:
+            victim = self._steps.pop(0)
+            delete_func(os.path.join(self.checkpoint_dir, str(victim)))
+
+
+class KeepStepIntervalStrategy(CheckpointDeletionStrategy):
+    """Keep checkpoints whose step is a multiple of `keep_interval`."""
+
+    def __init__(self, keep_interval: int, checkpoint_dir: str = ""):
+        self.keep_interval = keep_interval
+        self.checkpoint_dir = checkpoint_dir
+
+    def clean_up(self, step: int, delete_func):
+        if step % self.keep_interval != 0:
+            delete_func(os.path.join(self.checkpoint_dir, str(step)))
+
+
+class CheckpointStorage:
+    """write/read/listdir/exists/commit — the agent saver and the trainer
+    engines only speak this interface, so GCS/other backends drop in."""
+
+    def write(self, content, path: str):
+        raise NotImplementedError
+
+    def read(self, path: str, mode: str = "rb"):
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def makedirs(self, path: str):
+        raise NotImplementedError
+
+    def delete(self, path: str):
+        raise NotImplementedError
+
+    def commit(self, step: int, success: bool):
+        """Hook called after a step's files are fully persisted."""
+
+
+class PosixDiskStorage(CheckpointStorage):
+    def __init__(
+        self,
+        deletion_strategy: Optional[CheckpointDeletionStrategy] = None,
+    ):
+        self.deletion_strategy = deletion_strategy
+
+    def write(self, content, path: str):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
+        tmp = path + ".tmp"
+        with open(tmp, mode) as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def read(self, path: str, mode: str = "rb"):
+        if not os.path.exists(path):
+            return None
+        with open(path, mode) as f:
+            return f.read()
+
+    def listdir(self, path: str) -> List[str]:
+        try:
+            return sorted(os.listdir(path))
+        except FileNotFoundError:
+            return []
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+    def commit(self, step: int, success: bool):
+        if success and self.deletion_strategy is not None:
+            self.deletion_strategy.clean_up(step, self.delete)
+
+
+def get_checkpoint_storage(
+    deletion_strategy=None,
+) -> CheckpointStorage:
+    return PosixDiskStorage(deletion_strategy)
