@@ -1,0 +1,114 @@
+"""Benchmark: steady-state training throughput of the flagship decoder.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Runs on whatever accelerator backend is live (the driver runs this on a
+real TPU chip). Model size targets one v5e chip (16 GB HBM): ~350 M
+params, bf16 compute, remat, flash attention. vs_baseline reports
+achieved MFU / 0.40 — the reference north-star is >=40 % MFU at scale
+(BASELINE.md), so 1.0 means parity with that target.
+"""
+
+import json
+import os
+import sys
+import time
+
+# peak bf16 TFLOP/s per chip by generation (public spec sheets)
+PEAK_TFLOPS = {
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v6e": 918.0,
+}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.accelerate import Strategy, accelerate
+    from dlrover_tpu.parallel.mesh import MeshSpec
+
+    n_dev = jax.local_device_count()
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000,
+            dim=1024,
+            n_layers=24,
+            n_heads=16,
+            n_kv_heads=16,
+            mlp_dim=4096,
+            max_seq_len=2048,
+            remat=True,
+            attn_impl="auto",
+        )
+        batch_size, seq_len = 8, 2048
+        warmup, iters = 3, 10
+    else:  # CPU smoke mode so the bench is runnable anywhere
+        cfg = llama.LlamaConfig.tiny()
+        batch_size, seq_len = 4, 64
+        warmup, iters = 1, 3
+
+    acc = accelerate(
+        init_params=lambda k: llama.init_params(cfg, k),
+        loss_fn=lambda p, b, m: llama.loss_fn(cfg, p, b, mesh=m),
+        rules=llama.partition_rules(cfg),
+        optimizer=optax.adamw(1e-4),
+        strategy=Strategy(mesh=MeshSpec.fit(n_dev)),
+    )
+    state = acc.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch_size, seq_len + 1), 0,
+        cfg.vocab_size,
+    )
+    batch = acc.shard_batch({"tokens": tokens})
+
+    for _ in range(warmup):
+        state, metrics = acc.train_step(state, batch)
+    jax.block_until_ready(state)
+
+    t0 = time.monotonic()
+    for _ in range(iters):
+        state, metrics = acc.train_step(state, batch)
+    jax.block_until_ready(state)
+    elapsed = time.monotonic() - t0
+
+    tokens_per_step = batch_size * seq_len
+    tok_per_sec = tokens_per_step * iters / elapsed
+    tok_per_sec_per_chip = tok_per_sec / n_dev
+
+    flops_per_tok = llama.flops_per_token(cfg, seq_len)
+    achieved_tflops = tok_per_sec_per_chip * flops_per_tok / 1e12
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = PEAK_TFLOPS.get(gen, PEAK_TFLOPS["v5e"])
+    mfu = achieved_tflops / peak if on_tpu else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "tokens_per_sec_per_chip",
+                "value": round(tok_per_sec_per_chip, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
+                "detail": {
+                    "model_params_m": round(
+                        llama.num_params(cfg) / 1e6, 1
+                    ),
+                    "mfu": round(mfu, 4),
+                    "backend": jax.default_backend(),
+                    "n_devices": n_dev,
+                    "step_ms": round(elapsed / iters * 1e3, 1),
+                    "loss": float(metrics["loss"]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Tiny-Llama memorization demo under the elastic launcher.
+
+The TPU analogue of the reference's examples/pytorch/mnist/cnn_train.py:
+a small model trained through the full stack — `dlrover-tpu-run` starts a
+local master + agent, the agent supervises this script, and this script
+trains a tiny Llama with `accelerate()` over all local devices, reporting
+steps so the master's SpeedMonitor sees progress.
+
+Flags:
+  --steps N          training steps (default 30)
+  --crash-at-step K  kill this process at step K on the FIRST attempt
+                     (restart-recovery demo; needs --max-restarts >= 1)
+  --ckpt-dir DIR     enable flash checkpointing: stage to agent shm every
+                     step, persist to DIR every 5 steps, resume on restart
+                     (the fcp_demo.py analogue)
+"""
+
+import argparse
+import os
+import sys
+
+from dlrover_tpu.utils.platform import ensure_cpu_if_forced
+
+ensure_cpu_if_forced()
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.agent.monitor import write_step_metrics
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.accelerate import Strategy, accelerate
+from dlrover_tpu.parallel.mesh import MeshSpec
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--crash-at-step", type=int, default=-1)
+    p.add_argument("--ckpt-dir", default=None)
+    args = p.parse_args()
+
+    restart_count = int(os.environ.get(NodeEnv.RESTART_COUNT, "0"))
+    cfg = llama.LlamaConfig.tiny()
+    acc = accelerate(
+        init_params=lambda k: llama.init_params(cfg, k),
+        loss_fn=lambda pm, b, m: llama.loss_fn(cfg, pm, b, mesh=m),
+        rules=llama.partition_rules(cfg),
+        optimizer=optax.adam(1e-2),
+        strategy=Strategy(mesh=MeshSpec.fit(jax.local_device_count())),
+    )
+    state = acc.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 33), 0, cfg.vocab_size
+    )
+    batch = acc.shard_batch({"tokens": tokens})
+
+    ckpt = None
+    start_step = 0
+    if args.ckpt_dir:
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            Checkpointer,
+            StorageType,
+        )
+
+        ckpt = Checkpointer(args.ckpt_dir)
+        saved_step, saved = ckpt.load_checkpoint(target=state)
+        if saved is not None:
+            state, start_step = saved, saved_step
+            print(f"resumed from step {start_step}", flush=True)
+
+    first_loss = last_loss = None
+    for step in range(start_step + 1, args.steps + 1):
+        if step == args.crash_at_step and restart_count == 0:
+            print(f"[demo] injected crash at step {step}", flush=True)
+            os._exit(17)
+        state, metrics = acc.train_step(state, batch)
+        loss = float(metrics["loss"])
+        if first_loss is None:
+            first_loss = loss
+        last_loss = loss
+        write_step_metrics(step)
+        if ckpt is not None:
+            kind = (
+                StorageType.DISK
+                if step % 5 == 0
+                else StorageType.MEMORY
+            )
+            blocked = ckpt.save_checkpoint(step, state, kind)
+            if step % 10 == 0:
+                print(
+                    f"ckpt step {step} staged in {blocked*1e3:.1f} ms",
+                    flush=True,
+                )
+        if step % 10 == 0 or step == 1:
+            print(f"step {step} loss {loss:.4f}", flush=True)
+
+    print(
+        f"done: restart_count={restart_count} "
+        f"first_loss={first_loss:.4f} last_loss={last_loss:.4f}",
+        flush=True,
+    )
+    if last_loss >= first_loss:
+        print("loss did not decrease", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
