@@ -43,6 +43,7 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32     # storage dtype
     remat: bool = True                 # checkpoint each layer in scan
     attn_impl: str = "auto"            # auto | flash | reference
+    seq_parallel: str = "none"         # none | ring | ulysses
     tie_embeddings: bool = False
 
     @property
@@ -198,9 +199,22 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
     v = constrain(v, mesh, ("data", "fsdp"), "seq", "tensor", None)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    attn = dot_product_attention(
-        q, k, v, causal=True, impl=cfg.attn_impl
+    sp_live = (
+        mesh is not None
+        and cfg.seq_parallel != "none"
+        and dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1)
+        > 1
     )
+    if sp_live:
+        from dlrover_tpu.parallel.sequence import sp_attention
+
+        attn = sp_attention(
+            q, k, v, mesh, mode=cfg.seq_parallel, causal=True
+        )
+    else:
+        attn = dot_product_attention(
+            q, k, v, causal=True, impl=cfg.attn_impl
+        )
     attn = attn.reshape(b, s, H * hd)
     x = x + constrain(
         attn @ lp["wo"], mesh, ("data", "fsdp"), "seq", None

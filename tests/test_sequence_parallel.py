@@ -1,0 +1,105 @@
+"""Sequence-parallel attention correctness vs full attention.
+
+Tier-2 tests (SURVEY.md §4): 8 virtual CPU devices; ring and Ulysses must
+match the dense reference in forward AND gradients (the backward ring is
+autodiff-derived, so this exercises the transposed collectives too).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.ops.attention import dot_product_attention
+from dlrover_tpu.parallel.mesh import MeshSpec
+from dlrover_tpu.parallel.sequence import sp_attention
+
+
+def _mk_qkv(key, b=2, s=32, h=4, kv=4, d=8, dtype=jnp.float32):
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, kv, d), dtype)
+    v = jax.random.normal(kv_, (b, s, kv, d), dtype)
+    return q, k, v
+
+
+def _mesh(seq=4, data=2):
+    return MeshSpec(data=data, seq=seq).build()
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_matches_reference(mode, causal):
+    mesh = _mesh()
+    q, k, v = _mk_qkv(jax.random.PRNGKey(0))
+    ref = dot_product_attention(q, k, v, causal=causal, impl="reference")
+    out = jax.jit(
+        lambda q, k, v: sp_attention(q, k, v, mesh, mode=mode, causal=causal)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_sp_gqa(mode):
+    """Grouped-query attention: fewer KV heads than Q heads."""
+    mesh = _mesh()
+    q, k, v = _mk_qkv(jax.random.PRNGKey(1), h=8, kv=2)
+    ref = dot_product_attention(q, k, v, causal=True, impl="reference")
+    out = jax.jit(
+        lambda q, k, v: sp_attention(q, k, v, mesh, mode=mode)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_sp_gradients(mode):
+    mesh = _mesh()
+    q, k, v = _mk_qkv(jax.random.PRNGKey(2))
+
+    def loss_sp(q, k, v):
+        return sp_attention(q, k, v, mesh, mode=mode).sum()
+
+    def loss_ref(q, k, v):
+        return dot_product_attention(
+            q, k, v, causal=True, impl="reference"
+        ).sum()
+
+    g_sp = jax.jit(jax.grad(loss_sp, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_sp, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_llama_with_ring_attention():
+    """End-to-end: tiny Llama with seq_parallel=ring on a seq=4 mesh
+    matches the same model without SP."""
+    from dlrover_tpu.models import llama
+
+    mesh = _mesh()
+    cfg0 = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    cfg1 = llama.LlamaConfig.tiny(dtype=jnp.float32, seq_parallel="ring")
+    params = llama.init_params(cfg0, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (2, 32), 0, cfg0.vocab_size
+    )
+    base = llama.apply(cfg0, params, tokens)
+    with jax.sharding.use_mesh(mesh) if hasattr(
+        jax.sharding, "use_mesh"
+    ) else _null():
+        sp = jax.jit(
+            lambda p, t: llama.apply(cfg1, p, t, mesh=mesh)
+        )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(sp), np.asarray(base), rtol=2e-3, atol=2e-3
+    )
+
+
+class _null:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
