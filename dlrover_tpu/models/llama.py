@@ -45,6 +45,20 @@ class LlamaConfig:
     attn_impl: str = "auto"            # auto | flash | reference
     seq_parallel: str = "none"         # none | ring | ulysses
     tie_embeddings: bool = False
+    # MoE (0 experts = dense MLP). Experts shard on the "expert" mesh axis.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+
+    @property
+    def moe(self):
+        from dlrover_tpu.models.moe import MoeConfig
+
+        return MoeConfig(
+            n_experts=self.n_experts,
+            top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+        )
 
     @property
     def head_dim(self) -> int:
@@ -102,7 +116,19 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
             jax.random.normal(key, shape, pd) / math.sqrt(fan_in)
         )
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
+    if cfg.n_experts > 0:
+        from dlrover_tpu.models.moe import init_moe_mlp
+
+        mlp_weights = init_moe_mlp(
+            ks[7], cfg.moe, D, M, n_layers=L, param_dtype=pd
+        )
+    else:
+        mlp_weights = {
+            "w_gate": dense_init(ks[4], (L, D, M), D),
+            "w_up": dense_init(ks[5], (L, D, M), D),
+            "w_down": dense_init(ks[6], (L, M, D), M),
+        }
     params = {
         "embed": {
             "weight": jax.random.normal(
@@ -116,9 +142,7 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Params:
             "wv": dense_init(ks[2], (L, D, KV * hd), D),
             "wo": dense_init(ks[3], (L, H * hd, D), H * hd),
             "mlp_norm": norm_init(L, D),
-            "w_gate": dense_init(ks[4], (L, D, M), D),
-            "w_up": dense_init(ks[5], (L, D, M), D),
-            "w_down": dense_init(ks[6], (L, M, D), M),
+            **mlp_weights,
         },
         "final_norm": {"scale": norm_init(D)},
     }
@@ -136,7 +160,12 @@ def partition_rules(cfg: LlamaConfig):
     output dim on "tensor"; row-parallel wo/w_down shard the input dim.
     FSDP shards the other dim; vocab sharded on tensor for embed/head.
     """
-    return [
+    moe_rules = []
+    if cfg.n_experts > 0:
+        from dlrover_tpu.models.moe import moe_partition_rules
+
+        moe_rules = moe_partition_rules()
+    return moe_rules + [
         (r"embed/weight", P("tensor", "fsdp")),
         (r"layers/wq", P(None, "fsdp", "tensor")),
         (r"layers/wk", P(None, "fsdp", "tensor")),
@@ -221,6 +250,19 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
     )
 
     h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
+    if cfg.n_experts > 0:
+        from dlrover_tpu.models.moe import moe_mlp
+
+        ff_out, moe_metrics = moe_mlp(
+            cfg.moe,
+            {k: layer_params[k]
+             for k in ("router", "we_gate", "we_up", "we_down")},
+            h,
+            mesh=mesh,
+            compute_dtype=cfg.dtype,
+        )
+        x = x + constrain(ff_out, mesh, ("data", "fsdp"), "seq", None)
+        return x, moe_metrics["moe_aux_loss"]
     gate = jax.nn.silu(h @ lp["w_gate"])
     up = h @ lp["w_up"]
     ff = constrain(
@@ -229,7 +271,7 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
     x = x + constrain(
         ff @ lp["w_down"], mesh, ("data", "fsdp"), "seq", None
     )
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def apply(
@@ -238,8 +280,10 @@ def apply(
     tokens: jax.Array,
     mesh=None,
     positions: Optional[jax.Array] = None,
+    return_aux: bool = False,
 ) -> jax.Array:
-    """Forward pass: tokens [B, S] int32 → logits [B, S, vocab] f32."""
+    """Forward pass: tokens [B, S] int32 → logits [B, S, vocab] f32.
+    With return_aux, also returns the summed per-layer MoE aux loss."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
@@ -248,14 +292,14 @@ def apply(
     x = constrain(x, mesh, ("data", "fsdp"), "seq", None)
 
     def body(carry, layer_params):
-        y = _layer(cfg, mesh, carry, layer_params, positions)
-        return y, None
+        y, aux = _layer(cfg, mesh, carry, layer_params, positions)
+        return y, aux
 
     if cfg.remat:
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable
         )
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
 
     x = _rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     if cfg.tie_embeddings:
@@ -263,7 +307,10 @@ def apply(
     else:
         head = params["lm_head"]["weight"].astype(cfg.dtype)
     logits = (x @ head).astype(jnp.float32)
-    return constrain(logits, mesh, ("data", "fsdp"), "seq", "tensor")
+    logits = constrain(logits, mesh, ("data", "fsdp"), "seq", "tensor")
+    if return_aux:
+        return logits, jnp.sum(aux_per_layer)
+    return logits
 
 
 def loss_fn(
@@ -274,7 +321,9 @@ def loss_fn(
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross entropy. batch: tokens [B,S], optional loss_mask."""
     tokens = batch["tokens"]
-    logits = apply(cfg, params, tokens[:, :-1], mesh=mesh)
+    logits, aux = apply(
+        cfg, params, tokens[:, :-1], mesh=mesh, return_aux=True
+    )
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(
@@ -289,15 +338,23 @@ def loss_fn(
     else:
         loss = nll.mean()
         weight = jnp.asarray(nll.size, jnp.float32)
+    metrics = {"loss": loss, "loss_weight": weight}
+    if cfg.n_experts > 0:
+        loss = loss + aux
+        metrics["moe_aux_loss"] = aux
     # loss_weight lets grad-accum weight microbatches by token count
-    return loss, {"loss": loss, "loss_weight": weight}
+    return loss, metrics
 
 
 def num_params(cfg: LlamaConfig) -> int:
     L, D, M, V = cfg.n_layers, cfg.dim, cfg.mlp_dim, cfg.vocab_size
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    if cfg.n_experts > 0:
+        mlp = cfg.n_experts * 3 * D * M + D * cfg.n_experts
+    else:
+        mlp = 3 * D * M
     per_layer = (
-        D * H * hd + 2 * D * KV * hd + H * hd * D + 3 * D * M + 2 * D
+        D * H * hd + 2 * D * KV * hd + H * hd * D + mlp + 2 * D
     )
     total = V * D + L * per_layer + D
     if not cfg.tie_embeddings:

@@ -1,0 +1,173 @@
+"""Mixture-of-Experts layer with expert parallelism, TPU-first.
+
+Reference parity (SURVEY.md §2.5): ATorch's MoE stack — `MOELayer` with
+all-to-all dispatch (atorch/atorch/modules/moe/moe_layer.py:87 `_AllToAll`),
+expert process groups (moe_layer.py:29 `set_experts_process_group`),
+switch/top-k gating (switch_gating.py), grouped-GEMM experts
+(grouped_gemm_moe.py).
+
+TPU design: the torch dispatch/all-to-all machinery collapses into two
+einsums against one-hot dispatch/combine tensors (the GShard formulation).
+Expert weights carry a leading E axis sharded on the mesh's "expert" axis;
+GSPMD turns the dispatch einsum into the all-to-all. Grouped GEMM is what
+the MXU does natively with the [E, ...] batched einsum — no custom kernel
+needed. Capacity-bounded top-k gating with Switch-style load-balancing
+aux loss and router z-loss.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dlrover_tpu.parallel.sharding import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    normalize_topk: bool = True      # Mixtral-style renorm of top-k gates
+    aux_loss_weight: float = 0.01    # Switch load-balance loss
+    z_loss_weight: float = 1e-3      # router logit z-loss
+
+
+def capacity(cfg: MoeConfig, seq: int) -> int:
+    c = int(math.ceil(cfg.top_k * seq * cfg.capacity_factor / cfg.n_experts))
+    return max(c, cfg.min_capacity)
+
+
+def top_k_gating(
+    cfg: MoeConfig,
+    router_logits: jax.Array,   # [B, S, E] f32
+    cap: int,
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """GShard-style capacity-bounded top-k routing.
+
+    Returns (dispatch [B,S,E,C] bool-ish f32, combine [B,S,E,C] f32,
+    aux metrics incl. weighted aux_loss ready to add to the train loss).
+    """
+    b, s, e = router_logits.shape
+    logits32 = router_logits.astype(jnp.float32)
+    gates = jax.nn.softmax(logits32, axis=-1)  # [B,S,E]
+
+    remaining = gates
+    masks = []
+    gate_vals = []
+    for _ in range(cfg.top_k):
+        idx = jnp.argmax(remaining, axis=-1)            # [B,S]
+        mask = jax.nn.one_hot(idx, e, dtype=jnp.float32)
+        gate_vals.append(jnp.sum(gates * mask, axis=-1))  # [B,S]
+        masks.append(mask)
+        remaining = remaining * (1.0 - mask)
+
+    if cfg.normalize_topk:
+        denom = jnp.maximum(sum(gate_vals), 1e-9)
+        gate_vals = [g / denom for g in gate_vals]
+
+    # position-in-expert: priority order = selection order, earlier
+    # tokens first (cumsum over S), overflow dropped
+    dispatch = jnp.zeros((b, s, e, cap), jnp.float32)
+    combine = jnp.zeros((b, s, e, cap), jnp.float32)
+    pos_offset = jnp.zeros((b, 1, e), jnp.float32)
+    for mask, gv in zip(masks, gate_vals):
+        pos = jnp.cumsum(mask, axis=1) - 1.0 + pos_offset  # [B,S,E]
+        pos_offset = pos_offset + jnp.sum(mask, axis=1, keepdims=True)
+        keep = mask * (pos < cap)
+        pos_i = jnp.where(keep > 0, pos, 0).astype(jnp.int32)
+        oh = jax.nn.one_hot(pos_i, cap, dtype=jnp.float32) * keep[..., None]
+        dispatch = dispatch + oh                      # [B,S,E,C]
+        combine = combine + oh * gv[:, :, None, None]
+
+    # Switch aux loss: E * Σ_e (token_frac_e · prob_frac_e)
+    me = jnp.mean(gates, axis=(0, 1))                          # [E]
+    ce = jnp.mean(masks[0], axis=(0, 1))                       # [E]
+    aux = cfg.n_experts * jnp.sum(me * ce)
+    z = jnp.mean(jax.scipy.special.logsumexp(logits32, axis=-1) ** 2)
+    aux_loss = cfg.aux_loss_weight * aux + cfg.z_loss_weight * z
+    dropped = 1.0 - jnp.sum(dispatch) / (b * s * cfg.top_k)
+    metrics = {
+        "moe_aux_loss": aux_loss,
+        "moe_balance": aux,
+        "moe_dropped_frac": dropped,
+    }
+    return dispatch, combine, metrics
+
+
+def init_moe_mlp(
+    key: jax.Array,
+    cfg: MoeConfig,
+    dim: int,
+    mlp_dim: int,
+    n_layers: Optional[int] = None,
+    param_dtype=jnp.float32,
+) -> Dict[str, jax.Array]:
+    """Expert-stacked SwiGLU weights (leading [L?, E] axes)."""
+    lead = (cfg.n_experts,) if n_layers is None else (n_layers, cfg.n_experts)
+    rlead = () if n_layers is None else (n_layers,)
+    ks = jax.random.split(key, 4)
+
+    def dense(k, shape, fan_in):
+        return jax.random.normal(k, shape, param_dtype) / math.sqrt(fan_in)
+
+    return {
+        "router": dense(ks[0], rlead + (dim, cfg.n_experts), dim),
+        "we_gate": dense(ks[1], lead + (dim, mlp_dim), dim),
+        "we_up": dense(ks[2], lead + (dim, mlp_dim), dim),
+        "we_down": dense(ks[3], lead + (mlp_dim, dim), mlp_dim),
+    }
+
+
+def moe_partition_rules():
+    """Rules for the expert weights: experts on the "expert" mesh axis,
+    TP/FSDP on the matmul dims (leading L axis from the scan stack)."""
+    return [
+        (r"router$", P()),
+        (r"we_gate", P(None, "expert", "fsdp", "tensor")),
+        (r"we_up", P(None, "expert", "fsdp", "tensor")),
+        (r"we_down", P(None, "expert", "tensor", "fsdp")),
+    ]
+
+
+def moe_mlp(
+    cfg: MoeConfig,
+    params: Dict[str, jax.Array],   # router [D,E], we_* [E,D,M]/[E,M,D]
+    x: jax.Array,                   # [B, S, D]
+    mesh=None,
+    compute_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Expert-parallel SwiGLU MoE block.
+
+    dispatch einsum → [E, B, C, D] (GSPMD all-to-all over "expert"),
+    batched expert GEMMs on the MXU, combine einsum back to [B, S, D].
+    """
+    b, s, d = x.shape
+    cap = capacity(cfg, s)
+    router_logits = (
+        x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    )
+    dispatch, combine, metrics = top_k_gating(cfg, router_logits, cap)
+
+    xd = x.astype(compute_dtype)
+    disp = dispatch.astype(compute_dtype)
+    expert_in = jnp.einsum("bsec,bsd->ebcd", disp, xd)
+    expert_in = constrain(
+        expert_in, mesh, "expert", ("data", "fsdp"), None, None
+    )
+    wg = params["we_gate"].astype(compute_dtype)
+    wu = params["we_up"].astype(compute_dtype)
+    wd = params["we_down"].astype(compute_dtype)
+    h = jax.nn.silu(jnp.einsum("ebcd,edm->ebcm", expert_in, wg))
+    h = h * jnp.einsum("ebcd,edm->ebcm", expert_in, wu)
+    h = constrain(h, mesh, "expert", ("data", "fsdp"), None, "tensor")
+    out = jnp.einsum("ebcm,emd->ebcd", h, wd)
+    out = constrain(
+        out, mesh, "expert", ("data", "fsdp"), None, None
+    )
+    y = jnp.einsum("bsec,ebcd->bsd", combine.astype(compute_dtype), out)
+    return y.astype(x.dtype), metrics
