@@ -49,6 +49,9 @@ class LlamaConfig:
     n_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # GPipe microbatch count when the mesh has a live "pipe" axis
+    # (0 → default to the pipe degree)
+    pipeline_microbatches: int = 0
 
     @property
     def moe(self):
@@ -167,14 +170,14 @@ def partition_rules(cfg: LlamaConfig):
         moe_rules = moe_partition_rules()
     return moe_rules + [
         (r"embed/weight", P("tensor", "fsdp")),
-        (r"layers/wq", P(None, "fsdp", "tensor")),
-        (r"layers/wk", P(None, "fsdp", "tensor")),
-        (r"layers/wv", P(None, "fsdp", "tensor")),
-        (r"layers/wo", P(None, "tensor", "fsdp")),
-        (r"layers/w_gate", P(None, "fsdp", "tensor")),
-        (r"layers/w_up", P(None, "fsdp", "tensor")),
-        (r"layers/w_down", P(None, "tensor", "fsdp")),
-        (r"layers/(attn|mlp)_norm", P(None, None)),
+        (r"layers/wq", P("pipe", "fsdp", "tensor")),
+        (r"layers/wk", P("pipe", "fsdp", "tensor")),
+        (r"layers/wv", P("pipe", "fsdp", "tensor")),
+        (r"layers/wo", P("pipe", "tensor", "fsdp")),
+        (r"layers/w_gate", P("pipe", "fsdp", "tensor")),
+        (r"layers/w_up", P("pipe", "fsdp", "tensor")),
+        (r"layers/w_down", P("pipe", "tensor", "fsdp")),
+        (r"layers/(attn|mlp)_norm", P("pipe", None)),
         (r"final_norm/scale", P(None)),
         (r"lm_head/weight", P("fsdp", "tensor")),
     ]
@@ -291,15 +294,46 @@ def apply(
     x = params["embed"]["weight"].astype(cfg.dtype)[tokens]
     x = constrain(x, mesh, ("data", "fsdp"), "seq", None)
 
-    def body(carry, layer_params):
-        y, aux = _layer(cfg, mesh, carry, layer_params, positions)
-        return y, aux
+    from dlrover_tpu.parallel.pipeline import num_stages, pipeline_apply
 
-    if cfg.remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable
+    n_stages = num_stages(mesh) if mesh is not None else 1
+    if n_stages > 1:
+        # GPipe over the pipe axis; positions ride in the state tree so
+        # they split into microbatches alongside the activations
+        if cfg.n_layers % n_stages:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} not divisible by pipe degree "
+                f"{n_stages}"
+            )
+        n_mb = cfg.pipeline_microbatches or n_stages
+
+        def layer_fn(lp, st, _unused=None):
+            y, aux = _layer(cfg, mesh, st["h"], lp, st["pos"])
+            return {"h": y, "pos": st["pos"], "aux": st["aux"] + aux}
+
+        state = pipeline_apply(
+            layer_fn,
+            mesh,
+            params["layers"],
+            {
+                "h": x,
+                "pos": positions,
+                "aux": jnp.zeros((b,), jnp.float32),
+            },
+            n_microbatches=n_mb,
         )
-    x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
+        x = state["h"]
+        aux_per_layer = jnp.mean(state["aux"])[None]
+    else:
+        def body(carry, layer_params):
+            y, aux = _layer(cfg, mesh, carry, layer_params, positions)
+            return y, aux
+
+        if cfg.remat:
+            body = jax.checkpoint(
+                body, policy=jax.checkpoint_policies.nothing_saveable
+            )
+        x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
 
     x = _rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
     if cfg.tie_embeddings:

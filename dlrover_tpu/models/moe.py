@@ -127,10 +127,10 @@ def moe_partition_rules():
     """Rules for the expert weights: experts on the "expert" mesh axis,
     TP/FSDP on the matmul dims (leading L axis from the scan stack)."""
     return [
-        (r"router$", P()),
-        (r"we_gate", P(None, "expert", "fsdp", "tensor")),
-        (r"we_up", P(None, "expert", "fsdp", "tensor")),
-        (r"we_down", P(None, "expert", "tensor", "fsdp")),
+        (r"router$", P("pipe")),
+        (r"we_gate", P("pipe", "expert", "fsdp", "tensor")),
+        (r"we_up", P("pipe", "expert", "fsdp", "tensor")),
+        (r"we_down", P("pipe", "expert", "tensor", "fsdp")),
     ]
 
 
