@@ -1,0 +1,13 @@
+from dlrover_tpu.optim.agd import agd
+from dlrover_tpu.optim.wsam import sam_gradient, wsam
+from dlrover_tpu.optim.low_precision import bf16_adam
+from dlrover_tpu.optim.mup import mup_learning_rates, mup_scale_init
+
+__all__ = [
+    "agd",
+    "wsam",
+    "sam_gradient",
+    "bf16_adam",
+    "mup_learning_rates",
+    "mup_scale_init",
+]

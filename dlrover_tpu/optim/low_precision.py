@@ -1,0 +1,93 @@
+"""Low-precision optimizer states.
+
+Reference parity: atorch BF16Optimizer (atorch/optimizers/
+bf16_optimizer.py:46) keeps bf16 params with an f32 master copy; low-bit
+optimizers quantize moments. On TPU the idiomatic split is: params stay
+f32 (the model casts to bf16 for MXU compute), while the OPTIMIZER
+MOMENTS — the largest non-param state — are stored in bf16, halving
+optimizer HBM at negligible quality cost for the first moment and with
+stochastic-rounding-free second moment kept in f32 by default.
+"""
+
+from typing import NamedTuple, Optional
+
+import chex
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class Bf16AdamState(NamedTuple):
+    count: chex.Array
+    mu: optax.Updates    # bf16
+    nu: optax.Updates    # f32 (or bf16 if nu_dtype set)
+
+
+def scale_by_adam_low_precision(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    mu_dtype=jnp.bfloat16,
+    nu_dtype=jnp.float32,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        return Bf16AdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=mu_dtype), params
+            ),
+            nu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=nu_dtype), params
+            ),
+        )
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+        # accumulate in f32, store back in the compact dtype
+        mu = jax.tree_util.tree_map(
+            lambda m, g: (
+                b1 * m.astype(jnp.float32)
+                + (1 - b1) * g.astype(jnp.float32)
+            ).astype(mu_dtype),
+            state.mu,
+            updates,
+        )
+        nu = jax.tree_util.tree_map(
+            lambda v, g: (
+                b2 * v.astype(jnp.float32)
+                + (1 - b2) * jnp.square(g.astype(jnp.float32))
+            ).astype(nu_dtype),
+            state.nu,
+            updates,
+        )
+        c = count.astype(jnp.float32)
+        new_updates = jax.tree_util.tree_map(
+            lambda m, v: (
+                (m.astype(jnp.float32) / (1 - b1 ** c))
+                / (
+                    jnp.sqrt(v.astype(jnp.float32) / (1 - b2 ** c))
+                    + eps
+                )
+            ),
+            mu,
+            nu,
+        )
+        return new_updates, Bf16AdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def bf16_adam(
+    learning_rate: optax.ScalarOrSchedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mask: Optional[optax.Params] = None,
+) -> optax.GradientTransformation:
+    """AdamW with bf16 first moment (half the mu HBM)."""
+    tx = [scale_by_adam_low_precision(b1=b1, b2=b2, eps=eps)]
+    if weight_decay:
+        tx.append(optax.add_decayed_weights(weight_decay, mask))
+    tx.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*tx)

@@ -1,0 +1,98 @@
+"""muP — maximal update parametrization for width scaling.
+
+Reference parity: atorch mup (atorch/atorch/mup/infshape.py,
+module.py — `InfShape`, `MupLinear`). Instead of shape-annotated torch
+modules, the TPU version expresses muP as two pure functions over the
+param pytree keyed by path regex:
+
+- `mup_scale_init`: rescale a standard init — matrix-like (inf x inf)
+  weights get std ∝ 1/sqrt(width_mult) relative to base, output layers
+  1/width_mult.
+- `mup_learning_rates`: per-leaf lr multipliers (1/width_mult for
+  matrix-like weights under Adam-family optimizers), consumed via
+  `optax.masked`-free scaling (we scale the updates tree directly).
+
+width_mult = dim / base_dim. Vector-like params (norms, biases, embed)
+keep multiplier 1.
+"""
+
+import re
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from dlrover_tpu.parallel.sharding import path_str
+
+# (path_regex, kind): kind ∈ {"matrix", "output", "vector"}
+MupRules = Sequence[Tuple[str, str]]
+
+DEFAULT_LLAMA_MUP_RULES: MupRules = (
+    (r"lm_head", "output"),
+    (r"layers/(wq|wk|wv|wo|w_gate|w_up|w_down|we_gate|we_up|we_down)",
+     "matrix"),
+    (r"router", "matrix"),
+    (r"embed|_norm|scale", "vector"),
+)
+
+
+def _kind_for(path: str, rules: MupRules) -> str:
+    for pat, kind in rules:
+        if re.search(pat, path):
+            return kind
+    return "vector"
+
+
+def mup_scale_init(
+    params: Any,
+    width_mult: float,
+    rules: MupRules = DEFAULT_LLAMA_MUP_RULES,
+) -> Any:
+    """Rescale an SP (standard-parametrization) init to muP."""
+
+    def leaf(path, p):
+        kind = _kind_for(path_str(path), rules)
+        if kind == "output":
+            return p / width_mult
+        if kind == "matrix":
+            return p  # fan-in init already gives 1/sqrt(width) scaling
+        return p
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def mup_learning_rates(
+    params: Any,
+    width_mult: float,
+    rules: MupRules = DEFAULT_LLAMA_MUP_RULES,
+) -> Any:
+    """Per-leaf lr multiplier tree (Adam-family muP: matrix/output
+    weights learn at base_lr / width_mult)."""
+
+    def leaf(path, p):
+        kind = _kind_for(path_str(path), rules)
+        if kind in ("matrix", "output"):
+            return 1.0 / width_mult
+        return 1.0
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def scale_updates_by_mup(
+    lr_tree: Any,
+) -> optax.GradientTransformation:
+    """optax transform applying the per-leaf muP lr multipliers."""
+
+    def init_fn(params):
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        return (
+            jax.tree_util.tree_map(
+                lambda u, s: u * s, updates, lr_tree
+            ),
+            state,
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,123 @@
+"""Optimizer library tests: AGD, WSAM, bf16 Adam, muP."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.optim import (
+    agd,
+    bf16_adam,
+    mup_learning_rates,
+    sam_gradient,
+    wsam,
+)
+from dlrover_tpu.optim.mup import scale_updates_by_mup
+
+
+def _rosenbrock(p):
+    x, y = p["x"], p["y"]
+    return jnp.sum((1 - x) ** 2 + 100.0 * (y - x * x) ** 2)
+
+
+def _quadratic(p):
+    return jnp.sum(p["w"] ** 2) + jnp.sum((p["b"] - 1.0) ** 2)
+
+
+def _minimize(opt, loss, params, steps=300):
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        g = jax.grad(loss)(params)
+        updates, state = opt.update(g, state, params)
+        return optax.apply_updates(params, updates), state
+
+    for _ in range(steps):
+        params, state = step(params, state)
+    return params, float(loss(params))
+
+
+class TestAGD:
+    def test_converges_quadratic(self):
+        params = {"w": jnp.array([3.0, -2.0]), "b": jnp.array([0.0])}
+        params, final = _minimize(agd(5e-2), _quadratic, params)
+        assert final < 1e-4, final
+
+    def test_weight_decay_path(self):
+        params = {"w": jnp.ones(4), "b": jnp.zeros(2)}
+        opt = agd(1e-2, weight_decay=0.1)
+        state = opt.init(params)
+        g = jax.grad(_quadratic)(params)
+        updates, _ = opt.update(g, state, params)
+        assert all(
+            np.isfinite(np.asarray(u)).all()
+            for u in jax.tree_util.tree_leaves(updates)
+        )
+
+
+class TestWSAM:
+    def test_wsam_reduces_loss(self):
+        params = {"w": jnp.array([2.0]), "b": jnp.array([2.0])}
+        grad_fn = wsam(_quadratic, rho=0.05, gamma=0.5)
+        opt = optax.sgd(5e-2)
+        state = opt.init(params)
+        losses = []
+        for _ in range(100):
+            value, g = grad_fn(params)
+            losses.append(float(value))
+            updates, state = opt.update(g, state, params)
+            params = optax.apply_updates(params, updates)
+        assert losses[-1] < 1e-3 * losses[0]
+
+    def test_sam_gradient_differs_from_plain(self):
+        params = {"x": jnp.array([1.5]), "y": jnp.array([0.0])}
+        g_plain = jax.grad(_rosenbrock)(params)
+        g_sam = sam_gradient(_rosenbrock, params, rho=0.1)
+        diff = max(
+            float(jnp.abs(a - b).max())
+            for a, b in zip(
+                jax.tree_util.tree_leaves(g_plain),
+                jax.tree_util.tree_leaves(g_sam),
+            )
+        )
+        assert diff > 1e-4
+
+
+class TestBf16Adam:
+    def test_state_dtypes_and_convergence(self):
+        params = {"w": jnp.ones(8), "b": jnp.zeros(3)}
+        opt = bf16_adam(5e-2)
+        state = opt.init(params)
+        mu = state[0].mu
+        assert all(
+            leaf.dtype == jnp.bfloat16
+            for leaf in jax.tree_util.tree_leaves(mu)
+        )
+        params, final = _minimize(opt, _quadratic, params, steps=400)
+        assert final < 1e-3, final
+
+
+class TestMup:
+    def test_lr_multipliers_by_kind(self):
+        params = {
+            "layers": {"wq": jnp.zeros((2, 4, 4)),
+                       "attn_norm": jnp.zeros((2, 4))},
+            "embed": {"weight": jnp.zeros((8, 4))},
+            "lm_head": {"weight": jnp.zeros((4, 8))},
+        }
+        lrs = mup_learning_rates(params, width_mult=4.0)
+        assert lrs["layers"]["wq"] == 0.25
+        assert lrs["layers"]["attn_norm"] == 1.0
+        assert lrs["embed"]["weight"] == 1.0
+        assert lrs["lm_head"]["weight"] == 0.25
+
+    def test_scale_updates_transform(self):
+        params = {"a": jnp.ones(2), "b": jnp.ones(2)}
+        lr_tree = {"a": 0.5, "b": 1.0}
+        tx = scale_updates_by_mup(lr_tree)
+        updates, _ = tx.update(
+            {"a": jnp.ones(2), "b": jnp.ones(2)}, tx.init(params)
+        )
+        np.testing.assert_allclose(np.asarray(updates["a"]), 0.5)
+        np.testing.assert_allclose(np.asarray(updates["b"]), 1.0)
