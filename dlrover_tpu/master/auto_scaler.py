@@ -1,0 +1,136 @@
+"""Job auto-scaler: periodic optimize → ScalePlan → Scaler.
+
+Reference parity: `JobAutoScaler` (dlrover/python/master/node/
+job_auto_scaler.py:73) — `PSTrainingAutoScaler` :115 /
+`AllreduceTrainingAutoScaler` :275: a periodic thread pulls runtime
+stats, asks the optimizer for a plan, executes it; plus immediate paths
+for OOM recovery and pending-node timeout reduction.
+
+TPU notes: scaling changes the SPMD world, so executing a worker-count
+plan also bumps the rendezvous round (agents re-join, jax re-inits over
+the new mesh) — the scaler only moves pods; the rendezvous manager owns
+re-formation.
+"""
+
+import threading
+import time
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import NodeGroupResource
+from dlrover_tpu.master.resource import ResourceOptimizer
+from dlrover_tpu.master.scaler import ScalePlan, Scaler
+
+
+class JobAutoScaler:
+    def __init__(
+        self,
+        job_args,
+        node_manager,
+        speed_monitor,
+        scaler: Scaler,
+        optimizer: Optional[ResourceOptimizer] = None,
+        interval: float = 300.0,
+        pending_timeout: float = 900.0,
+        batch_size_per_worker: int = 0,
+    ):
+        self._job_args = job_args
+        self._nodes = node_manager
+        self._speed = speed_monitor
+        self._scaler = scaler
+        self._optimizer = optimizer or ResourceOptimizer()
+        self._interval = interval
+        self._pending_timeout = pending_timeout
+        self._batch = batch_size_per_worker
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.executed_plans = 0
+
+    # ---- lifecycle ----
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="auto-scaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.optimize_once()
+            except Exception as e:  # keep the scaler thread alive
+                logger.warning("auto-scale iteration failed: %s", e)
+
+    # ---- scaling paths ----
+    def _worker_group(self) -> NodeGroupResource:
+        return self._job_args.node_groups.get(
+            NodeType.WORKER, NodeGroupResource(count=0)
+        )
+
+    def optimize_once(self) -> ScalePlan:
+        """Periodic running-stage optimization."""
+        running = len(self._nodes.running_nodes(NodeType.WORKER))
+        speed = self._speed.running_speed
+        if callable(speed):  # property on some impls
+            speed = speed()
+        if running > 0 and speed > 0:
+            samples = speed * (self._batch or 1) * running
+            self._optimizer.observe(running, samples)
+        plan = self._optimizer.plan_for_running(
+            running, self._worker_group()
+        )
+        self.execute(plan)
+        return plan
+
+    def handle_oom(self, node) -> ScalePlan:
+        """Immediate OOM path: replan the group with more memory and
+        relaunch the node under the new resource."""
+        group = self._worker_group()
+        plan = self._optimizer.plan_for_oom(node.type, group)
+        new_group = plan.node_group_resources[node.type]
+        relaunch = node.get_relaunch_node_id(
+            self._nodes.next_node_id(node.type)
+        )
+        relaunch.config_resource = new_group.node_resource
+        plan.launch_nodes.append(relaunch)
+        # remember the bumped resource for future launches
+        self._job_args.node_groups[node.type] = new_group
+        self.execute(plan)
+        return plan
+
+    def reduce_timeout_pending_nodes(self) -> ScalePlan:
+        """Pending-node timeout: give up on nodes stuck unschedulable and
+        shrink the job to what is actually running (reference
+        _reduce_timeout_pending_node)."""
+        plan = ScalePlan()
+        now = time.time()
+        for node in self._nodes.get_nodes(NodeType.WORKER):
+            if node.status != NodeStatus.PENDING:
+                continue
+            created = node.create_time or now
+            if now - created > self._pending_timeout:
+                logger.info(
+                    "node %s pending > %ss: removing", node.name,
+                    self._pending_timeout,
+                )
+                plan.remove_nodes.append(node)
+        if plan.remove_nodes:
+            group = self._worker_group()
+            remaining = group.count - len(plan.remove_nodes)
+            plan.node_group_resources[NodeType.WORKER] = (
+                NodeGroupResource(
+                    count=max(1, remaining),
+                    node_resource=group.node_resource,
+                )
+            )
+        self.execute(plan)
+        return plan
+
+    def execute(self, plan: ScalePlan):
+        if plan.empty():
+            return
+        self.executed_plans += 1
+        self._scaler.scale(plan)

@@ -1,0 +1,215 @@
+"""Master-side diagnosis: pluggable inference chain over collected data.
+
+Reference parity: `DiagnosisManager` (dlrover/python/master/diagnosis/
+diagnosis.py:31), `InferenceChain.infer` (inferencechain/
+inference_chain.py:38), `CheckTrainingHangOperator` (operator/
+check_training_hang_operator.py), agent-side collectors
+(elastic_agent/monitor/diagnosis.py, datacollector/*).
+
+Model: observations are (name, payload) facts; operators map a problem
+hypothesis to a conclusion with a confidence; the chain walks operators
+until one resolves. TPU specifics: SPMD means one slow/hung host stalls
+the global step, so hang attribution relies on per-host heartbeats +
+step reports rather than per-rank NCCL timeouts.
+"""
+
+import abc
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class DiagnosisDataType:
+    TRAINING_LOG = "training_log"
+    CHIP_METRICS = "chip_metrics"
+    STEP_REPORT = "step_report"
+    HEARTBEAT = "heartbeat"
+
+
+@dataclasses.dataclass
+class DiagnosisData:
+    data_type: str
+    node_id: int
+    ts: float
+    payload: Any = None
+
+
+@dataclasses.dataclass
+class Inference:
+    """A hypothesis or conclusion: 'training' 'is' 'hung' because ..."""
+
+    subject: str
+    predicate: str
+    state: str
+    evidence: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def key(self):
+        return (self.subject, self.predicate, self.state)
+
+
+class InferenceOperator(abc.ABC):
+    @abc.abstractmethod
+    def is_compatible(self, problem: Inference) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def infer(self, problem: Inference) -> List[Inference]:
+        ...
+
+
+class DataManager:
+    """Rolling store of reported diagnosis data (per node, per type)."""
+
+    def __init__(self, ttl: float = 600.0):
+        self._ttl = ttl
+        self._data: Dict[str, List[DiagnosisData]] = {}
+
+    def report(self, data: DiagnosisData):
+        self._data.setdefault(data.data_type, []).append(data)
+        self._gc(data.data_type)
+
+    def _gc(self, data_type: str):
+        cutoff = time.time() - self._ttl
+        rows = self._data.get(data_type, [])
+        self._data[data_type] = [d for d in rows if d.ts >= cutoff]
+
+    def get(self, data_type: str) -> List[DiagnosisData]:
+        return list(self._data.get(data_type, []))
+
+
+class CheckTrainingHangOperator(InferenceOperator):
+    """Training is hung if every running node's last step report is older
+    than `hang_timeout` while heartbeats still arrive (the processes are
+    alive but the step is stuck — an ICI/compile/deadlock signature)."""
+
+    def __init__(self, data_mgr: DataManager, hang_timeout: float = 300.0):
+        self._data = data_mgr
+        self._timeout = hang_timeout
+
+    def is_compatible(self, problem: Inference) -> bool:
+        return problem.key() == ("training", "is", "hung?")
+
+    def infer(self, problem: Inference) -> List[Inference]:
+        now = time.time()
+        steps = self._data.get(DiagnosisDataType.STEP_REPORT)
+        beats = self._data.get(DiagnosisDataType.HEARTBEAT)
+        if not steps:
+            return [Inference("training", "is", "unknown")]
+        last_step_ts = max(d.ts for d in steps)
+        alive = {
+            d.node_id for d in beats if now - d.ts < self._timeout / 2
+        }
+        if now - last_step_ts > self._timeout and alive:
+            stuck = sorted(
+                {d.node_id for d in steps}
+            )
+            return [
+                Inference(
+                    "training", "is", "hung",
+                    evidence={
+                        "last_step_age": now - last_step_ts,
+                        "alive_nodes": sorted(alive),
+                        "reporting_nodes": stuck,
+                    },
+                )
+            ]
+        return [Inference("training", "is", "healthy")]
+
+
+class CheckFailureNodeOperator(InferenceOperator):
+    """A node is failed if its training log window contains fatal
+    markers (reference check_failure_node_operator; XLA/TPU fatal
+    signatures replace CUDA ones)."""
+
+    FATAL_MARKERS = (
+        "RESOURCE_EXHAUSTED",
+        "Hbm OOM",
+        "device halted",
+        "XLA compilation failure",
+        "Fatal Python error",
+        "core dumped",
+    )
+
+    def __init__(self, data_mgr: DataManager):
+        self._data = data_mgr
+
+    def is_compatible(self, problem: Inference) -> bool:
+        return problem.key() == ("node", "is", "failed?")
+
+    def infer(self, problem: Inference) -> List[Inference]:
+        out = []
+        for d in self._data.get(DiagnosisDataType.TRAINING_LOG):
+            text = str(d.payload or "")
+            hits = [m for m in self.FATAL_MARKERS if m in text]
+            if hits:
+                out.append(
+                    Inference(
+                        "node", "is", "failed",
+                        evidence={"node_id": d.node_id, "markers": hits},
+                    )
+                )
+        return out or [Inference("node", "is", "healthy")]
+
+
+class InferenceChain:
+    """Walk operators compatible with the problem; first non-empty
+    conclusion wins (reference inference_chain.py:38)."""
+
+    def __init__(self, operators: List[InferenceOperator]):
+        self._operators = operators
+
+    def infer(self, problem: Inference) -> List[Inference]:
+        for op in self._operators:
+            if not op.is_compatible(problem):
+                continue
+            try:
+                results = op.infer(problem)
+            except Exception as e:
+                logger.warning("diagnosis operator failed: %s", e)
+                continue
+            if results:
+                return results
+        return [Inference(problem.subject, "is", "unknown")]
+
+
+class DiagnosisManager:
+    """Owns the data store + periodic checks; the master polls
+    `diagnose()` from its run loop."""
+
+    def __init__(self, hang_timeout: float = 300.0):
+        self.data = DataManager()
+        self._chain = InferenceChain(
+            [
+                CheckTrainingHangOperator(self.data, hang_timeout),
+                CheckFailureNodeOperator(self.data),
+            ]
+        )
+
+    def report(
+        self, data_type: str, node_id: int, payload: Any = None,
+        ts: Optional[float] = None,
+    ):
+        self.data.report(
+            DiagnosisData(
+                data_type=data_type,
+                node_id=node_id,
+                ts=ts if ts is not None else time.time(),
+                payload=payload,
+            )
+        )
+
+    def diagnose(self) -> List[Inference]:
+        results = []
+        for problem in (
+            Inference("training", "is", "hung?"),
+            Inference("node", "is", "failed?"),
+        ):
+            results.extend(self._chain.infer(problem))
+        return results
+
+    def is_training_hung(self) -> bool:
+        return any(
+            r.key() == ("training", "is", "hung") for r in self.diagnose()
+        )

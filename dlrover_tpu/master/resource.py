@@ -1,0 +1,131 @@
+"""Resource plans + heuristic optimizer (the local Brain).
+
+Reference parity: `ResourcePlan`/`ResourceOptimizer` ABC
+(dlrover/python/master/resource/optimizer.py:48,:134),
+`PSLocalOptimizer` (resource/local_optimizer.py:66) generating stage
+plans (create/init/running/OOM), `AllreduceJobResourceOptimizer`
+(resource/job.py:517), quota check (master/cluster/quota.py:18).
+
+TPU translation: the unit of scaling is a whole TPU host (chips come in
+fixed slices), so plans move worker COUNT and memory, not fractional
+CPU. Heuristics:
+- OOM stage: bump memory by a factor (reference local_optimizer OOM path)
+- running stage: if throughput per host degraded vs baseline as workers
+  were added, suggest shrinking back to the best-known world size;
+  if scaling has been linear and free quota exists, suggest growing.
+"""
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+from dlrover_tpu.master.scaler import ScalePlan
+
+OOM_MEMORY_FACTOR = 1.5  # reference: NodeResourceLimits/oom factor
+
+
+@dataclasses.dataclass
+class JobOptimizeStat:
+    """One throughput observation at a given world size."""
+
+    num_workers: int
+    samples_per_sec: float
+    ts: float
+
+
+class QuotaChecker:
+    """Free-resource gate before scale-up (reference quota.py:18)."""
+
+    def __init__(self, max_workers: Optional[int] = None):
+        self.max_workers = max_workers
+
+    def allow_worker_count(self, count: int) -> int:
+        if self.max_workers is None:
+            return count
+        return min(count, self.max_workers)
+
+
+class ResourceOptimizer:
+    """Heuristic job-resource optimizer over SpeedMonitor stats."""
+
+    def __init__(
+        self,
+        min_workers: int = 1,
+        max_workers: int = 32,
+        quota: Optional[QuotaChecker] = None,
+        degrade_threshold: float = 0.85,
+    ):
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.quota = quota or QuotaChecker(max_workers)
+        self.degrade_threshold = degrade_threshold
+        self._history: List[JobOptimizeStat] = []
+
+    def observe(self, num_workers: int, samples_per_sec: float):
+        self._history.append(
+            JobOptimizeStat(num_workers, samples_per_sec, time.time())
+        )
+
+    def _best_stat(self) -> Optional[JobOptimizeStat]:
+        """Observation with the best per-host goodput (scaling quality,
+        not raw throughput — more hosts always raises the total)."""
+        if not self._history:
+            return None
+        return max(
+            self._history,
+            key=lambda s: s.samples_per_sec / max(s.num_workers, 1),
+        )
+
+    def plan_for_oom(
+        self, role: str, group: NodeGroupResource
+    ) -> ScalePlan:
+        """OOM: grow per-node memory (whole-host TPU scaling can't grow
+        HBM — this grows host RAM for input pipeline/ckpt staging)."""
+        new_res = NodeResource(
+            cpu=group.node_resource.cpu,
+            memory_mb=int(
+                max(group.node_resource.memory_mb, 1024) * OOM_MEMORY_FACTOR
+            ),
+            chips=group.node_resource.chips,
+            chip_type=group.node_resource.chip_type,
+        )
+        plan = ScalePlan()
+        plan.node_group_resources[role] = NodeGroupResource(
+            count=group.count, node_resource=new_res
+        )
+        return plan
+
+    def plan_for_running(
+        self, current_workers: int, group: NodeGroupResource
+    ) -> ScalePlan:
+        """Throughput-driven world-size suggestion."""
+        plan = ScalePlan()
+        if len(self._history) < 2:
+            return plan
+        latest = self._history[-1]
+        best = self._best_stat()
+        per_host_latest = latest.samples_per_sec / max(
+            latest.num_workers, 1
+        )
+        per_host_best = best.samples_per_sec / max(best.num_workers, 1)
+        target = current_workers
+        if (
+            latest.num_workers > best.num_workers
+            and per_host_latest < per_host_best * self.degrade_threshold
+        ):
+            # scaling hurt per-host goodput: fall back to the best size
+            target = best.num_workers
+        elif per_host_latest >= per_host_best * self.degrade_threshold:
+            target = current_workers * 2
+        target = max(self.min_workers, min(target, self.max_workers))
+        target = self.quota.allow_worker_count(target)
+        if target != current_workers:
+            plan.node_group_resources[NodeType.WORKER] = (
+                NodeGroupResource(
+                    count=target, node_resource=group.node_resource
+                )
+            )
+        return plan

@@ -1,0 +1,147 @@
+"""Node watchers: platform events → NodeEvents for the job manager.
+
+Reference parity: `PodWatcher` (dlrover/python/master/watcher/
+k8s_watcher.py:194) streams pod events and maps phases/exit codes to
+NodeStatus + exit reason; `K8sScalePlanWatcher` :272 feeds operator-side
+scale plans back. The local watcher mirrors scaler actions for dev mode.
+"""
+
+import abc
+import threading
+import time
+from typing import Callable, List, Optional
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeResource
+
+# k8s pod phase → NodeStatus (reference k8s_watcher.py _convert_pod_event)
+_PHASE_TO_STATUS = {
+    "Pending": NodeStatus.PENDING,
+    "Running": NodeStatus.RUNNING,
+    "Succeeded": NodeStatus.SUCCEEDED,
+    "Failed": NodeStatus.FAILED,
+    "Unknown": NodeStatus.UNKNOWN,
+}
+
+# OOMKilled exit code per k8s convention
+_OOM_EXIT_CODE = 137
+
+
+class WatchEvent:
+    def __init__(self, event_type: str, node: Node):
+        self.event_type = event_type
+        self.node = node
+
+
+class NodeWatcher(abc.ABC):
+    @abc.abstractmethod
+    def poll(self) -> List[WatchEvent]:
+        """Drain pending platform events."""
+
+    def list(self) -> List[Node]:
+        return []
+
+
+def pod_to_node(pod: dict) -> Node:
+    labels = pod.get("metadata", {}).get("labels", {})
+    status = pod.get("status", {})
+    phase = status.get("phase", "Unknown")
+    node = Node(
+        node_type=labels.get("node-type", "worker"),
+        node_id=int(labels.get("node-id", 0)),
+        rank_index=int(labels.get("rank-index", 0)),
+        name=pod.get("metadata", {}).get("name", ""),
+        status=_PHASE_TO_STATUS.get(phase, NodeStatus.UNKNOWN),
+    )
+    if node.status == NodeStatus.FAILED:
+        reason = str(status.get("reason", ""))
+        exit_code = _terminated_exit_code(pod)
+        if exit_code == _OOM_EXIT_CODE or reason == "OOMKilled":
+            node.exit_reason = NodeExitReason.OOM
+        elif reason in ("NodeLost", "Evicted", "Shutdown"):
+            # host preempted/lost → relaunch somewhere else
+            node.exit_reason = NodeExitReason.HARDWARE_ERROR
+        else:
+            node.exit_reason = NodeExitReason.FATAL_ERROR
+    return node
+
+
+def _terminated_exit_code(pod: dict) -> Optional[int]:
+    for cs in pod.get("status", {}).get("containerStatuses", []):
+        term = cs.get("state", {}).get("terminated")
+        if term:
+            return int(term.get("exitCode", 0))
+    return None
+
+
+class K8sPodWatcher(NodeWatcher):
+    """Poll-based pod watcher (list + diff; the REST adapter has no
+    websocket watch). The job manager polls every few seconds, same
+    cadence the reference uses for its event resync."""
+
+    def __init__(self, job_args, k8s_client):
+        self._job_args = job_args
+        self._k8s = k8s_client
+        self._last: dict = {}
+
+    def poll(self) -> List[WatchEvent]:
+        events: List[WatchEvent] = []
+        current = {}
+        try:
+            pods = self._k8s.list_pods(
+                label_selector=f"app={self._job_args.job_name}"
+            )
+        except Exception as e:
+            logger.warning("pod list failed: %s", e)
+            return events
+        for pod in pods:
+            node = pod_to_node(pod)
+            current[node.name] = node
+            prev = self._last.get(node.name)
+            if prev is None:
+                events.append(WatchEvent(NodeEventType.ADDED, node))
+            elif prev.status != node.status:
+                events.append(WatchEvent(NodeEventType.MODIFIED, node))
+        for name, node in self._last.items():
+            if name not in current:
+                node.status = NodeStatus.DELETED
+                events.append(WatchEvent(NodeEventType.DELETED, node))
+        self._last = current
+        return events
+
+    def list(self) -> List[Node]:
+        return [
+            pod_to_node(p)
+            for p in self._k8s.list_pods(
+                label_selector=f"app={self._job_args.job_name}"
+            )
+        ]
+
+
+class LocalWatcher(NodeWatcher):
+    """Dev-mode watcher: surfaces LocalScaler launches/removals as
+    events; process liveness is the agent's concern locally."""
+
+    def __init__(self, scaler):
+        self._scaler = scaler
+        self._seen_launched = 0
+        self._seen_removed = 0
+
+    def poll(self) -> List[WatchEvent]:
+        events = []
+        launched = self._scaler.launched[self._seen_launched:]
+        self._seen_launched += len(launched)
+        for node in launched:
+            node.update_status(NodeStatus.PENDING)
+            events.append(WatchEvent(NodeEventType.ADDED, node))
+        removed = self._scaler.removed[self._seen_removed:]
+        self._seen_removed += len(removed)
+        for node in removed:
+            node.update_status(NodeStatus.DELETED)
+            events.append(WatchEvent(NodeEventType.DELETED, node))
+        return events

@@ -1,0 +1,72 @@
+"""Job description consumed by the master — platform-independent.
+
+Reference parity: `JobArgs` (dlrover/python/scheduler/job.py) carries the
+per-role node-group resources, distribution strategy, and platform; the
+scheduler factory (scheduler/factory.py) picks the platform adapter.
+"""
+
+import dataclasses
+from typing import Dict, Optional
+
+from dlrover_tpu.common.constants import DistributionStrategy, NodeType
+from dlrover_tpu.common.node import NodeGroupResource, NodeResource
+
+
+@dataclasses.dataclass
+class JobArgs:
+    job_name: str = "dlrover-tpu-job"
+    namespace: str = "default"
+    platform: str = "local"          # local | k8s
+    distribution_strategy: str = DistributionStrategy.SPMD
+    # per-role groups: worker / ps / chief / evaluator
+    node_groups: Dict[str, NodeGroupResource] = dataclasses.field(
+        default_factory=dict
+    )
+    relaunch_on_worker_failure: int = 3
+    cancel_at_first_worker_fail: bool = False
+
+    @classmethod
+    def simple(
+        cls,
+        num_workers: int,
+        cpu: float = 0,
+        memory_mb: int = 0,
+        tpu_chips: int = 0,
+        **kw,
+    ) -> "JobArgs":
+        return cls(
+            node_groups={
+                NodeType.WORKER: NodeGroupResource(
+                    count=num_workers,
+                    node_resource=NodeResource(
+                        cpu=cpu, memory_mb=memory_mb, chips=tpu_chips
+                    ),
+                )
+            },
+            **kw,
+        )
+
+
+class PlatformFactory:
+    """Pick (scaler, watcher) for the platform (reference
+    scheduler/factory.py)."""
+
+    @staticmethod
+    def build(job_args: JobArgs, node_manager=None, k8s_client=None):
+        if job_args.platform == "local":
+            from dlrover_tpu.master.scaler import LocalScaler
+            from dlrover_tpu.master.watcher import LocalWatcher
+
+            scaler = LocalScaler(job_args)
+            watcher = LocalWatcher(scaler)
+            return scaler, watcher
+        if job_args.platform == "k8s":
+            from dlrover_tpu.master.scaler import PodScaler
+            from dlrover_tpu.master.watcher import K8sPodWatcher
+            from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+            client = k8s_client or K8sClient.from_env(job_args.namespace)
+            scaler = PodScaler(job_args, client)
+            watcher = K8sPodWatcher(job_args, client)
+            return scaler, watcher
+        raise ValueError(f"unknown platform {job_args.platform}")

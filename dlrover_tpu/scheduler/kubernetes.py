@@ -1,0 +1,181 @@
+"""Minimal Kubernetes REST adapter (no external k8s client dependency).
+
+Reference parity: `k8sClient` singleton (dlrover/python/scheduler/
+kubernetes.py:122) wraps the official client for pod/service/CRD CRUD.
+This image has no kubernetes package, so the adapter speaks the REST API
+directly over `requests` using in-cluster credentials
+(/var/run/secrets/kubernetes.io/serviceaccount). All calls go through an
+injectable `transport` so tests swap in a fake (the reference mocks its
+k8s client the same way — tests/test_utils.py:283 mock_k8s_client).
+"""
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class K8sTransport:
+    """requests-backed transport; one method so fakes are trivial."""
+
+    def __init__(self, base_url: str, token: str, verify):
+        self._base = base_url.rstrip("/")
+        self._headers = {
+            "Authorization": f"Bearer {token}",
+            "Content-Type": "application/json",
+        }
+        self._verify = verify
+
+    def request(
+        self, method: str, path: str, body: Optional[Dict] = None,
+        params: Optional[Dict] = None,
+    ) -> Dict:
+        import requests
+
+        resp = requests.request(
+            method,
+            self._base + path,
+            headers=self._headers,
+            json=body,
+            params=params,
+            verify=self._verify,
+            timeout=30,
+        )
+        if resp.status_code >= 300:
+            raise RuntimeError(
+                f"k8s {method} {path} -> {resp.status_code}: "
+                f"{resp.text[:500]}"
+            )
+        return resp.json() if resp.text else {}
+
+
+class K8sClient:
+    """Pod/CRD CRUD through one transport hook."""
+
+    def __init__(self, namespace: str, transport):
+        self.namespace = namespace
+        self._t = transport
+
+    @classmethod
+    def from_env(cls, namespace: str = "default") -> "K8sClient":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError(
+                "not in a k8s cluster (KUBERNETES_SERVICE_HOST unset); "
+                "pass an explicit transport for out-of-cluster use"
+            )
+        with open(os.path.join(SA_DIR, "token")) as f:
+            token = f.read().strip()
+        ca = os.path.join(SA_DIR, "ca.crt")
+        ns_file = os.path.join(SA_DIR, "namespace")
+        if namespace == "default" and os.path.exists(ns_file):
+            with open(ns_file) as f:
+                namespace = f.read().strip()
+        return cls(
+            namespace,
+            K8sTransport(
+                f"https://{host}:{port}", token,
+                ca if os.path.exists(ca) else False,
+            ),
+        )
+
+    # ---- pods ----
+    def create_pod(self, manifest: Dict) -> Dict:
+        return self._t.request(
+            "POST", f"/api/v1/namespaces/{self.namespace}/pods", manifest
+        )
+
+    def delete_pod(self, name: str) -> Dict:
+        return self._t.request(
+            "DELETE", f"/api/v1/namespaces/{self.namespace}/pods/{name}"
+        )
+
+    def get_pod(self, name: str) -> Dict:
+        return self._t.request(
+            "GET", f"/api/v1/namespaces/{self.namespace}/pods/{name}"
+        )
+
+    def list_pods(self, label_selector: str = "") -> List[Dict]:
+        params = (
+            {"labelSelector": label_selector} if label_selector else None
+        )
+        out = self._t.request(
+            "GET", f"/api/v1/namespaces/{self.namespace}/pods",
+            params=params,
+        )
+        return out.get("items", [])
+
+    # ---- services ----
+    def create_service(self, manifest: Dict) -> Dict:
+        return self._t.request(
+            "POST",
+            f"/api/v1/namespaces/{self.namespace}/services",
+            manifest,
+        )
+
+    # ---- custom resources (ElasticJob / ScalePlan equivalents) ----
+    def create_custom(
+        self, group: str, version: str, plural: str, manifest: Dict
+    ) -> Dict:
+        return self._t.request(
+            "POST",
+            f"/apis/{group}/{version}/namespaces/{self.namespace}/"
+            f"{plural}",
+            manifest,
+        )
+
+    def patch_custom_status(
+        self, group: str, version: str, plural: str, name: str,
+        status: Dict,
+    ) -> Dict:
+        return self._t.request(
+            "PATCH",
+            f"/apis/{group}/{version}/namespaces/{self.namespace}/"
+            f"{plural}/{name}/status",
+            {"status": status},
+        )
+
+
+class FakeK8sClient(K8sClient):
+    """In-memory fake for tier-1 tests (reference mock_k8s_client)."""
+
+    def __init__(self, namespace: str = "default"):
+        super().__init__(namespace, transport=None)
+        self.pods: Dict[str, Dict] = {}
+        self.services: Dict[str, Dict] = {}
+        self.customs: List[Dict] = []
+        self.deleted: List[str] = []
+
+    def create_pod(self, manifest):
+        name = manifest["metadata"]["name"]
+        manifest.setdefault("status", {"phase": "Pending"})
+        self.pods[name] = manifest
+        return manifest
+
+    def delete_pod(self, name):
+        self.deleted.append(name)
+        return self.pods.pop(name, {})
+
+    def get_pod(self, name):
+        if name not in self.pods:
+            raise RuntimeError(f"k8s GET pod {name} -> 404")
+        return self.pods[name]
+
+    def list_pods(self, label_selector: str = ""):
+        return list(self.pods.values())
+
+    def create_service(self, manifest):
+        self.services[manifest["metadata"]["name"]] = manifest
+        return manifest
+
+    def create_custom(self, group, version, plural, manifest):
+        self.customs.append(manifest)
+        return manifest
+
+    def set_pod_phase(self, name: str, phase: str, reason: str = ""):
+        pod = self.pods[name]
+        pod["status"] = {"phase": phase, "reason": reason}

@@ -1,0 +1,242 @@
+"""Auto-scaling stack tests: scalers, watcher, optimizer, auto-scaler,
+diagnosis — tier 1 with the fake k8s client (reference test strategy:
+mocked k8s client, real logic)."""
+
+import time
+
+import pytest
+
+from dlrover_tpu.common.constants import (
+    NodeEventType,
+    NodeExitReason,
+    NodeStatus,
+    NodeType,
+)
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.auto_scaler import JobAutoScaler
+from dlrover_tpu.master.diagnosis import (
+    DiagnosisDataType,
+    DiagnosisManager,
+)
+from dlrover_tpu.master.node_manager import JobNodeManager
+from dlrover_tpu.master.monitor.speed_monitor import SpeedMonitor
+from dlrover_tpu.master.resource import QuotaChecker, ResourceOptimizer
+from dlrover_tpu.master.scaler import (
+    ElasticJobScaler,
+    LocalScaler,
+    PodScaler,
+    ScalePlan,
+)
+from dlrover_tpu.master.watcher import K8sPodWatcher, pod_to_node
+from dlrover_tpu.scheduler.job import JobArgs, PlatformFactory
+from dlrover_tpu.scheduler.kubernetes import FakeK8sClient
+
+
+def _args(n=2) -> JobArgs:
+    return JobArgs.simple(
+        num_workers=n, cpu=4, memory_mb=2048, tpu_chips=4,
+        job_name="tj",
+    )
+
+
+class TestPodScaler:
+    def test_launch_and_remove(self):
+        k8s = FakeK8sClient()
+        scaler = PodScaler(_args(), k8s)
+        n0 = Node("worker", 0, config_resource=NodeResource(chips=4))
+        n1 = Node("worker", 1, config_resource=NodeResource(chips=4))
+        plan = ScalePlan(launch_nodes=[n0, n1])
+        scaler.scale(plan)
+        assert set(k8s.pods) == {"tj-worker-0", "tj-worker-1"}
+        limits = k8s.pods["tj-worker-0"]["spec"]["containers"][0][
+            "resources"]["limits"]
+        assert limits["google.com/tpu"] == "4"
+        assert "tj-worker-0" in k8s.services
+
+        scaler.scale(ScalePlan(remove_nodes=[n1]))
+        assert set(k8s.pods) == {"tj-worker-0"}
+        assert k8s.deleted == ["tj-worker-1"]
+
+    def test_declarative_group_fill(self):
+        k8s = FakeK8sClient()
+        scaler = PodScaler(_args(), k8s)
+        plan = ScalePlan()
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=3, node_resource=NodeResource(chips=4)
+        )
+        scaler.scale(plan)
+        assert len(k8s.pods) == 3
+
+
+class TestElasticJobScaler:
+    def test_writes_scaleplan_cr(self):
+        k8s = FakeK8sClient()
+        scaler = ElasticJobScaler(_args(), k8s)
+        plan = ScalePlan()
+        plan.node_group_resources[NodeType.WORKER] = NodeGroupResource(
+            count=4, node_resource=NodeResource(chips=4, memory_mb=1024)
+        )
+        scaler.scale(plan)
+        assert len(k8s.customs) == 1
+        cr = k8s.customs[0]
+        assert cr["kind"] == "ScalePlan"
+        spec = cr["spec"]["replicaResourceSpecs"]["worker"]
+        assert spec["replicas"] == 4
+
+
+class TestWatcher:
+    def test_pod_event_mapping(self):
+        pod = {
+            "metadata": {
+                "name": "tj-worker-0",
+                "labels": {"node-type": "worker", "node-id": "0",
+                           "rank-index": "0"},
+            },
+            "status": {
+                "phase": "Failed",
+                "reason": "OOMKilled",
+            },
+        }
+        node = pod_to_node(pod)
+        assert node.status == NodeStatus.FAILED
+        assert node.exit_reason == NodeExitReason.OOM
+
+    def test_poll_diff(self):
+        k8s = FakeK8sClient()
+        args = _args()
+        scaler = PodScaler(args, k8s)
+        watcher = K8sPodWatcher(args, k8s)
+        n0 = Node("worker", 0)
+        scaler.scale(ScalePlan(launch_nodes=[n0]))
+        events = watcher.poll()
+        assert [e.event_type for e in events] == [NodeEventType.ADDED]
+        k8s.set_pod_phase("tj-worker-0", "Running")
+        events = watcher.poll()
+        assert [e.event_type for e in events] == [NodeEventType.MODIFIED]
+        assert events[0].node.status == NodeStatus.RUNNING
+        k8s.delete_pod("tj-worker-0")
+        events = watcher.poll()
+        assert [e.event_type for e in events] == [NodeEventType.DELETED]
+
+
+class TestResourceOptimizer:
+    def test_oom_plan_bumps_memory(self):
+        opt = ResourceOptimizer()
+        group = NodeGroupResource(
+            count=2, node_resource=NodeResource(memory_mb=2048)
+        )
+        plan = opt.plan_for_oom("worker", group)
+        assert (
+            plan.node_group_resources["worker"].node_resource.memory_mb
+            == 3072
+        )
+
+    def test_scaleup_when_linear(self):
+        opt = ResourceOptimizer(max_workers=8)
+        group = NodeGroupResource(
+            count=2, node_resource=NodeResource(chips=4)
+        )
+        opt.observe(2, 200.0)   # 100/host
+        opt.observe(4, 390.0)   # ~98/host: still linear
+        plan = opt.plan_for_running(4, group)
+        assert plan.node_group_resources[NodeType.WORKER].count == 8
+
+    def test_fallback_when_degraded(self):
+        opt = ResourceOptimizer(max_workers=16)
+        group = NodeGroupResource(count=8)
+        opt.observe(4, 400.0)   # 100/host
+        opt.observe(8, 480.0)   # 60/host: degraded
+        plan = opt.plan_for_running(8, group)
+        assert plan.node_group_resources[NodeType.WORKER].count == 4
+
+    def test_quota_caps_scaleup(self):
+        opt = ResourceOptimizer(
+            max_workers=32, quota=QuotaChecker(max_workers=6)
+        )
+        group = NodeGroupResource(count=4)
+        opt.observe(2, 200.0)
+        opt.observe(4, 400.0)
+        plan = opt.plan_for_running(4, group)
+        assert plan.node_group_resources[NodeType.WORKER].count == 6
+
+
+class TestAutoScaler:
+    def _mk(self):
+        args = _args(2)
+        nodes = JobNodeManager()
+        speed = SpeedMonitor()
+        scaler = LocalScaler(args)
+        auto = JobAutoScaler(
+            args, nodes, speed, scaler,
+            optimizer=ResourceOptimizer(max_workers=8),
+            pending_timeout=0.1,
+        )
+        return args, nodes, speed, scaler, auto
+
+    def test_oom_recovery_launches_bigger_node(self):
+        args, nodes, speed, scaler, auto = self._mk()
+        bad = Node("worker", 0,
+                   config_resource=NodeResource(memory_mb=2048))
+        bad.update_status(NodeStatus.FAILED)
+        bad.exit_reason = NodeExitReason.OOM
+        nodes.add_node(bad)
+        auto.handle_oom(bad)
+        assert len(scaler.launched) == 1
+        relaunched = scaler.launched[0]
+        assert relaunched.config_resource.memory_mb == 3072
+        # job args remember the bumped size for future launches
+        assert (
+            args.node_groups["worker"].node_resource.memory_mb == 3072
+        )
+
+    def test_pending_timeout_shrinks_job(self):
+        args, nodes, speed, scaler, auto = self._mk()
+        stuck = Node("worker", 1)
+        stuck.update_status(NodeStatus.PENDING)
+        stuck.create_time = time.time() - 10
+        nodes.add_node(stuck)
+        plan = auto.reduce_timeout_pending_nodes()
+        assert stuck in plan.remove_nodes
+        assert scaler.removed == [stuck]
+
+
+class TestPlatformFactory:
+    def test_local(self):
+        scaler, watcher = PlatformFactory.build(_args())
+        assert isinstance(scaler, LocalScaler)
+
+    def test_k8s_with_injected_client(self):
+        args = _args()
+        args.platform = "k8s"
+        scaler, watcher = PlatformFactory.build(
+            args, k8s_client=FakeK8sClient()
+        )
+        assert isinstance(scaler, PodScaler)
+        assert isinstance(watcher, K8sPodWatcher)
+
+
+class TestDiagnosis:
+    def test_hang_detection(self):
+        dm = DiagnosisManager(hang_timeout=1.0)
+        now = time.time()
+        # old step reports, fresh heartbeats → hung
+        dm.report(DiagnosisDataType.STEP_REPORT, 0, 100, ts=now - 10)
+        dm.report(DiagnosisDataType.HEARTBEAT, 0, ts=now)
+        assert dm.is_training_hung()
+
+    def test_healthy_when_steps_fresh(self):
+        dm = DiagnosisManager(hang_timeout=5.0)
+        now = time.time()
+        dm.report(DiagnosisDataType.STEP_REPORT, 0, 100, ts=now)
+        dm.report(DiagnosisDataType.HEARTBEAT, 0, ts=now)
+        assert not dm.is_training_hung()
+
+    def test_failure_node_markers(self):
+        dm = DiagnosisManager()
+        dm.report(
+            DiagnosisDataType.TRAINING_LOG, 3,
+            "...jaxlib RESOURCE_EXHAUSTED: Hbm OOM while allocating...",
+        )
+        results = dm.diagnose()
+        failed = [r for r in results if r.state == "failed"]
+        assert failed and failed[0].evidence["node_id"] == 3
