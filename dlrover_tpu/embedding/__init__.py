@@ -1,0 +1,4 @@
+from dlrover_tpu.embedding.kv_store import KvEmbeddingTable
+from dlrover_tpu.embedding.layer import KvEmbeddingLayer
+
+__all__ = ["KvEmbeddingTable", "KvEmbeddingLayer"]

@@ -1,0 +1,223 @@
+"""ctypes bindings for the C++ KvEmbedding store (built on demand).
+
+Reference parity: the Python surface of TFPlus KvVariable
+(tfplus/kv_variable/python/ops/kv_variable_ops.py — gather/
+gather_or_insert/gather_or_zeros, scatter ops, import/export V1-V3,
+eviction, frequency tracking) re-exposed over a dependency-free C ABI
+(pybind11 is not in this image; SURVEY.md §2.6).
+
+The .so is compiled from dlrover_tpu/native/kv_embedding.cc with g++ the
+first time it's needed and cached next to the source.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_SRC = os.path.join(_NATIVE_DIR, "kv_embedding.cc")
+_SO = os.path.join(_NATIVE_DIR, "libkv_embedding.so")
+_BUILD_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _build_so() -> str:
+    with _BUILD_LOCK:
+        if os.path.exists(_SO) and (
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        ):
+            return _SO
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", _SO, _SRC,
+        ]
+        logger.info("building kv_embedding native lib: %s", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=True)
+        return _SO
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    lib = ctypes.CDLL(_build_so())
+    i64 = ctypes.c_int64
+    u64 = ctypes.c_uint64
+    u32 = ctypes.c_uint32
+    f32 = ctypes.c_float
+    p = ctypes.c_void_p
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+
+    lib.kv_create.restype = p
+    lib.kv_create.argtypes = [i64, ctypes.c_int, u64, f32]
+    lib.kv_free.argtypes = [p]
+    lib.kv_size.restype = i64
+    lib.kv_size.argtypes = [p]
+    lib.kv_dim.restype = i64
+    lib.kv_dim.argtypes = [p]
+    lib.kv_version.restype = u64
+    lib.kv_version.argtypes = [p]
+    lib.kv_lookup.argtypes = [p, i64p, i64, f32p, ctypes.c_int]
+    lib.kv_scatter_add.argtypes = [p, i64p, i64, f32p, f32]
+    lib.kv_apply_sgd.argtypes = [p, i64p, i64, f32p, f32]
+    lib.kv_apply_adagrad.argtypes = [p, i64p, i64, f32p, f32, f32]
+    lib.kv_apply_adam.argtypes = [
+        p, i64p, i64, f32p, f32, f32, f32, f32, i64, f32, f32,
+    ]
+    lib.kv_evict.restype = i64
+    lib.kv_evict.argtypes = [p, u32, ctypes.c_double]
+    lib.kv_export_count.restype = i64
+    lib.kv_export_count.argtypes = [p, u64]
+    lib.kv_export_rows.restype = i64
+    lib.kv_export_rows.argtypes = [p, u64, i64p, f32p, i64]
+    lib.kv_import_rows.argtypes = [p, i64p, f32p, i64]
+    _LIB = lib
+    return lib
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class KvEmbeddingTable:
+    """Dynamic hashtable embedding table (host DRAM, C++ core)."""
+
+    def __init__(
+        self,
+        dim: int,
+        initializer: str = "zeros",   # zeros | normal
+        init_scale: float = 0.01,
+        seed: int = 0,
+    ):
+        self._lib = _lib()
+        self.dim = int(dim)
+        mode = 1 if initializer == "normal" else 0
+        self._h = self._lib.kv_create(
+            self.dim, mode, seed, ctypes.c_float(init_scale)
+        )
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.kv_free(h)
+            self._h = None
+
+    def __len__(self) -> int:
+        return int(self._lib.kv_size(self._h))
+
+    @property
+    def version(self) -> int:
+        return int(self._lib.kv_version(self._h))
+
+    def _keys(self, keys) -> np.ndarray:
+        return np.ascontiguousarray(np.asarray(keys), dtype=np.int64).ravel()
+
+    def lookup(self, keys, insert_missing: bool = True) -> np.ndarray:
+        """Gather rows [n, dim]; missing keys insert (GatherOrInsert) or
+        read as zeros (GatherOrZeros)."""
+        k = self._keys(keys)
+        out = np.empty((k.size, self.dim), np.float32)
+        self._lib.kv_lookup(
+            self._h, _i64p(k), k.size, _f32p(out),
+            1 if insert_missing else 0,
+        )
+        return out.reshape(*np.shape(keys), self.dim)
+
+    def scatter_add(self, keys, values, alpha: float = 1.0):
+        k = self._keys(keys)
+        v = np.ascontiguousarray(values, np.float32).reshape(
+            k.size, self.dim
+        )
+        self._lib.kv_scatter_add(
+            self._h, _i64p(k), k.size, _f32p(v), ctypes.c_float(alpha)
+        )
+
+    def apply_sgd(self, keys, grads, lr: float):
+        k = self._keys(keys)
+        g = np.ascontiguousarray(grads, np.float32).reshape(
+            k.size, self.dim
+        )
+        self._lib.kv_apply_sgd(
+            self._h, _i64p(k), k.size, _f32p(g), ctypes.c_float(lr)
+        )
+
+    def apply_adagrad(self, keys, grads, lr: float, eps: float = 1e-10):
+        k = self._keys(keys)
+        g = np.ascontiguousarray(grads, np.float32).reshape(
+            k.size, self.dim
+        )
+        self._lib.kv_apply_adagrad(
+            self._h, _i64p(k), k.size, _f32p(g),
+            ctypes.c_float(lr), ctypes.c_float(eps),
+        )
+
+    def apply_adam(
+        self, keys, grads, lr: float, step: int,
+        b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+        l1: float = 0.0, l2: float = 0.0,
+    ):
+        """Sparse Adam; l1/l2 > 0 gives the reference's Group Adam
+        (sparse group lasso on embedding rows)."""
+        k = self._keys(keys)
+        g = np.ascontiguousarray(grads, np.float32).reshape(
+            k.size, self.dim
+        )
+        self._lib.kv_apply_adam(
+            self._h, _i64p(k), k.size, _f32p(g),
+            ctypes.c_float(lr), ctypes.c_float(b1), ctypes.c_float(b2),
+            ctypes.c_float(eps), step, ctypes.c_float(l1),
+            ctypes.c_float(l2),
+        )
+
+    def evict(self, min_freq: int = 0, max_idle_sec: float = 0.0) -> int:
+        """Drop cold (freq < min_freq) or idle rows; returns count."""
+        return int(
+            self._lib.kv_evict(
+                self._h, min_freq, ctypes.c_double(max_idle_sec)
+            )
+        )
+
+    def export(
+        self, since_version: int = 0
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Full (since_version=0) or delta export → (keys, values).
+        Delta export backs incremental model delivery (reference
+        ImportV3/ExportV3)."""
+        n = int(self._lib.kv_export_count(self._h, since_version))
+        keys = np.empty(n, np.int64)
+        vals = np.empty((n, self.dim), np.float32)
+        got = int(
+            self._lib.kv_export_rows(
+                self._h, since_version, _i64p(keys), _f32p(vals), n
+            )
+        )
+        return keys[:got], vals[:got]
+
+    def import_(self, keys, values):
+        k = self._keys(keys)
+        v = np.ascontiguousarray(values, np.float32).reshape(
+            k.size, self.dim
+        )
+        self._lib.kv_import_rows(self._h, _i64p(k), _f32p(v), k.size)
+
+    # ---- checkpoint integration ----
+    def state_dict(self) -> dict:
+        keys, vals = self.export(0)
+        return {"keys": keys, "values": vals, "dim": self.dim}
+
+    def load_state_dict(self, state: dict):
+        assert int(state["dim"]) == self.dim
+        self.import_(state["keys"], state["values"])

@@ -1,0 +1,114 @@
+"""JAX bridge for the host-side KvEmbedding table.
+
+Reference parity: TFPlus wires KvVariable into the TF graph as custom
+ops (tfplus/kv_variable/ops/kv_variable_ops.cc). The XLA equivalent is
+`jax.pure_callback` for the dense-gather forward plus a `custom_vjp`
+whose backward hands the sparse row gradient back to the table's C++
+optimizer — the device program keeps static shapes (a [batch, dim]
+gather window), the dynamic table stays in host DRAM. This mirrors how
+SparseCore-style embedding APIs split dense TPU compute from host/SC
+lookups.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.embedding.kv_store import KvEmbeddingTable
+
+
+class KvEmbeddingLayer:
+    """Trainable embedding lookup backed by a KvEmbeddingTable.
+
+    forward: ids [batch...] int -> embeddings [batch..., dim]
+    The gradient does NOT flow into jax params; instead call
+    `apply_grads(ids, grad)` (or use `lookup_with_grad`) to run the
+    sparse optimizer on the touched rows host-side.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        optimizer: str = "adam",     # sgd | adagrad | adam
+        lr: float = 1e-3,
+        l1: float = 0.0,
+        l2: float = 0.0,
+        initializer: str = "normal",
+        seed: int = 0,
+    ):
+        self.table = KvEmbeddingTable(
+            dim, initializer=initializer, seed=seed
+        )
+        self.dim = dim
+        self.optimizer = optimizer
+        self.lr = lr
+        self.l1 = l1
+        self.l2 = l2
+        self._step = 0
+
+    # ---- forward (pure_callback keeps jit compatibility) ----
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        out_shape = jax.ShapeDtypeStruct(
+            tuple(ids.shape) + (self.dim,), jnp.float32
+        )
+
+        def host_lookup(ids_np):
+            return self.table.lookup(
+                np.asarray(ids_np), insert_missing=True
+            ).astype(np.float32)
+
+        return jax.pure_callback(host_lookup, out_shape, ids)
+
+    def lookup_with_grad(
+        self, ids: jax.Array, handle: jax.Array
+    ) -> jax.Array:
+        """Differentiable lookup. `handle` is a scalar f32 that must be
+        among the caller's grad targets (keep it in the params pytree);
+        it anchors the vjp so autodiff can't prune it. The backward
+        routes the embedding row cotangent into the table's C++ sparse
+        optimizer as a host side effect.
+        """
+        layer = self
+
+        @jax.custom_vjp
+        def emb(handle):
+            return layer(ids)
+
+        def fwd(handle):
+            return layer(ids), ids
+
+        def bwd(res_ids, g):
+            def host_apply(ids_np, g_np):
+                layer.apply_grads(np.asarray(ids_np), np.asarray(g_np))
+                return np.zeros((), np.float32)
+
+            token = jax.pure_callback(
+                host_apply, jax.ShapeDtypeStruct((), jnp.float32),
+                res_ids, g,
+            )
+            return (token,)  # handle's cotangent carries the callback
+
+        emb.defvjp(fwd, bwd)
+        return emb(handle)
+
+    # ---- sparse update ----
+    def apply_grads(self, ids, grads):
+        ids = np.asarray(ids).ravel()
+        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        # duplicate ids within a batch must accumulate, not race
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        self._step += 1
+        if self.optimizer == "sgd":
+            self.table.apply_sgd(uniq, acc, self.lr)
+        elif self.optimizer == "adagrad":
+            self.table.apply_adagrad(uniq, acc, self.lr)
+        else:
+            self.table.apply_adam(
+                uniq, acc, self.lr, self._step,
+                l1=self.l1, l2=self.l2,
+            )

@@ -1,0 +1,358 @@
+// KvEmbedding: dynamic-shape hashtable embedding store (C++ core).
+//
+// Reference parity (SURVEY.md §2.6): TFPlus KvVariable
+// (tfplus/kv_variable/kernels/kv_variable.h:89, hashmap.h, kernels/
+// training_ops.cc) — a concurrent find-or-insert embedding table with
+// frequency/timestamp tracking, feature eviction, full/delta
+// import-export for incremental model delivery, and sparse optimizers
+// applied directly on the table.
+//
+// TPU design: XLA needs static shapes, so the dynamic table lives
+// host-side in C++; training gathers fixed-size key windows
+// (jax pure_callback) and optimizers apply host-side on the sparse rows
+// touched. Striped shards (own mutex + open hash map each) give
+// concurrent lookup/update from the input pipeline's threads.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 (no external deps).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+double now_sec() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Slot {
+  std::vector<float> data;  // [value(dim) | m(dim) | v(dim)] lazily sized
+  uint32_t freq = 0;
+  double last_access = 0.0;
+  uint64_t version = 0;  // table version at last write
+};
+
+constexpr int kNumShards = 64;
+
+struct Shard {
+  std::unordered_map<int64_t, Slot> map;
+  mutable std::mutex mu;
+};
+
+class KvTable {
+ public:
+  KvTable(int64_t dim, int init_mode, uint64_t seed, float init_scale)
+      : dim_(dim),
+        init_mode_(init_mode),
+        init_scale_(init_scale),
+        seed_(seed),
+        version_(1) {}
+
+  int64_t dim() const { return dim_; }
+
+  int64_t size() const {
+    int64_t n = 0;
+    for (const auto& s : shards_) {
+      std::lock_guard<std::mutex> g(s.mu);
+      n += static_cast<int64_t>(s.map.size());
+    }
+    return n;
+  }
+
+  // Gather rows for keys; missing keys: insert (insert_missing=1) with
+  // the configured initializer, or return zeros without inserting (=0)
+  // — the GatherOrInsert / GatherOrZeros pair of the reference.
+  void lookup(const int64_t* keys, int64_t n, float* out,
+              int insert_missing) {
+    const double t = now_sec();
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t k = keys[i];
+      Shard& sh = shard(k);
+      std::lock_guard<std::mutex> g(sh.mu);
+      auto it = sh.map.find(k);
+      if (it == sh.map.end()) {
+        if (!insert_missing) {
+          std::memset(out + i * dim_, 0, sizeof(float) * dim_);
+          continue;
+        }
+        it = sh.map.emplace(k, Slot{}).first;
+        init_value(k, it->second);
+      }
+      Slot& slot = it->second;
+      slot.freq++;
+      slot.last_access = t;
+      std::memcpy(out + i * dim_, slot.data.data(),
+                  sizeof(float) * dim_);
+    }
+  }
+
+  void scatter_add(const int64_t* keys, int64_t n, const float* vals,
+                   float alpha) {
+    const uint64_t ver = ++version_;
+    for (int64_t i = 0; i < n; ++i) {
+      const float* v = vals + i * dim_;
+      with_slot(keys[i], 1, [&](Slot& slot) {
+        float* w = slot.data.data();
+        for (int64_t d = 0; d < dim_; ++d) w[d] += alpha * v[d];
+        slot.version = ver;
+      });
+    }
+  }
+
+  // SGD on the touched rows.
+  void apply_sgd(const int64_t* keys, int64_t n, const float* grads,
+                 float lr) {
+    scatter_add(keys, n, grads, -lr);
+  }
+
+  // Adagrad: accumulator in data[dim..2*dim).
+  void apply_adagrad(const int64_t* keys, int64_t n, const float* grads,
+                     float lr, float eps) {
+    const uint64_t ver = ++version_;
+    for (int64_t i = 0; i < n; ++i) {
+      const float* g2 = grads + i * dim_;
+      with_slot(keys[i], 2, [&](Slot& slot) {
+        float* w = slot.data.data();
+        float* acc = w + dim_;
+        for (int64_t d = 0; d < dim_; ++d) {
+          acc[d] += g2[d] * g2[d];
+          w[d] -= lr * g2[d] / (std::sqrt(acc[d]) + eps);
+        }
+        slot.version = ver;
+      });
+    }
+  }
+
+  // Adam with optional sparse-group-lasso regularization — the
+  // reference's GroupAdam (tfplus python/training/group_adam.py:272,
+  // kernels/training_ops.cc): after the adam step, apply l2 shrinkage
+  // and a group-l1 soft threshold over the whole row (feature group),
+  // which drives unused embedding rows to exact zero.
+  void apply_adam(const int64_t* keys, int64_t n, const float* grads,
+                  float lr, float b1, float b2, float eps, int64_t step,
+                  float l1, float l2) {
+    const uint64_t ver = ++version_;
+    const float bc1 = 1.0f - std::pow(b1, static_cast<float>(step));
+    const float bc2 = 1.0f - std::pow(b2, static_cast<float>(step));
+    for (int64_t i = 0; i < n; ++i) {
+      const float* gr = grads + i * dim_;
+      with_slot(keys[i], 3, [&](Slot& slot) {
+        float* w = slot.data.data();
+        float* m = w + dim_;
+        float* v = w + 2 * dim_;
+        for (int64_t d = 0; d < dim_; ++d) {
+          m[d] = b1 * m[d] + (1 - b1) * gr[d];
+          v[d] = b2 * v[d] + (1 - b2) * gr[d] * gr[d];
+          const float mh = m[d] / bc1;
+          const float vh = v[d] / bc2;
+          w[d] -= lr * mh / (std::sqrt(vh) + eps);
+        }
+        if (l2 > 0.f) {
+          const float shrink = 1.0f / (1.0f + lr * l2);
+          for (int64_t d = 0; d < dim_; ++d) w[d] *= shrink;
+        }
+        if (l1 > 0.f) {
+          // group soft-threshold on the row norm
+          float norm = 0.f;
+          for (int64_t d = 0; d < dim_; ++d) norm += w[d] * w[d];
+          norm = std::sqrt(norm);
+          const float thresh = lr * l1;
+          if (norm <= thresh) {
+            std::memset(w, 0, sizeof(float) * dim_);
+          } else {
+            const float scale = (norm - thresh) / norm;
+            for (int64_t d = 0; d < dim_; ++d) w[d] *= scale;
+          }
+        }
+        slot.version = ver;
+      });
+    }
+  }
+
+  // Remove rows with freq < min_freq OR idle longer than max_idle_sec.
+  int64_t evict(uint32_t min_freq, double max_idle_sec) {
+    const double t = now_sec();
+    int64_t removed = 0;
+    for (auto& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
+      for (auto it = sh.map.begin(); it != sh.map.end();) {
+        const Slot& s = it->second;
+        const bool idle =
+            max_idle_sec > 0 && (t - s.last_access) > max_idle_sec;
+        const bool cold = min_freq > 0 && s.freq < min_freq;
+        if (idle || cold) {
+          it = sh.map.erase(it);
+          ++removed;
+        } else {
+          ++it;
+        }
+      }
+    }
+    return removed;
+  }
+
+  // Export rows with version > since_version (0 = full export).
+  // Two-phase: count then fill, caller allocates.
+  int64_t export_count(uint64_t since_version) const {
+    int64_t n = 0;
+    for (const auto& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
+      for (const auto& kv : sh.map)
+        if (kv.second.version > since_version) ++n;
+    }
+    return n;
+  }
+
+  int64_t export_rows(uint64_t since_version, int64_t* keys_out,
+                      float* vals_out, int64_t max_n) const {
+    int64_t n = 0;
+    for (const auto& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
+      for (const auto& kv : sh.map) {
+        if (kv.second.version <= since_version) continue;
+        if (n >= max_n) return n;
+        keys_out[n] = kv.first;
+        std::memcpy(vals_out + n * dim_, kv.second.data.data(),
+                    sizeof(float) * dim_);
+        ++n;
+      }
+    }
+    return n;
+  }
+
+  void import_rows(const int64_t* keys, const float* vals, int64_t n) {
+    const uint64_t ver = ++version_;
+    const double t = now_sec();
+    for (int64_t i = 0; i < n; ++i) {
+      const float* src = vals + i * dim_;
+      with_slot(keys[i], 1, [&](Slot& slot) {
+        std::memcpy(slot.data.data(), src, sizeof(float) * dim_);
+        slot.version = ver;
+        slot.last_access = t;
+      });
+    }
+  }
+
+  uint64_t version() const { return version_.load(); }
+
+ private:
+  Shard& shard(int64_t key) {
+    // splitmix64 scramble → shard index
+    uint64_t x = static_cast<uint64_t>(key) + 0x9e3779b97f4a7c15ULL;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+    return shards_[(x ^ (x >> 31)) % kNumShards];
+  }
+
+  void init_value(int64_t key, Slot& slot) {
+    slot.data.assign(dim_, 0.0f);
+    slot.last_access = now_sec();
+    slot.version = version_.load();
+    if (init_mode_ == 1) {
+      // deterministic per-key pseudo-normal init
+      std::mt19937_64 rng(seed_ ^ static_cast<uint64_t>(key));
+      std::normal_distribution<float> dist(0.f, init_scale_);
+      for (int64_t d = 0; d < dim_; ++d) slot.data[d] = dist(rng);
+    }
+  }
+
+  // find-or-create + run f(slot), all under the shard lock so a
+  // concurrent evict() cannot invalidate the slot mid-update
+  template <typename F>
+  void with_slot(int64_t key, int state_mult, F&& f) {
+    Shard& sh = shard(key);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.map.find(key);
+    if (it == sh.map.end()) {
+      it = sh.map.emplace(key, Slot{}).first;
+      init_value(key, it->second);
+    }
+    const size_t need = static_cast<size_t>(dim_) * state_mult;
+    if (it->second.data.size() < need) it->second.data.resize(need, 0.f);
+    f(it->second);
+  }
+
+  const int64_t dim_;
+  const int init_mode_;
+  const float init_scale_;
+  const uint64_t seed_;
+  std::atomic<uint64_t> version_;
+  Shard shards_[kNumShards];
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_create(int64_t dim, int init_mode, uint64_t seed,
+                float init_scale) {
+  return new KvTable(dim, init_mode, seed, init_scale);
+}
+
+void kv_free(void* t) { delete static_cast<KvTable*>(t); }
+
+int64_t kv_size(void* t) { return static_cast<KvTable*>(t)->size(); }
+
+int64_t kv_dim(void* t) { return static_cast<KvTable*>(t)->dim(); }
+
+uint64_t kv_version(void* t) {
+  return static_cast<KvTable*>(t)->version();
+}
+
+void kv_lookup(void* t, const int64_t* keys, int64_t n, float* out,
+               int insert_missing) {
+  static_cast<KvTable*>(t)->lookup(keys, n, out, insert_missing);
+}
+
+void kv_scatter_add(void* t, const int64_t* keys, int64_t n,
+                    const float* vals, float alpha) {
+  static_cast<KvTable*>(t)->scatter_add(keys, n, vals, alpha);
+}
+
+void kv_apply_sgd(void* t, const int64_t* keys, int64_t n,
+                  const float* grads, float lr) {
+  static_cast<KvTable*>(t)->apply_sgd(keys, n, grads, lr);
+}
+
+void kv_apply_adagrad(void* t, const int64_t* keys, int64_t n,
+                      const float* grads, float lr, float eps) {
+  static_cast<KvTable*>(t)->apply_adagrad(keys, n, grads, lr, eps);
+}
+
+void kv_apply_adam(void* t, const int64_t* keys, int64_t n,
+                   const float* grads, float lr, float b1, float b2,
+                   float eps, int64_t step, float l1, float l2) {
+  static_cast<KvTable*>(t)->apply_adam(keys, n, grads, lr, b1, b2, eps,
+                                       step, l1, l2);
+}
+
+int64_t kv_evict(void* t, uint32_t min_freq, double max_idle_sec) {
+  return static_cast<KvTable*>(t)->evict(min_freq, max_idle_sec);
+}
+
+int64_t kv_export_count(void* t, uint64_t since_version) {
+  return static_cast<KvTable*>(t)->export_count(since_version);
+}
+
+int64_t kv_export_rows(void* t, uint64_t since_version,
+                       int64_t* keys_out, float* vals_out,
+                       int64_t max_n) {
+  return static_cast<KvTable*>(t)->export_rows(since_version, keys_out,
+                                               vals_out, max_n);
+}
+
+void kv_import_rows(void* t, const int64_t* keys, const float* vals,
+                    int64_t n) {
+  static_cast<KvTable*>(t)->import_rows(keys, vals, n);
+}
+
+}  // extern "C"
