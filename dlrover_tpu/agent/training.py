@@ -228,6 +228,16 @@ class ElasticTrainingAgent:
                 "DLROVER_TPU_RDZV_ROUND": str(rnd),
             }
         )
+        # workers may run with any cwd: make sure they can import the
+        # package the agent itself was loaded from
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        pp = env.get("PYTHONPATH", "")
+        if pkg_root not in pp.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                f"{pkg_root}{os.pathsep}{pp}" if pp else pkg_root
+            )
         return env
 
     def _start_worker(self) -> Tuple[int, CommWorld]:

@@ -29,19 +29,61 @@ _BUILD_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 
 
-def _build_so() -> str:
-    with _BUILD_LOCK:
-        if os.path.exists(_SO) and (
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
-        ):
-            return _SO
-        cmd = [
-            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-            "-o", _SO, _SRC,
-        ]
-        logger.info("building kv_embedding native lib: %s", " ".join(cmd))
-        subprocess.run(cmd, check=True, capture_output=True)
+def _so_path() -> str:
+    """Prefer a fresh prebuilt .so next to the source (no toolchain
+    needed at runtime); else build there if writable, falling back to a
+    per-user cache dir (installed read-only site-packages)."""
+    if os.path.exists(_SO) and (
+        os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    ):
         return _SO
+    if os.access(_NATIVE_DIR, os.W_OK):
+        return _SO
+    cache = os.path.join(
+        os.path.expanduser("~"), ".cache", "dlrover_tpu"
+    )
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, "libkv_embedding.so")
+
+
+def _build_so() -> str:
+    import fcntl
+
+    so = _so_path()
+    with _BUILD_LOCK:
+        # cross-process exclusion: g++ writes the output in place, so
+        # concurrently launched workers must not compile over a .so a
+        # third process is dlopen-ing — build to a temp name under an
+        # flock, then rename atomically.
+        lock_path = so + ".lock"
+        with open(lock_path, "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(so) and (
+                    os.path.getmtime(so) >= os.path.getmtime(_SRC)
+                ):
+                    return so
+                tmp = f"{so}.{os.getpid()}.tmp"
+                cmd = [
+                    "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                    "-o", tmp, _SRC,
+                ]
+                logger.info(
+                    "building kv_embedding native lib: %s", " ".join(cmd)
+                )
+                try:
+                    subprocess.run(
+                        cmd, check=True, capture_output=True, text=True
+                    )
+                except subprocess.CalledProcessError as e:
+                    logger.error(
+                        "kv_embedding build failed:\n%s", e.stderr
+                    )
+                    raise
+                os.replace(tmp, so)
+                return so
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
 def _lib() -> ctypes.CDLL:
@@ -80,6 +122,16 @@ def _lib() -> ctypes.CDLL:
     lib.kv_export_rows.restype = i64
     lib.kv_export_rows.argtypes = [p, u64, i64p, f32p, i64]
     lib.kv_import_rows.argtypes = [p, i64p, f32p, i64]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.kv_max_state_mult.restype = ctypes.c_int
+    lib.kv_max_state_mult.argtypes = [p]
+    lib.kv_export_full.restype = i64
+    lib.kv_export_full.argtypes = [
+        p, u64, i64p, f32p, u32p, i64, ctypes.c_int,
+    ]
+    lib.kv_import_full.argtypes = [
+        p, i64p, f32p, u32p, i64, ctypes.c_int,
+    ]
     _LIB = lib
     return lib
 
@@ -196,15 +248,34 @@ class KvEmbeddingTable:
         """Full (since_version=0) or delta export → (keys, values).
         Delta export backs incremental model delivery (reference
         ImportV3/ExportV3)."""
-        n = int(self._lib.kv_export_count(self._h, since_version))
-        keys = np.empty(n, np.int64)
-        vals = np.empty((n, self.dim), np.float32)
-        got = int(
-            self._lib.kv_export_rows(
-                self._h, since_version, _i64p(keys), _f32p(vals), n
+
+        def _fill(keys, cap, since):
+            vals = np.empty((cap, self.dim), np.float32)
+            got = int(
+                self._lib.kv_export_rows(
+                    self._h, since, _i64p(keys), _f32p(vals), cap
+                )
             )
+            return got, (vals,)
+
+        got, keys, (vals,) = self._export_with_retry(
+            since_version, _fill
         )
         return keys[:got], vals[:got]
+
+    def _export_with_retry(self, since_version: int, fill):
+        """count-then-fill isn't atomic vs concurrent inserts: allocate
+        headroom and retry while the buffer fills to the brim (a full
+        buffer can't be distinguished from a truncated one)."""
+        headroom = 1024
+        while True:
+            n = int(self._lib.kv_export_count(self._h, since_version))
+            cap = n + headroom
+            keys = np.empty(cap, np.int64)
+            got, extra = fill(keys, cap, since_version)
+            if got < cap:
+                return got, keys, extra
+            headroom *= 4
 
     def import_(self, keys, values):
         k = self._keys(keys)
@@ -213,11 +284,68 @@ class KvEmbeddingTable:
         )
         self._lib.kv_import_rows(self._h, _i64p(k), _f32p(v), k.size)
 
+    @property
+    def state_mult(self) -> int:
+        """Widest per-row state (1=values, 2=+adagrad, 3=+adam m,v)."""
+        return int(self._lib.kv_max_state_mult(self._h))
+
+    def export_full(
+        self, since_version: int = 0, state_mult: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+        """Export (keys, state[n, mult*dim], freq, mult): row values AND
+        optimizer moments AND eviction stats (reference ExportV2). The
+        width adapts to the optimizer actually in use — an SGD table
+        exports dim floats per row, not 3*dim of zeros."""
+        mult = state_mult or self.state_mult
+
+        def _fill(keys, cap, since):
+            state = np.empty((cap, mult * self.dim), np.float32)
+            freq = np.empty(cap, np.uint32)
+            got = int(
+                self._lib.kv_export_full(
+                    self._h, since, _i64p(keys), _f32p(state),
+                    freq.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint32)
+                    ),
+                    cap, mult,
+                )
+            )
+            return got, (state, freq)
+
+        got, keys, (state, freq) = self._export_with_retry(
+            since_version, _fill
+        )
+        return keys[:got], state[:got], freq[:got], mult
+
+    def import_full(self, keys, state, freq, state_mult: int):
+        k = self._keys(keys)
+        s = np.ascontiguousarray(state, np.float32).reshape(
+            k.size, state_mult * self.dim
+        )
+        f = np.ascontiguousarray(freq, np.uint32).ravel()
+        self._lib.kv_import_full(
+            self._h, _i64p(k), _f32p(s),
+            f.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            k.size, state_mult,
+        )
+
     # ---- checkpoint integration ----
     def state_dict(self) -> dict:
-        keys, vals = self.export(0)
-        return {"keys": keys, "values": vals, "dim": self.dim}
+        keys, state, freq, mult = self.export_full(0)
+        return {
+            "keys": keys,
+            "state": state,
+            "freq": freq,
+            "dim": self.dim,
+            "state_mult": mult,
+        }
 
     def load_state_dict(self, state: dict):
         assert int(state["dim"]) == self.dim
-        self.import_(state["keys"], state["values"])
+        if "state" in state:
+            self.import_full(
+                state["keys"], state["state"], state["freq"],
+                int(state.get("state_mult", 3)),
+            )
+        else:  # legacy values-only checkpoint
+            self.import_(state["keys"], state["values"])

@@ -112,3 +112,16 @@ class KvEmbeddingLayer:
                 uniq, acc, self.lr, self._step,
                 l1=self.l1, l2=self.l2,
             )
+
+    # ---- checkpoint ----
+    def state_dict(self) -> dict:
+        """Table rows + optimizer moments + the Adam step counter, so a
+        restore resumes the exact optimizer trajectory (no bias-
+        correction restart spike)."""
+        sd = self.table.state_dict()
+        sd["step"] = self._step
+        return sd
+
+    def load_state_dict(self, state: dict):
+        self._step = int(state.get("step", 0))
+        self.table.load_state_dict(state)

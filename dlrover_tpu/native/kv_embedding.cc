@@ -238,6 +238,70 @@ class KvTable {
         std::memcpy(slot.data.data(), src, sizeof(float) * dim_);
         slot.version = ver;
         slot.last_access = t;
+        // a freshly imported row must survive frequency eviction until
+        // it is actually looked up again
+        if (slot.freq == 0) slot.freq = 1;
+      });
+    }
+  }
+
+  // Widest per-row state actually allocated (1=value only, 2=+adagrad
+  // acc, 3=+adam m,v) — lets checkpoints carry exactly the state that
+  // exists instead of always padding to 3*dim.
+  int max_state_mult() const {
+    size_t mx = 1;
+    for (const auto& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
+      for (const auto& kv : sh.map) {
+        const size_t m = kv.second.data.size() / dim_;
+        if (m > mx) mx = m;
+      }
+    }
+    return static_cast<int>(mx);
+  }
+
+  // Full-state export/import: the whole row state [value|m|v]
+  // (state_mult*dim, zero-padded when a row keeps less) plus freq — so
+  // a restored checkpoint resumes with intact optimizer moments and
+  // eviction statistics (reference ImportV2/ExportV2 carry slot state:
+  // tfplus kv_variable.h FullOrDeltaImport/Export).
+  int64_t export_full(uint64_t since_version, int64_t* keys_out,
+                      float* state_out, uint32_t* freq_out,
+                      int64_t max_n, int state_mult) const {
+    const int64_t w = state_mult * dim_;
+    int64_t n = 0;
+    for (const auto& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
+      for (const auto& kv : sh.map) {
+        if (kv.second.version <= since_version) continue;
+        if (n >= max_n) return n;
+        keys_out[n] = kv.first;
+        float* dst = state_out + n * w;
+        const auto& src = kv.second.data;
+        const size_t have =
+            std::min(src.size(), static_cast<size_t>(w));
+        std::memcpy(dst, src.data(), sizeof(float) * have);
+        if (have < static_cast<size_t>(w))
+          std::memset(dst + have, 0, sizeof(float) * (w - have));
+        freq_out[n] = kv.second.freq;
+        ++n;
+      }
+    }
+    return n;
+  }
+
+  void import_full(const int64_t* keys, const float* state,
+                   const uint32_t* freq, int64_t n, int state_mult) {
+    const uint64_t ver = ++version_;
+    const double t = now_sec();
+    const int64_t w = state_mult * dim_;
+    for (int64_t i = 0; i < n; ++i) {
+      const float* src = state + i * w;
+      with_slot(keys[i], state_mult, [&](Slot& slot) {
+        std::memcpy(slot.data.data(), src, sizeof(float) * w);
+        slot.version = ver;
+        slot.last_access = t;
+        slot.freq = freq[i] > 0 ? freq[i] : 1;
       });
     }
   }
@@ -256,7 +320,9 @@ class KvTable {
   void init_value(int64_t key, Slot& slot) {
     slot.data.assign(dim_, 0.0f);
     slot.last_access = now_sec();
-    slot.version = version_.load();
+    // bump the table version so gather-or-insert rows are visible to
+    // delta export (version > since), not just optimizer-touched ones
+    slot.version = ++version_;
     if (init_mode_ == 1) {
       // deterministic per-key pseudo-normal init
       std::mt19937_64 rng(seed_ ^ static_cast<uint64_t>(key));
@@ -353,6 +419,24 @@ int64_t kv_export_rows(void* t, uint64_t since_version,
 void kv_import_rows(void* t, const int64_t* keys, const float* vals,
                     int64_t n) {
   static_cast<KvTable*>(t)->import_rows(keys, vals, n);
+}
+
+int kv_max_state_mult(void* t) {
+  return static_cast<KvTable*>(t)->max_state_mult();
+}
+
+int64_t kv_export_full(void* t, uint64_t since_version,
+                       int64_t* keys_out, float* state_out,
+                       uint32_t* freq_out, int64_t max_n,
+                       int state_mult) {
+  return static_cast<KvTable*>(t)->export_full(
+      since_version, keys_out, state_out, freq_out, max_n, state_mult);
+}
+
+void kv_import_full(void* t, const int64_t* keys, const float* state,
+                    const uint32_t* freq, int64_t n, int state_mult) {
+  static_cast<KvTable*>(t)->import_full(keys, state, freq, n,
+                                        state_mult);
 }
 
 }  // extern "C"

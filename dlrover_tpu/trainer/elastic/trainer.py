@@ -1,0 +1,176 @@
+"""ElasticTrainer: fixed global batch size across world resizes.
+
+Reference parity: dlrover/trainer/torch/elastic/trainer.py:48-132
+(`ElasticTrainer` + `_ElasticOptimizer`) — wraps model/optimizer so the
+*global* batch size stays constant as workers come and go, by adjusting
+gradient-accumulation steps to the current world size.
+
+TPU re-design: there is one SPMD program, not per-rank optimizers, so
+the wrapper owns the `accelerate()` build instead of proxying torch
+objects. On a world change it rebuilds the mesh + jitted step with a new
+(per_replica_batch, grad_accum) pair from `elastic_batch_plan` and
+re-shards the live train state onto the new mesh
+(`restore_to_shardings`) — the JAX analogue of the reference's
+"re-init process group and keep training".
+"""
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import optax
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.parallel.accelerate import Accelerated, Strategy, accelerate
+from dlrover_tpu.parallel.mesh import BATCH_AXES, MeshSpec, local_mesh_spec
+from dlrover_tpu.trainer.elastic.data import elastic_batch_plan
+
+
+class ElasticTrainer:
+    """Keeps ``global_batch_size`` fixed while the device world resizes.
+
+    Usage::
+
+        et = ElasticTrainer(init_params, loss_fn, rules, optimizer,
+                            global_batch_size=64,
+                            max_per_replica_batch=8)
+        state = et.init_state(jax.random.PRNGKey(0))
+        for batch in loader:          # batch leading dim == 64 always
+            state, metrics = et.step(state, batch)
+        # on membership change (agent restarted us on a new world):
+        state = et.on_world_change(state)
+    """
+
+    def __init__(
+        self,
+        init_params: Callable[[jax.Array], Any],
+        loss_fn: Callable,
+        rules,
+        optimizer: optax.GradientTransformation,
+        global_batch_size: int,
+        max_per_replica_batch: int,
+        mesh_spec: Optional[MeshSpec] = None,
+        devices=None,
+        batch_spec: Tuple = (BATCH_AXES, None),
+    ):
+        self._init_params = init_params
+        self._loss_fn = loss_fn
+        self._rules = rules
+        self._optimizer = optimizer
+        self.global_batch_size = global_batch_size
+        self.max_per_replica_batch = max_per_replica_batch
+        self._batch_spec = batch_spec
+        self._devices = devices
+        self._mesh_spec = mesh_spec
+        self.acc: Optional[Accelerated] = None
+        self.plan: Dict[str, int] = {}
+        self._build()
+
+    # -- build / rebuild ---------------------------------------------------
+
+    def _current_spec(self) -> MeshSpec:
+        if self._mesh_spec is not None:
+            return self._mesh_spec
+        n = len(self._devices) if self._devices else len(jax.devices())
+        return local_mesh_spec(n)
+
+    def _build(self):
+        spec = self._current_spec()
+        replicas = spec.batch_shards
+        self.plan = elastic_batch_plan(
+            self.global_batch_size, replicas, self.max_per_replica_batch
+        )
+        strategy = Strategy(
+            mesh=spec,
+            grad_accum=self.plan["grad_accum"],
+            batch_spec=self._batch_spec,
+        )
+        self.acc = accelerate(
+            self._init_params,
+            self._loss_fn,
+            self._rules,
+            self._optimizer,
+            strategy=strategy,
+            devices=self._devices,
+        )
+        logger.info(
+            "ElasticTrainer: %d replicas, per-replica batch %d, "
+            "grad-accum %d (global %d)",
+            replicas,
+            self.plan["per_replica_batch"],
+            self.plan["grad_accum"],
+            self.global_batch_size,
+        )
+
+    @property
+    def grad_accum(self) -> int:
+        return self.plan["grad_accum"]
+
+    @property
+    def mesh(self):
+        return self.acc.mesh
+
+    def init_state(self, key: jax.Array) -> Any:
+        return self.acc.init(key)
+
+    # -- stepping ----------------------------------------------------------
+
+    def _fold_microbatches(self, batch):
+        """[global, ...] → [accum, global/accum, ...] when accumulating."""
+        accum = self.plan["grad_accum"]
+        if accum == 1:
+            return batch
+
+        def _fold(x):
+            if getattr(x, "ndim", 0) == 0:
+                return x
+            if x.shape[0] != self.global_batch_size:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} != global batch "
+                    f"{self.global_batch_size}"
+                )
+            return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+        return jax.tree_util.tree_map(_fold, batch)
+
+    def step(self, state: Any, batch: Any) -> Tuple[Any, Dict]:
+        batch = self.acc.shard_batch(self._fold_microbatches(batch))
+        return self.acc.train_step(state, batch)
+
+    def eval_step(self, state: Any, batch: Any) -> Dict:
+        sharded = self.acc.shard_batch(batch)
+        return self.acc.eval_step(state, sharded)
+
+    # -- elasticity --------------------------------------------------------
+
+    def on_world_change(
+        self,
+        state: Any,
+        mesh_spec: Optional[MeshSpec] = None,
+        devices=None,
+    ) -> Any:
+        """Rebuild for a new world and re-shard the live state onto it.
+
+        The state's leaves are fetched to host (addressable data) and
+        device_put with the new mesh's shardings — the elastic-resize
+        path SURVEY.md §7 calls out as the hard part the torch reference
+        sidesteps.
+        """
+        if mesh_spec is not None:
+            self._mesh_spec = mesh_spec
+        if devices is not None:
+            self._devices = devices
+        host_state = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x))
+            if isinstance(x, jax.Array)
+            else x,
+            state,
+        )
+        self._build()
+        from dlrover_tpu.parallel.sharding import tree_shardings
+
+        abstract = jax.eval_shape(self.acc.init, jax.random.PRNGKey(0))
+        shardings = tree_shardings(abstract, self.acc.mesh, self._rules)
+        return jax.tree_util.tree_map(
+            jax.device_put, host_state, shardings
+        )

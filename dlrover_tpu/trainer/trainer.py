@@ -1,0 +1,245 @@
+"""High-level training loop — the AtorchTrainer / FlashCkptTrainer analogue.
+
+Reference parity:
+- atorch/atorch/trainer/atorch_trainer.py:136 (`AtorchTrainer`): HF-style
+  train/evaluate/save loop with resume, periodic logging/eval/save.
+- dlrover/trainer/torch/flash_checkpoint/hf_trainer.py:123
+  (`FlashCkptTrainer`): checkpoint saves go through the flash-checkpoint
+  engine instead of blocking disk writes.
+- elastic_agent/monitor/training.py:77 (`TorchTrainingMonitor`): the
+  trainer publishes its global step for the agent's heartbeat.
+
+TPU design: the loop drives an `ElasticTrainer` (fixed global batch over
+an SPMD mesh). Saves stage to host shm in milliseconds and persist
+asynchronously; resume is memory-first. A `HangingDetector` watches
+step liveness. Callbacks mirror the HF `TrainerCallback` surface the
+reference exposes (on_step_end / on_log / on_save / on_evaluate).
+"""
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import jax
+import numpy as np
+
+from dlrover_tpu.agent.monitor import write_step_metrics
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.trainer.flash_checkpoint.engine import (
+    Checkpointer,
+    StorageType,
+)
+from dlrover_tpu.utils.hanging_detector import HangingDetector
+
+
+@dataclass
+class TrainingArguments:
+    """Reference: atorch/atorch/trainer/atorch_args.py (HF-style args)."""
+
+    output_dir: str = "output"
+    max_steps: int = -1
+    num_epochs: int = 1
+    logging_steps: int = 10
+    eval_steps: int = 0  # 0 = no periodic eval
+    save_steps: int = 0  # 0 = no periodic save
+    save_storage: str = StorageType.DISK
+    save_total_limit: int = 0  # kept by the storage deletion strategy
+    resume: bool = True
+    hang_timeout: float = 1800.0
+    publish_step_metrics: bool = True
+
+
+class TrainerCallback:
+    """Subclass-and-override hook points (HF TrainerCallback surface)."""
+
+    def on_train_begin(self, trainer, state):  # noqa: D401
+        pass
+
+    def on_step_end(self, trainer, state, metrics: Dict):
+        pass
+
+    def on_log(self, trainer, state, logs: Dict):
+        pass
+
+    def on_save(self, trainer, state, step: int):
+        pass
+
+    def on_evaluate(self, trainer, state, metrics: Dict):
+        pass
+
+    def on_train_end(self, trainer, state):
+        pass
+
+
+class Trainer:
+    """Train an ElasticTrainer-wrapped model with flash checkpointing.
+
+    ``train_data`` yields host batches whose leading dim equals the
+    elastic trainer's global batch size (an `ElasticDataLoader` or any
+    iterable); ``eval_data`` likewise for evaluation.
+    """
+
+    def __init__(
+        self,
+        elastic_trainer,
+        args: Optional[TrainingArguments] = None,
+        train_data: Optional[Iterable] = None,
+        eval_data: Optional[Iterable] = None,
+        callbacks: Optional[List[TrainerCallback]] = None,
+        checkpointer: Optional[Checkpointer] = None,
+        master_client=None,
+    ):
+        self.et = elastic_trainer
+        self.args = args or TrainingArguments()
+        self.train_data = train_data
+        self.eval_data = eval_data
+        self.callbacks = list(callbacks or [])
+        self._mc = master_client
+        self.checkpointer = checkpointer
+        if self.checkpointer is None and (
+            self.args.save_steps > 0 or self.args.resume
+        ):
+            self.checkpointer = Checkpointer(
+                os.path.join(self.args.output_dir, "checkpoints")
+            )
+        self.global_step = 0
+        self.last_logs: Dict = {}
+        self._hang = HangingDetector(
+            timeout=self.args.hang_timeout, master_client=master_client
+        )
+
+    # -- checkpoint --------------------------------------------------------
+
+    def save(self, state, storage_type: Optional[str] = None) -> float:
+        st = storage_type or self.args.save_storage
+        blocked = self.checkpointer.save_checkpoint(
+            self.global_step, state, storage_type=st
+        )
+        logger.info(
+            "saved step %d to %s (blocked %.3f s)",
+            self.global_step,
+            st,
+            blocked,
+        )
+        for cb in self.callbacks:
+            cb.on_save(self, state, self.global_step)
+        return blocked
+
+    def _maybe_resume(self, state):
+        if not (self.args.resume and self.checkpointer):
+            return state
+        step, restored = self.checkpointer.load_checkpoint(target=state)
+        if restored is None:
+            return state
+        self.global_step = step
+        logger.info("resumed from step %d", step)
+        return restored
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, state) -> Dict:
+        if self.eval_data is None:
+            return {}
+        totals: Dict[str, float] = {}
+        count = 0
+        for batch in self.eval_data:
+            metrics = self.et.eval_step(state, batch)
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(
+                    np.asarray(jax.device_get(v))
+                )
+            count += 1
+        logs = {
+            f"eval_{k}": v / max(count, 1) for k, v in totals.items()
+        }
+        for cb in self.callbacks:
+            cb.on_evaluate(self, state, logs)
+        return logs
+
+    # -- main loop ---------------------------------------------------------
+
+    def train(self, state=None) -> Any:
+        if state is None:
+            state = self.et.init_state(jax.random.PRNGKey(0))
+        state = self._maybe_resume(state)
+        self._hang.start()
+        for cb in self.callbacks:
+            cb.on_train_begin(self, state)
+
+        window_t0 = time.monotonic()
+        window_steps = 0
+        stop = False
+        try:
+            for epoch in range(self.args.num_epochs):
+                if stop:
+                    break
+                if hasattr(self.train_data, "set_epoch"):
+                    self.train_data.set_epoch(epoch)
+                for batch in self.train_data:
+                    state, metrics = self.et.step(state, batch)
+                    jax.block_until_ready(
+                        metrics.get("loss", metrics)
+                    )
+                    self.global_step += 1
+                    window_steps += 1
+                    self._hang.record_step(self.global_step)
+                    for cb in self.callbacks:
+                        cb.on_step_end(self, state, metrics)
+
+                    a = self.args
+                    if (
+                        a.logging_steps
+                        and self.global_step % a.logging_steps == 0
+                    ):
+                        dt = time.monotonic() - window_t0
+                        logs = {
+                            k: float(np.asarray(jax.device_get(v)))
+                            for k, v in metrics.items()
+                        }
+                        logs["steps_per_sec"] = window_steps / max(
+                            dt, 1e-9
+                        )
+                        logs["step"] = self.global_step
+                        self.last_logs = logs
+                        logger.info("step %s", logs)
+                        for cb in self.callbacks:
+                            cb.on_log(self, state, logs)
+                        if a.publish_step_metrics:
+                            write_step_metrics(
+                                self.global_step, **{
+                                    "loss": logs.get("loss", 0.0)
+                                }
+                            )
+                        if self._mc is not None:
+                            try:
+                                self._mc.report_global_step(
+                                    self.global_step
+                                )
+                            except Exception:
+                                pass
+                        window_t0 = time.monotonic()
+                        window_steps = 0
+                    if (
+                        a.eval_steps
+                        and self.global_step % a.eval_steps == 0
+                    ):
+                        self.evaluate(state)
+                    if (
+                        a.save_steps
+                        and self.global_step % a.save_steps == 0
+                    ):
+                        self.save(state)
+                    if (
+                        a.max_steps > 0
+                        and self.global_step >= a.max_steps
+                    ):
+                        stop = True
+                        break
+        finally:
+            self._hang.stop()
+        if self.args.save_steps and self.checkpointer:
+            self.save(state, storage_type=StorageType.DISK)
+        for cb in self.callbacks:
+            cb.on_train_end(self, state)
+        return state

@@ -1,0 +1,257 @@
+"""Trainer-layer tests: ElasticTrainer fixed-global-batch elasticity,
+HF-style Trainer loop with flash-ckpt save/resume, hanging detector."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dlrover_tpu.parallel.mesh import MeshSpec
+from dlrover_tpu.trainer.elastic.trainer import ElasticTrainer
+from dlrover_tpu.trainer.trainer import (
+    Trainer,
+    TrainerCallback,
+    TrainingArguments,
+)
+from dlrover_tpu.utils.hanging_detector import HangingDetector
+
+RULES = [(r".*", (None,))]  # tiny model: replicate everything
+
+
+def _init_params(key):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w": jax.random.normal(k1, (4, 8)) * 0.1,
+        "b": jnp.zeros((8,)),
+        "head": jax.random.normal(k2, (8, 2)) * 0.1,
+    }
+
+
+def _loss_fn(params, batch, mesh):
+    x, y = batch["x"], batch["y"]
+    h = jnp.tanh(x @ params["w"] + params["b"])
+    logits = h @ params["head"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits, y
+    ).mean()
+    return loss, {"loss": loss}
+
+
+def _make_batch(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": rng.randn(n, 4).astype(np.float32),
+        "y": rng.randint(0, 2, size=(n,)).astype(np.int32),
+    }
+
+
+def _make_et(global_batch=16, max_per_replica=2, spec=None):
+    return ElasticTrainer(
+        _init_params,
+        _loss_fn,
+        RULES,
+        optax.adam(1e-2),
+        global_batch_size=global_batch,
+        max_per_replica_batch=max_per_replica,
+        mesh_spec=spec or MeshSpec(data=4),
+    )
+
+
+class TestElasticTrainer:
+    def test_plan_grad_accum(self):
+        et = _make_et(global_batch=16, max_per_replica=2)
+        # 4 replicas * 2 per-replica * accum 2 == 16
+        assert et.plan["per_replica_batch"] == 2
+        assert et.grad_accum == 2
+
+    def test_step_decreases_loss(self):
+        et = _make_et()
+        state = et.init_state(jax.random.PRNGKey(0))
+        batch = _make_batch(16)
+        losses = []
+        for _ in range(20):
+            state, m = et.step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+
+    def test_world_change_keeps_state_and_global_batch(self):
+        et = _make_et(global_batch=16, max_per_replica=2)
+        state = et.init_state(jax.random.PRNGKey(0))
+        batch = _make_batch(16)
+        state, m0 = et.step(state, batch)
+        w_before = np.asarray(jax.device_get(state["params"]["w"]))
+        # shrink the world: 4 data shards -> 2 (same 8 devices, mesh
+        # reshaped); global batch stays 16, accum grows
+        state = et.on_world_change(state, mesh_spec=MeshSpec(data=2))
+        assert et.plan["num_replicas"] == 2
+        assert (
+            et.plan["per_replica_batch"] * et.grad_accum * 2 == 16
+        )
+        w_after = np.asarray(jax.device_get(state["params"]["w"]))
+        np.testing.assert_allclose(w_before, w_after, rtol=1e-6)
+        # training continues on the new world
+        state, m1 = et.step(state, batch)
+        assert np.isfinite(float(m1["loss"]))
+
+    def test_accum_matches_single_big_batch(self):
+        batch = _make_batch(16, seed=3)
+        et1 = _make_et(global_batch=16, max_per_replica=16)
+        et2 = _make_et(global_batch=16, max_per_replica=2)
+        assert et1.grad_accum == 1 and et2.grad_accum == 2
+        s1 = et1.init_state(jax.random.PRNGKey(0))
+        s2 = et2.init_state(jax.random.PRNGKey(0))
+        s1, m1 = et1.step(s1, batch)
+        s2, m2 = et2.step(s2, batch)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(s1["params"]["w"])),
+            np.asarray(jax.device_get(s2["params"]["w"])),
+            rtol=1e-4,
+            atol=1e-6,
+        )
+
+
+class _Recorder(TrainerCallback):
+    def __init__(self):
+        self.events = []
+
+    def on_train_begin(self, trainer, state):
+        self.events.append("begin")
+
+    def on_step_end(self, trainer, state, metrics):
+        self.events.append("step")
+
+    def on_log(self, trainer, state, logs):
+        self.events.append(("log", logs["step"]))
+
+    def on_save(self, trainer, state, step):
+        self.events.append(("save", step))
+
+    def on_train_end(self, trainer, state):
+        self.events.append("end")
+
+
+def _loader(n_batches, batch):
+    return [batch] * n_batches
+
+
+class TestTrainerLoop:
+    def test_train_runs_and_logs(self, tmp_path):
+        et = _make_et()
+        rec = _Recorder()
+        args = TrainingArguments(
+            output_dir=str(tmp_path),
+            max_steps=6,
+            logging_steps=2,
+            resume=False,
+            save_steps=0,
+            publish_step_metrics=False,
+        )
+        tr = Trainer(
+            et,
+            args,
+            train_data=_loader(10, _make_batch(16)),
+            callbacks=[rec],
+            checkpointer=None,
+        )
+        state = tr.train()
+        assert tr.global_step == 6
+        assert rec.events[0] == "begin"
+        assert rec.events[-1] == "end"
+        assert ("log", 2) in rec.events
+        assert state is not None
+
+    def test_save_resume_roundtrip(self, tmp_path):
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"trainer-{os.getpid()}"
+        et = _make_et()
+        args = TrainingArguments(
+            output_dir=str(tmp_path),
+            max_steps=4,
+            logging_steps=0,
+            save_steps=2,
+            resume=False,
+            publish_step_metrics=False,
+        )
+        tr = Trainer(et, args, train_data=_loader(10, _make_batch(16)))
+        state = tr.train()
+        tr.checkpointer.wait_latest_checkpoint(4, timeout=30)
+        w_saved = np.asarray(jax.device_get(state["params"]["w"]))
+        tr.checkpointer.close()
+
+        # new trainer resumes from step 4 and continues
+        et2 = _make_et()
+        args2 = TrainingArguments(
+            output_dir=str(tmp_path),
+            max_steps=6,
+            logging_steps=0,
+            save_steps=2,
+            resume=True,
+            publish_step_metrics=False,
+        )
+        tr2 = Trainer(
+            et2, args2, train_data=_loader(10, _make_batch(16))
+        )
+        st2 = et2.init_state(jax.random.PRNGKey(1))
+        st2 = tr2._maybe_resume(st2)
+        assert tr2.global_step == 4
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(st2["params"]["w"])),
+            w_saved,
+            rtol=1e-6,
+        )
+        tr2.checkpointer.close()
+
+    def test_evaluate(self, tmp_path):
+        et = _make_et()
+        args = TrainingArguments(
+            output_dir=str(tmp_path),
+            max_steps=2,
+            resume=False,
+            logging_steps=0,
+            publish_step_metrics=False,
+        )
+        tr = Trainer(
+            et,
+            args,
+            train_data=_loader(4, _make_batch(16)),
+            eval_data=_loader(2, _make_batch(16, seed=9)),
+            checkpointer=None,
+        )
+        state = tr.train()
+        logs = tr.evaluate(state)
+        assert "eval_loss" in logs and np.isfinite(logs["eval_loss"])
+
+
+class TestHangingDetector:
+    def test_fires_on_stall(self):
+        hangs = []
+        hd = HangingDetector(
+            timeout=0.2,
+            check_interval=0.05,
+            on_hang=lambda s: hangs.append(s),
+        )
+        hd.start()
+        hd.record_step(1)
+        import time
+
+        time.sleep(0.6)
+        hd.stop()
+        assert len(hangs) == 1  # reported once, not repeatedly
+
+    def test_quiet_while_stepping(self):
+        hangs = []
+        hd = HangingDetector(
+            timeout=0.3,
+            check_interval=0.05,
+            on_hang=lambda s: hangs.append(s),
+        )
+        hd.start()
+        import time
+
+        for i in range(6):
+            hd.record_step(i)
+            time.sleep(0.05)
+        hd.stop()
+        assert not hangs
