@@ -50,6 +50,12 @@ def _build_so() -> str:
     import fcntl
 
     so = _so_path()
+    # fresh prebuilt .so: no lock file, no toolchain — works on
+    # read-only installs
+    if os.path.exists(so) and (
+        os.path.getmtime(so) >= os.path.getmtime(_SRC)
+    ):
+        return so
     with _BUILD_LOCK:
         # cross-process exclusion: g++ writes the output in place, so
         # concurrently launched workers must not compile over a .so a
@@ -296,26 +302,32 @@ class KvEmbeddingTable:
         optimizer moments AND eviction stats (reference ExportV2). The
         width adapts to the optimizer actually in use — an SGD table
         exports dim floats per row, not 3*dim of zeros."""
-        mult = state_mult or self.state_mult
+        while True:
+            mult = state_mult or self.state_mult
 
-        def _fill(keys, cap, since):
-            state = np.empty((cap, mult * self.dim), np.float32)
-            freq = np.empty(cap, np.uint32)
-            got = int(
-                self._lib.kv_export_full(
-                    self._h, since, _i64p(keys), _f32p(state),
-                    freq.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_uint32)
-                    ),
-                    cap, mult,
+            def _fill(keys, cap, since):
+                state = np.empty((cap, mult * self.dim), np.float32)
+                freq = np.empty(cap, np.uint32)
+                got = int(
+                    self._lib.kv_export_full(
+                        self._h, since, _i64p(keys), _f32p(state),
+                        freq.ctypes.data_as(
+                            ctypes.POINTER(ctypes.c_uint32)
+                        ),
+                        cap, mult,
+                    )
                 )
-            )
-            return got, (state, freq)
+                return got, (state, freq)
 
-        got, keys, (state, freq) = self._export_with_retry(
-            since_version, _fill
-        )
-        return keys[:got], state[:got], freq[:got], mult
+            got, keys, (state, freq) = self._export_with_retry(
+                since_version, _fill
+            )
+            # a concurrent optimizer step may have widened rows after
+            # we sampled mult — their moments would be silently clipped;
+            # re-export at the wider width instead
+            if state_mult is None and self.state_mult > mult:
+                continue
+            return keys[:got], state[:got], freq[:got], mult
 
     def import_full(self, keys, state, freq, state_mult: int):
         k = self._keys(keys)

@@ -1,0 +1,112 @@
+"""Initial hyperparameter/config suggestions from job resources.
+
+Reference parity: dlrover/python/master/hyperparams/
+simple_strategy_generator.py:40 (`SimpleStrategyGenerator` — suggests
+DataLoader batch size / worker count and optimizer knobs from the
+node's resource profile before training starts) and the runtime
+`ParallelConfig`/`DataLoaderConfig` push (common/grpc.py:434-477 →
+agent ParalConfigTuner → ElasticDataLoader.update_batch_size).
+
+TPU design: suggestions cover the host input pipeline (process count,
+prefetch depth, per-host batch) and a starting MeshSpec given device
+count + model memory footprint; the master pushes updates through the
+existing config channel the ElasticDataLoader polls.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class DataLoaderConfig:
+    """Reference common/grpc.py DataLoaderConfig."""
+
+    batch_size: int = 0
+    num_workers: int = 2
+    prefetch: int = 2
+    pin_host_memory: bool = True
+
+
+@dataclass
+class ParallelConfig:
+    """Mesh suggestion pushed to the trainer (reference ParallelConfig)."""
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    grad_accum: int = 1
+
+
+class SimpleStrategyGenerator:
+    """Heuristic first-guess configs; the auto-tuner refines them."""
+
+    # usable fraction of HBM after runtime buffers
+    _HBM_USABLE = 0.85
+
+    def __init__(
+        self,
+        num_devices: int,
+        hbm_gb_per_device: float = 16.0,
+        host_cpu_count: int = 8,
+        host_mem_gb: float = 64.0,
+    ):
+        self.num_devices = num_devices
+        self.hbm_gb = hbm_gb_per_device
+        self.host_cpu = host_cpu_count
+        self.host_mem_gb = host_mem_gb
+
+    def suggest_dataloader(
+        self, sample_bytes: int, global_batch_size: int
+    ) -> DataLoaderConfig:
+        """IO workers sized to CPUs (leave 2 for the runtime), prefetch
+        bounded by host memory."""
+        workers = max(1, min(self.host_cpu - 2, 8))
+        batch_bytes = sample_bytes * global_batch_size
+        prefetch = max(
+            1,
+            min(
+                4,
+                int(self.host_mem_gb * 1e9 * 0.1 / max(batch_bytes, 1)),
+            ),
+        )
+        return DataLoaderConfig(
+            batch_size=global_batch_size,
+            num_workers=workers,
+            prefetch=prefetch,
+        )
+
+    def suggest_parallel(
+        self,
+        num_params: int,
+        seq_len: int = 2048,
+        bytes_per_param: int = 2,
+        optimizer_mult: float = 3.0,
+    ) -> ParallelConfig:
+        """Pick (data, fsdp, tensor): shard params only as much as
+        memory requires (fsdp), give the rest to data parallelism —
+        data-parallel collectives overlap best and tensor parallelism
+        only pays once a single chip can't hold a layer's working set.
+        """
+        state_gb = num_params * bytes_per_param * (1 + optimizer_mult) / 1e9
+        usable = self.hbm_gb * self._HBM_USABLE
+        fsdp = 1
+        while fsdp < self.num_devices and state_gb / fsdp > usable * 0.6:
+            fsdp *= 2
+        data = max(1, self.num_devices // fsdp)
+        cfg = ParallelConfig(data=data, fsdp=fsdp)
+        logger.info(
+            "suggested parallel config for %.1fB params on %d devices: %s",
+            num_params / 1e9,
+            self.num_devices,
+            cfg,
+        )
+        return cfg
+
+    def suggest_optimizer(self, num_params: int) -> Dict[str, float]:
+        """muP-flavoured starting LR: scale inversely with width proxy."""
+        width_proxy = max(num_params, 1) ** 0.5
+        lr = min(3e-4, 3e-4 * (2.5e7 / width_proxy))
+        return {"learning_rate": lr, "weight_decay": 0.1, "warmup": 2000}

@@ -55,9 +55,11 @@ class Accelerated:
     eval_step: Optional[Callable] = None
     state_shardings: Any = None
 
-    def shard_batch(self, batch) -> Any:
+    def shard_batch(self, batch, with_accum: bool = True) -> Any:
+        """Place a host batch on the mesh. `with_accum=False` for
+        unfolded batches (eval) when the train strategy accumulates."""
         spec = P(*self.strategy.batch_spec)
-        if self.strategy.grad_accum > 1:
+        if self.strategy.grad_accum > 1 and with_accum:
             spec = P(None, *self.strategy.batch_spec)
 
         def _put(x):

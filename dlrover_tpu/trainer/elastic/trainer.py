@@ -138,7 +138,7 @@ class ElasticTrainer:
         return self.acc.train_step(state, batch)
 
     def eval_step(self, state: Any, batch: Any) -> Dict:
-        sharded = self.acc.shard_batch(batch)
+        sharded = self.acc.shard_batch(batch, with_accum=False)
         return self.acc.eval_step(state, sharded)
 
     # -- elasticity --------------------------------------------------------
@@ -167,10 +167,6 @@ class ElasticTrainer:
             state,
         )
         self._build()
-        from dlrover_tpu.parallel.sharding import tree_shardings
+        from dlrover_tpu.parallel.sharding import shard_tree
 
-        abstract = jax.eval_shape(self.acc.init, jax.random.PRNGKey(0))
-        shardings = tree_shardings(abstract, self.acc.mesh, self._rules)
-        return jax.tree_util.tree_map(
-            jax.device_put, host_state, shardings
-        )
+        return shard_tree(host_state, self.acc.mesh, self._rules)

@@ -1,0 +1,13 @@
+from dlrover_tpu.trainer.flash_checkpoint.engine import (  # noqa: F401
+    CheckpointEngine,
+    Checkpointer,
+    StorageType,
+)
+from dlrover_tpu.trainer.flash_checkpoint.formats import (  # noqa: F401
+    FullCheckpointer,
+    OrbaxCheckpointer,
+    ShardedCheckpointer,
+)
+from dlrover_tpu.trainer.flash_checkpoint.replica import (  # noqa: F401
+    CkptReplicaManager,
+)

@@ -136,8 +136,11 @@ class CheckpointEngine:
         job_name: Optional[str] = None,
         node_rank: Optional[int] = None,
         local_saver: bool = True,
+        replica_manager=None,
     ):
         self.checkpoint_dir = checkpoint_dir
+        self.replica_manager = replica_manager
+        self._replica_thread = None
         self.storage = storage or get_checkpoint_storage()
         self.job_name = job_name or os.environ.get(
             NodeEnv.JOB_NAME, "default"
@@ -191,6 +194,27 @@ class CheckpointEngine:
             self.shm_handler.save_flat_state(
                 step, flat, save_path=self.checkpoint_dir, aux=aux
             )
+        if self.replica_manager is not None:
+            # ship the replica off-host in the background (replica.py:
+            # the reference backs up to a peer's shm asynchronously
+            # too). If the previous backup is still in flight, skip
+            # this round — never block the milliseconds fast path.
+            if (
+                self._replica_thread is None
+                or not self._replica_thread.is_alive()
+            ):
+                self._replica_thread = threading.Thread(
+                    target=self.replica_manager.backup,
+                    args=(step, flat, aux),
+                    daemon=True,
+                )
+                self._replica_thread.start()
+            else:
+                logger.info(
+                    "replica backup for step %d skipped "
+                    "(previous still in flight)",
+                    step,
+                )
         return time.monotonic() - t0
 
     def save_to_storage(self, step: int, state: Any) -> float:
@@ -244,6 +268,13 @@ class CheckpointEngine:
             step, state = self.load_from_storage(
                 disk_step if disk_step >= 0 else None
             )
+        if state is None and self.replica_manager is not None:
+            # node replacement: local shm is empty and storage has no
+            # shard — pull this rank's replica (reference replica.py:193
+            # gathers the lost shard from the peer node's shm)
+            step, state = self.replica_manager.restore_state()
+            if state is not None:
+                logger.info("restored step %d from replica", step)
         if state is not None and target is not None:
             state = restore_to_shardings(state, target)
         return step, state
@@ -263,6 +294,12 @@ class CheckpointEngine:
         return False
 
     def close(self):
+        if (
+            self._replica_thread is not None
+            and self._replica_thread.is_alive()
+        ):
+            # let an in-flight backup commit rather than die mid-write
+            self._replica_thread.join(timeout=30.0)
         if self._local_saver is not None:
             self._local_saver.stop()
             self._ipc.stop()

@@ -167,6 +167,23 @@ class Trainer:
         for cb in self.callbacks:
             cb.on_train_begin(self, state)
 
+        # on resume, don't replay already-consumed batches: loaders
+        # with their own resumable sampler (ElasticDataLoader) handle
+        # this via sampler state; plain iterables get skipped here.
+        skip = 0
+        if self.global_step > 0 and not hasattr(
+            self.train_data, "load_state_dict"
+        ):
+            try:
+                n_batches = len(self.train_data)
+            except TypeError:
+                n_batches = 0
+            skip = (
+                self.global_step % n_batches
+                if n_batches
+                else self.global_step
+            )
+
         window_t0 = time.monotonic()
         window_steps = 0
         stop = False
@@ -177,6 +194,9 @@ class Trainer:
                 if hasattr(self.train_data, "set_epoch"):
                     self.train_data.set_epoch(epoch)
                 for batch in self.train_data:
+                    if skip > 0:
+                        skip -= 1
+                        continue
                     state, metrics = self.et.step(state, batch)
                     jax.block_until_ready(
                         metrics.get("loss", metrics)

@@ -1,0 +1,170 @@
+"""Numeric health: loss-spike detection, NaN/Inf checks, run comparison.
+
+Reference parity: atorch loss-spike dump (atorch/atorch/utils/
+loss_spike_utils.py — record losses, detect spikes, dump offending
+sample ids), numeric checker (utils/numberic_checker.py — compare
+module outputs between two runs), plus the step-consistency votes the
+flash-checkpoint engine takes before saving.
+
+TPU notes: checks run on host values (post device_get); under jit use
+`jax.debug.callback` or check the returned metrics — never Python
+branches on traced values.
+"""
+
+import json
+import math
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class LossSpikeDetector:
+    """Rolling-statistics spike detector with incident dumps.
+
+    A loss is a spike when it exceeds mean + `sigma` * std of the last
+    `window` losses (and the window is warm). Incidents append JSON
+    lines (step, loss, context — e.g. sample ids) to `dump_dir`, the
+    reference's "dump sample ids so bad data can be skipped on replay".
+    """
+
+    def __init__(
+        self,
+        window: int = 100,
+        sigma: float = 6.0,
+        min_warm: int = 20,
+        dump_dir: Optional[str] = None,
+        on_spike: Optional[Callable[[int, float], None]] = None,
+    ):
+        self.window = window
+        self.sigma = sigma
+        self.min_warm = min_warm
+        self.dump_dir = dump_dir
+        self.on_spike = on_spike
+        self._losses: deque = deque(maxlen=window)
+        self.spikes: List[Tuple[int, float]] = []
+
+    def observe(
+        self, step: int, loss: float, context: Optional[Dict] = None
+    ) -> bool:
+        """Record a loss; True if it's a spike."""
+        loss = float(loss)
+        is_spike = False
+        if not math.isfinite(loss):
+            is_spike = True
+        elif len(self._losses) >= self.min_warm:
+            mean = sum(self._losses) / len(self._losses)
+            var = sum((x - mean) ** 2 for x in self._losses) / len(
+                self._losses
+            )
+            std = math.sqrt(var)
+            # floor the std at 1% of the mean: near-constant loss
+            # curves must not flag ordinary jitter as spikes
+            floor = max(abs(mean) * 0.01, 1e-8)
+            if loss > mean + self.sigma * max(std, floor):
+                is_spike = True
+        if is_spike:
+            self.spikes.append((step, loss))
+            logger.warning("loss spike at step %d: %g", step, loss)
+            if self.dump_dir:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                with open(
+                    os.path.join(self.dump_dir, "loss_spikes.jsonl"), "a"
+                ) as f:
+                    f.write(
+                        json.dumps(
+                            {
+                                "step": step,
+                                "loss": loss,
+                                "time": time.time(),
+                                "context": context or {},
+                            }
+                        )
+                        + "\n"
+                    )
+            if self.on_spike:
+                self.on_spike(step, loss)
+        else:
+            self._losses.append(loss)  # spikes don't poison the stats
+        return is_spike
+
+
+def find_nonfinite(tree: Any, prefix: str = "") -> List[str]:
+    """Paths of leaves containing NaN/Inf (host-side check)."""
+    import jax
+
+    bad = []
+
+    def _leaf(path, leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+            parts = []
+            for p in path:
+                parts.append(
+                    str(
+                        getattr(p, "key", None)
+                        or getattr(p, "idx", None)
+                        or getattr(p, "name", "")
+                    )
+                )
+            bad.append(prefix + "/".join(parts))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(_leaf, tree)
+    return bad
+
+
+def assert_finite(tree: Any, what: str = "tree"):
+    bad = find_nonfinite(tree)
+    if bad:
+        raise FloatingPointError(
+            f"non-finite values in {what}: {bad[:10]}"
+            + (f" (+{len(bad) - 10} more)" if len(bad) > 10 else "")
+        )
+
+
+class NumericChecker:
+    """Record-and-compare tensors across two runs (reference
+    numberic_checker.py compares per-module outputs between a baseline
+    and an optimized run to localize numeric drift)."""
+
+    def __init__(self, atol: float = 1e-5, rtol: float = 1e-5):
+        self.atol = atol
+        self.rtol = rtol
+        self._baseline: Dict[str, np.ndarray] = {}
+
+    def record(self, name: str, value):
+        import jax
+
+        self._baseline[name] = np.asarray(jax.device_get(value)).copy()
+
+    def compare(self, name: str, value) -> Dict[str, float]:
+        import jax
+
+        if name not in self._baseline:
+            raise KeyError(f"no baseline recorded for {name!r}")
+        ref = self._baseline[name]
+        got = np.asarray(jax.device_get(value))
+        diff = np.abs(got.astype(np.float64) - ref.astype(np.float64))
+        denom = np.maximum(np.abs(ref), 1e-12)
+        report = {
+            "max_abs": float(diff.max(initial=0.0)),
+            "max_rel": float((diff / denom).max(initial=0.0)),
+            "match": bool(
+                np.allclose(got, ref, atol=self.atol, rtol=self.rtol)
+            ),
+        }
+        if not report["match"]:
+            logger.warning("numeric drift on %s: %s", name, report)
+        return report
+
+    def save(self, path: str):
+        np.savez(path, **self._baseline)
+
+    def load(self, path: str):
+        with np.load(path) as npz:
+            self._baseline = {k: npz[k] for k in npz.files}

@@ -1,0 +1,155 @@
+"""Profiling + numeric-health + stats-collection tests."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.master.stats import (
+    JobMetricCollector,
+    LocalStatsReporter,
+    ModelMetrics,
+)
+from dlrover_tpu.master.strategy_generator import SimpleStrategyGenerator
+from dlrover_tpu.utils.numeric import (
+    LossSpikeDetector,
+    NumericChecker,
+    assert_finite,
+    find_nonfinite,
+)
+from dlrover_tpu.utils.prof import (
+    StepProfiler,
+    Timer,
+    cost_analysis,
+)
+
+
+class TestTimer:
+    def test_accumulates(self):
+        t = Timer()
+        for _ in range(3):
+            with t.record("fwd"):
+                pass
+        assert t.counts["fwd"] == 3
+        assert t.summary()["fwd"]["count"] == 3
+
+
+class TestStepProfiler:
+    def test_throughput_and_mfu(self):
+        p = StepProfiler(
+            tokens_per_step=1000,
+            flops_per_step=1e9,
+            peak_tflops=1.0,
+        )
+        import time
+
+        for i in range(3):
+            with p.step(i):
+                time.sleep(0.01)
+        assert p.mean_step_s > 0.005
+        assert p.tokens_per_sec > 0
+        assert 0 < p.mfu < 1.0
+
+
+class TestCostAnalysis:
+    def test_matmul_flops(self):
+        a = jnp.ones((64, 64), jnp.float32)
+
+        def f(x):
+            return x @ x
+
+        costs = cost_analysis(f, a)
+        # 2*n^3 flops for a square matmul
+        assert costs["flops"] >= 2 * 64**3 * 0.9
+
+
+class TestLossSpike:
+    def test_detects_spike_and_dumps(self, tmp_path):
+        det = LossSpikeDetector(
+            window=50, sigma=4.0, min_warm=10, dump_dir=str(tmp_path)
+        )
+        rng = np.random.RandomState(0)
+        for i in range(30):
+            assert not det.observe(i, 1.0 + rng.randn() * 0.01)
+        assert det.observe(30, 50.0)
+        assert det.observe(31, float("nan"))
+        lines = open(tmp_path / "loss_spikes.jsonl").read().splitlines()
+        assert len(lines) == 2
+        assert json.loads(lines[0])["step"] == 30
+
+    def test_spike_does_not_poison_stats(self):
+        det = LossSpikeDetector(window=50, sigma=4.0, min_warm=10)
+        for i in range(20):
+            det.observe(i, 1.0)
+        det.observe(20, 100.0)
+        # next normal loss is still normal
+        assert not det.observe(21, 1.01)
+
+
+class TestNumeric:
+    def test_find_nonfinite(self):
+        tree = {
+            "ok": jnp.ones((3,)),
+            "bad": jnp.array([1.0, float("inf")]),
+        }
+        bad = find_nonfinite(tree)
+        assert bad == ["bad"]
+        try:
+            assert_finite(tree)
+            raise AssertionError("should have raised")
+        except FloatingPointError:
+            pass
+
+    def test_checker_compare(self):
+        c = NumericChecker(atol=1e-6, rtol=1e-6)
+        x = jnp.arange(6.0)
+        c.record("layer0", x)
+        assert c.compare("layer0", x)["match"]
+        rep = c.compare("layer0", x + 1e-3)
+        assert not rep["match"]
+        assert rep["max_abs"] > 1e-4
+
+
+class TestStatsCollection:
+    def test_collect_and_report(self, tmp_path):
+        rep = LocalStatsReporter(str(tmp_path))
+        col = JobMetricCollector(
+            "job1", reporters=[rep], report_interval=0.0
+        )
+        col.collect_model_info(num_params=1000, batch_size=8)
+        col.collect_node_resource(0, cpu_percent=50, mem_gb=4)
+        col.collect_node_resource(1, cpu_percent=70, mem_gb=4)
+        col.maybe_report_runtime(global_step=100, samples_per_sec=12.5)
+        runtime = [
+            json.loads(ln)
+            for ln in open(tmp_path / "runtime.jsonl")
+        ]
+        assert runtime[0]["num_nodes"] == 2
+        assert runtime[0]["samples_per_sec"] == 12.5
+        model = [json.loads(ln) for ln in open(tmp_path / "model.jsonl")]
+        assert model[0]["num_params"] == 1000
+        # duplicate model info is not re-reported
+        col.collect_model_info(num_params=1000, batch_size=8)
+        assert (
+            len(open(tmp_path / "model.jsonl").read().splitlines()) == 1
+        )
+
+
+class TestStrategyGenerator:
+    def test_parallel_suggestion_shards_when_too_big(self):
+        g = SimpleStrategyGenerator(
+            num_devices=8, hbm_gb_per_device=16.0
+        )
+        small = g.suggest_parallel(num_params=100_000_000)
+        assert small.fsdp == 1 and small.data == 8
+        big = g.suggest_parallel(num_params=13_000_000_000)
+        assert big.fsdp > 1
+        assert big.data * big.fsdp == 8
+
+    def test_dataloader_suggestion(self):
+        g = SimpleStrategyGenerator(8, host_cpu_count=16)
+        cfg = g.suggest_dataloader(sample_bytes=4096, global_batch_size=64)
+        assert 1 <= cfg.num_workers <= 8
+        assert cfg.prefetch >= 1
