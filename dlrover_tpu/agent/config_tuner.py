@@ -1,0 +1,97 @@
+"""Agent-side parallel-config tuner: master push → file → trainer.
+
+Reference parity: dlrover/python/elastic_agent/config/paral_config_tuner.py
+(`ParalConfigTuner`) — an agent thread polls the master for a new
+`ParallelConfig` and writes it to a well-known JSON file; the trainer
+(ElasticDataLoader, grad-accum schedule) picks it up without holding a
+master connection of its own.
+
+On TPU the file channel matters more than on GPU: the training process
+is a single jitted SPMD program per host, and re-config (batch size,
+grad-accum) must land at a step boundary — the trainer polls the file
+between steps, never inside jit.
+"""
+
+import json
+import os
+import threading
+from dataclasses import asdict
+from typing import Optional
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.common.log import default_logger as logger
+
+ENV_CONFIG_PATH = "DLROVER_TPU_PARAL_CONFIG_PATH"
+
+
+def default_config_path(node_id: int = 0) -> str:
+    return os.environ.get(
+        ENV_CONFIG_PATH,
+        os.path.join("/tmp", "dlrover_tpu", f"paral_config_{node_id}.json"),
+    )
+
+
+def read_paral_config(path: str) -> Optional[msg.ParallelConfig]:
+    """Trainer-side read; None if the tuner has not written yet."""
+    try:
+        with open(path, "r") as f:
+            return msg.ParallelConfig(**json.load(f))
+    except (OSError, ValueError, TypeError):
+        return None
+
+
+class ParalConfigTuner:
+    """Polls the master and mirrors newer configs to the config file."""
+
+    def __init__(
+        self,
+        client: Optional[MasterClient] = None,
+        node_id: int = 0,
+        interval: float = 30.0,
+        path: Optional[str] = None,
+    ):
+        self._client = client or MasterClient.singleton()
+        self._interval = interval
+        self.path = path or default_config_path(node_id)
+        self._version = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> bool:
+        """Fetch + write if the master has a newer config. Returns
+        whether a new version was written."""
+        try:
+            cfg = self._client.get_paral_config()
+        except Exception:
+            return False
+        if cfg.version <= self._version:
+            return False
+        self._version = cfg.version
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(asdict(cfg), f)
+        os.replace(tmp, self.path)  # atomic swap: readers never see partial
+        logger.info(
+            "paral config v%d -> %s", cfg.version, self.path
+        )
+        return True
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="paral-config-tuner", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            self.poll_once()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

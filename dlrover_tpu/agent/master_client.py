@@ -300,6 +300,67 @@ class MasterClient:
         resp = self.get(msg.ElasticRunConfigQuery())
         return resp.configs if resp else {}
 
+    # ---- elastic PS / topology ---------------------------------------------
+
+    def register_ps(self, addr: str, alive: bool = True) -> int:
+        """Register this node as a sparse embedding-shard host; returns
+        the new global cluster version."""
+        resp = self.report(
+            msg.PsRegister(node_id=self.node_id, addr=addr, alive=alive)
+        )
+        return resp.payload.version if resp and resp.payload else 0
+
+    def get_ps_cluster(self) -> msg.PsClusterResponse:
+        resp = self.get(msg.PsClusterQuery())
+        return resp or msg.PsClusterResponse()
+
+    def update_cluster_version(
+        self, version: int, version_type: str = "local"
+    ):
+        return self.report(
+            msg.ClusterVersionReport(
+                version_type=version_type,
+                version=version,
+                node_type=self.node_type,
+                node_id=self.node_id,
+            )
+        )
+
+    def get_cluster_version(self, version_type: str = "global") -> int:
+        resp = self.get(
+            msg.ClusterVersionQuery(
+                version_type=version_type,
+                node_type=self.node_type,
+                node_id=self.node_id,
+            )
+        )
+        return resp.version if resp else 0
+
+    def report_topology(
+        self,
+        node_rank: int = -1,
+        hostname: str = "",
+        slice_id: int = 0,
+        coords=(-1, -1, -1),
+        process_num: int = 1,
+        bandwidth_gbps: float = 0.0,
+    ):
+        return self.report(
+            msg.TopologyReport(
+                node_id=self.node_id,
+                node_rank=node_rank,
+                process_num=process_num,
+                hostname=hostname,
+                slice_id=slice_id,
+                coords=tuple(coords),
+                bandwidth_gbps=bandwidth_gbps,
+            )
+        )
+
+    def get_topology_order(self) -> List[int]:
+        resp = self.get(msg.TopologyQuery())
+        return resp.sorted_node_ids if resp else []
+
     # ---- singleton -------------------------------------------------------
 
     @classmethod

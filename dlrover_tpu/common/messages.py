@@ -379,3 +379,70 @@ class DiagnosisReport(BaseRequest):
     data_type: str = ""
     content: str = ""
     timestamp: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# elastic PS (sparse embedding-shard hosts) + topology
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PsRegister(BaseRequest):
+    node_id: int = 0
+    addr: str = ""
+    alive: bool = True
+
+
+@dataclass
+class PsClusterQuery(BaseRequest):
+    pass
+
+
+@dataclass
+class PsClusterResponse:
+    version: int = 0
+    ps_addrs: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ClusterVersionReport(BaseRequest):
+    version_type: str = "local"  # global | local | restored
+    version: int = 0
+    node_type: str = "worker"
+    node_id: int = 0
+
+
+@dataclass
+class ClusterVersionQuery(BaseRequest):
+    version_type: str = "global"
+    node_type: str = "worker"
+    node_id: int = 0
+
+
+@dataclass
+class ClusterVersionResponse:
+    version: int = 0
+
+
+@dataclass
+class TopologyReport(BaseRequest):
+    """Host interconnect position (slice + torus coords) for placement."""
+
+    node_id: int = 0
+    node_rank: int = -1
+    process_num: int = 1
+    hostname: str = ""
+    slice_id: int = 0
+    coords: Tuple[int, int, int] = (-1, -1, -1)
+    bandwidth_gbps: float = 0.0
+
+
+@dataclass
+class TopologyQuery(BaseRequest):
+    pass
+
+
+@dataclass
+class TopologyResponse:
+    # node ids in slice-major snake order (ICI-contiguous rank order)
+    sorted_node_ids: List[int] = field(default_factory=list)

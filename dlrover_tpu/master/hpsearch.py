@@ -1,0 +1,154 @@
+"""Bayesian-optimization hyperparameter search.
+
+Reference parity: dlrover/go/brain hpsearch client surface +
+dlrover/python/brain/hpsearch/bo.py:30 (`BayesianOptimizer`) — suggest
+the next hyperparameter point from past (point, objective) observations.
+Also the search core behind the acceleration engine's strategy tuning
+(atorch auto/engine/sg_algo/{bayes_opt_sg.py,hebo}).
+
+Pure numpy: a GP surrogate (RBF kernel + jitter) with expected
+improvement acquisition over a random candidate pool. Good enough for
+the low-dimensional spaces we tune (batch size, remat policy, mesh
+shape, learning rate) without pulling in skopt/HEBO.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class SearchSpace:
+    """Box bounds per dimension; integer dims are rounded on suggest."""
+
+    names: List[str]
+    lows: List[float]
+    highs: List[float]
+    integer: List[bool] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.integer:
+            self.integer = [False] * len(self.names)
+
+    @property
+    def dim(self) -> int:
+        return len(self.names)
+
+    def clip_round(self, x: np.ndarray) -> np.ndarray:
+        x = np.clip(x, self.lows, self.highs)
+        for i, isint in enumerate(self.integer):
+            if isint:
+                x[..., i] = np.round(x[..., i])
+        return x
+
+    def to_dict(self, x: np.ndarray) -> Dict[str, float]:
+        return {
+            n: (int(v) if isint else float(v))
+            for n, v, isint in zip(self.names, x, self.integer)
+        }
+
+
+def _rbf(a: np.ndarray, b: np.ndarray, ls: np.ndarray) -> np.ndarray:
+    d = (a[:, None, :] - b[None, :, :]) / ls
+    return np.exp(-0.5 * np.sum(d * d, axis=-1))
+
+
+class BayesianOptimizer:
+    """Minimize an objective over a SearchSpace.
+
+    tell() records observations; suggest() returns the next point —
+    random until `n_init` observations exist, then EI over the GP.
+    """
+
+    def __init__(
+        self,
+        space: SearchSpace,
+        n_init: int = 4,
+        n_candidates: int = 512,
+        seed: int = 0,
+    ):
+        self.space = space
+        self.n_init = n_init
+        self.n_candidates = n_candidates
+        self._rng = np.random.default_rng(seed)
+        self._x: List[np.ndarray] = []
+        self._y: List[float] = []
+
+    # ---- observations ----------------------------------------------------
+
+    def tell(self, point: Dict[str, float], objective: float):
+        x = np.array(
+            [float(point[n]) for n in self.space.names], dtype=np.float64
+        )
+        self._x.append(x)
+        self._y.append(float(objective))
+
+    @property
+    def best(self) -> Optional[Tuple[Dict[str, float], float]]:
+        if not self._y:
+            return None
+        i = int(np.argmin(self._y))
+        return self.space.to_dict(self._x[i]), self._y[i]
+
+    # ---- acquisition -----------------------------------------------------
+
+    def _random_points(self, n: int) -> np.ndarray:
+        u = self._rng.random((n, self.space.dim))
+        lows = np.asarray(self.space.lows)
+        highs = np.asarray(self.space.highs)
+        return self.space.clip_round(lows + u * (highs - lows))
+
+    def suggest(self) -> Dict[str, float]:
+        if len(self._y) < self.n_init:
+            return self.space.to_dict(self._random_points(1)[0])
+
+        X = np.stack(self._x)
+        y = np.asarray(self._y)
+        y_mean, y_std = y.mean(), y.std() + 1e-12
+        yn = (y - y_mean) / y_std
+        # median-heuristic lengthscale per dim
+        span = np.asarray(self.space.highs) - np.asarray(self.space.lows)
+        ls = np.maximum(span * 0.2, 1e-9)
+
+        K = _rbf(X, X, ls) + 1e-6 * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+
+        cand = self._random_points(self.n_candidates)
+        Ks = _rbf(cand, X, ls)
+        mu = Ks @ alpha
+        v = np.linalg.solve(L, Ks.T)
+        var = np.maximum(1.0 - np.sum(v * v, axis=0), 1e-12)
+        sigma = np.sqrt(var)
+
+        best = yn.min()
+        # expected improvement (minimization)
+        z = (best - mu) / sigma
+        ei = sigma * (z * _norm_cdf(z) + _norm_pdf(z))
+        return self.space.to_dict(cand[int(np.argmax(ei))])
+
+
+def _norm_pdf(z: np.ndarray) -> np.ndarray:
+    return np.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
+
+
+def _norm_cdf(z: np.ndarray) -> np.ndarray:
+    return 0.5 * (1.0 + _erf(z / math.sqrt(2.0)))
+
+
+def _erf(x: np.ndarray) -> np.ndarray:
+    # Abramowitz & Stegun 7.1.26 — keeps numpy-only (np.erf is scipy's)
+    sign = np.sign(x)
+    x = np.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * x)
+    poly = t * (
+        0.254829592
+        + t
+        * (
+            -0.284496736
+            + t * (1.421413741 + t * (-1.453152027 + t * 1.061405429))
+        )
+    )
+    return sign * (1.0 - poly * np.exp(-x * x))

@@ -16,7 +16,9 @@ from dlrover_tpu.common.comm import (
 )
 from dlrover_tpu.common.constants import NodeStatus
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.elastic_ps import ElasticPsService
 from dlrover_tpu.master.kv_store import KVStoreService, SyncService
+from dlrover_tpu.master.net_topology import NetworkTopology, NodeTopologyMeta
 from dlrover_tpu.master.monitor.error_monitor import (
     ErrorRecord,
     SimpleErrorMonitor,
@@ -47,6 +49,8 @@ class MasterServicer(MasterServicerBase):
         self.error_monitor = error_monitor or SimpleErrorMonitor()
         self.kv_store = kv_store or KVStoreService()
         self.sync_service = sync_service or SyncService()
+        self.elastic_ps = ElasticPsService()
+        self.topology = NetworkTopology()
         self.rdzv_managers = rdzv_managers or {
             "training": ElasticTrainingRendezvousManager(),
             "network-check": NetworkCheckRendezvousManager(),
@@ -139,6 +143,27 @@ class MasterServicer(MasterServicerBase):
             return ReplyEnvelope(
                 payload=msg.ElasticRunConfigResponse(
                     configs=dict(self.run_configs)
+                )
+            )
+        if isinstance(req, msg.PsClusterQuery):
+            return ReplyEnvelope(
+                payload=msg.PsClusterResponse(
+                    version=self.elastic_ps.get_version("global"),
+                    ps_addrs=self.elastic_ps.alive_ps(),
+                )
+            )
+        if isinstance(req, msg.ClusterVersionQuery):
+            return ReplyEnvelope(
+                payload=msg.ClusterVersionResponse(
+                    version=self.elastic_ps.get_version(
+                        req.version_type, req.node_type, req.node_id
+                    )
+                )
+            )
+        if isinstance(req, msg.TopologyQuery):
+            return ReplyEnvelope(
+                payload=msg.TopologyResponse(
+                    sorted_node_ids=self.topology.sorted_node_ids()
                 )
             )
         return ReplyEnvelope(
@@ -256,6 +281,32 @@ class MasterServicer(MasterServicerBase):
             return ReplyEnvelope()
         if isinstance(req, msg.DiagnosisReport):
             self.run_configs.setdefault("diagnosis", "")
+            return ReplyEnvelope()
+        if isinstance(req, msg.PsRegister):
+            if req.alive:
+                v = self.elastic_ps.register_ps(req.node_id, req.addr)
+            else:
+                v = self.elastic_ps.deregister_ps(req.node_id)
+            return ReplyEnvelope(
+                payload=msg.ClusterVersionResponse(version=v)
+            )
+        if isinstance(req, msg.ClusterVersionReport):
+            self.elastic_ps.update_version(
+                req.version_type, req.version, req.node_type, req.node_id
+            )
+            return ReplyEnvelope()
+        if isinstance(req, msg.TopologyReport):
+            self.topology.report(
+                NodeTopologyMeta(
+                    node_id=req.node_id,
+                    node_rank=req.node_rank,
+                    process_num=req.process_num,
+                    hostname=req.hostname,
+                    slice_id=req.slice_id,
+                    coords=tuple(req.coords),
+                    bandwidth_gbps=req.bandwidth_gbps,
+                )
+            )
             return ReplyEnvelope()
         return ReplyEnvelope(
             success=False, reason=f"unknown report: {type(req).__name__}"

@@ -1,0 +1,233 @@
+"""Quantization ops: Pallas int8 block kernels + compressed collectives.
+
+Reference parity: ATorch's CUDA quantization suite
+(atorch/ops/csrc/quantization/{quantize.cu,dequantize.cu,quant_reduce.cu,
+swizzled_quantize.cu}) — block-wise int8/fp8 quantize/dequantize and a
+quantized gradient reduction used to halve NVLink/IB bytes in ZeRO.
+
+TPU design: quantize/dequantize are Pallas kernels (VPU elementwise +
+per-block absmax reduction, tiles staged HBM→VMEM); the quantized
+reduction is a ring reduce-scatter under `shard_map` whose per-hop
+payload is int8 blocks + f32 scales — `ppermute` moves 1/4 the bytes of
+an f32 ring over ICI, and dequant-accumulate runs in f32 on the VPU.
+CPU backend runs the same kernels in interpret mode (tests)."""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # jax >= 0.8 moved shard_map out of experimental
+    from jax import shard_map as _shard_map
+
+    shard_map = functools.partial(_shard_map, check_vma=False)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    shard_map = functools.partial(_shard_map, check_rep=False)
+
+INT8_MAX = 127.0
+DEFAULT_BLOCK = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# pallas kernels
+# ---------------------------------------------------------------------------
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[...].astype(jnp.float32)            # [bm, block]
+    amax = jnp.max(jnp.abs(x), axis=1)            # [bm]
+    scale = jnp.where(amax > 0, amax / INT8_MAX, 1.0)
+    q = jnp.clip(
+        jnp.round(x / scale[:, None]), -INT8_MAX, INT8_MAX
+    )
+    q_ref[...] = q.astype(jnp.int8)
+    s_ref[...] = scale[:, None]
+
+
+def _dequant_kernel(q_ref, s_ref, x_ref, *, out_dtype):
+    q = q_ref[...].astype(jnp.float32)
+    x_ref[...] = (q * s_ref[...]).astype(out_dtype)
+
+
+def quantize_int8(
+    x: jax.Array, block: int = DEFAULT_BLOCK, block_m: int = 256
+) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-block int8 quantization along the last dim.
+
+    x: [m, n] with n % block == 0 → (q int8 [m, n], scales f32 [m, n/block]).
+    """
+    m, n = x.shape
+    assert n % block == 0, (n, block)
+    bm = min(block_m, m)
+    assert m % bm == 0, (m, bm)
+    grid = (m // bm, n // block)
+    q, s = pl.pallas_call(
+        _quant_kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, block), lambda i, j: (i, j))],
+        out_specs=[
+            pl.BlockSpec((bm, block), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), jnp.int8),
+            jax.ShapeDtypeStruct((m, n // block), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x)
+    return q, s
+
+
+def dequantize_int8(
+    q: jax.Array,
+    scales: jax.Array,
+    out_dtype=jnp.float32,
+    block_m: int = 256,
+) -> jax.Array:
+    m, n = q.shape
+    block = n // scales.shape[1]
+    bm = min(block_m, m)
+    assert m % bm == 0, (m, bm)
+    grid = (m // bm, n // block)
+    return pl.pallas_call(
+        functools.partial(_dequant_kernel, out_dtype=out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, block), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, block), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        interpret=_interpret(),
+    )(q, scales)
+
+
+def quantize_any(x: jax.Array, block: int = DEFAULT_BLOCK):
+    """Quantize an arbitrary-shaped tensor (flattened + padded to block)."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    q, s = quantize_int8(flat.reshape(1, -1), block=block, block_m=1)
+    return q, s, x.shape, pad
+
+
+def dequantize_any(q, s, shape, pad, out_dtype=jnp.float32):
+    flat = dequantize_int8(q, s, out_dtype=out_dtype, block_m=1).reshape(-1)
+    if pad:
+        flat = flat[:-pad]
+    return flat.reshape(shape)
+
+
+def stochastic_round_int8(
+    x: jax.Array, key: jax.Array, block: int = DEFAULT_BLOCK
+) -> Tuple[jax.Array, jax.Array]:
+    """Unbiased int8 quantization (E[dequant] == x): floor + bernoulli on
+    the fractional part. Used for gradient compression where rounding
+    bias would accumulate across steps (quantization_optimizer.cu's
+    stochastic mode)."""
+    m, n = x.shape
+    amax = jnp.max(
+        jnp.abs(x.reshape(m, n // block, block)), axis=2
+    ).astype(jnp.float32)
+    scale = jnp.where(amax > 0, amax / INT8_MAX, 1.0)
+    xs = x.astype(jnp.float32) / jnp.repeat(scale, block, axis=1)
+    lo = jnp.floor(xs)
+    frac = xs - lo
+    up = jax.random.uniform(key, x.shape) < frac
+    q = jnp.clip(lo + up.astype(jnp.float32), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), scale
+
+
+# ---------------------------------------------------------------------------
+# compressed collectives (the quant_reduce equivalent)
+# ---------------------------------------------------------------------------
+
+
+def _ring_reduce_scatter_q(x, axis_name: str, block: int):
+    """Inside shard_map: ring reduce-scatter with int8 wire format.
+
+    x: [n_chunks * c, ...] local array; returns this rank's reduced chunk
+    [c, ...]. Each of the n-1 hops sends one quantized chunk to the next
+    rank (ppermute), which dequantizes and accumulates its local data.
+    """
+    n = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunks = x.shape[0] // n
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def chunk_at(i):
+        return jax.lax.dynamic_slice_in_dim(x, i * chunks, chunks, axis=0)
+
+    # travelling-accumulator ring: rank r starts the accumulator for
+    # chunk (r-1); each hop the accumulator moves one rank forward and
+    # picks up that rank's local share, so after n-1 hops rank r holds
+    # the fully reduced chunk r
+    acc = chunk_at((rank + n - 1) % n)
+    for step in range(n - 1):
+        q, s, shape, pad = quantize_any(acc, block)
+        q = jax.lax.ppermute(q, axis_name, perm)
+        s = jax.lax.ppermute(s, axis_name, perm)
+        recv = dequantize_any(q, s, shape, pad)
+        idx = (rank + n - 2 - step) % n
+        acc = recv + chunk_at(idx)
+    return acc
+
+
+def quantized_reduce_scatter(
+    x: jax.Array, mesh, axis_name: str, block: int = DEFAULT_BLOCK
+) -> jax.Array:
+    """Reduce-scatter over `axis_name` with int8 payloads. x is replicated
+    per-shard input [n*c, ...]; result is each rank's summed chunk."""
+    from jax.sharding import PartitionSpec as P
+
+    fn = shard_map(
+        functools.partial(
+            _ring_reduce_scatter_q, axis_name=axis_name, block=block
+        ),
+        mesh=mesh,
+        in_specs=P(axis_name),
+        out_specs=P(axis_name),
+    )
+    return fn(x)
+
+
+def quantized_all_reduce_tree(
+    grads, mesh, axis_name: str, block: int = DEFAULT_BLOCK
+):
+    """Compressed gradient all-reduce over a pytree: each rank quantizes
+    its leaf once (own scale), all-gathers the int8 payload + scales
+    (1/4 the f32 wire bytes), then dequantizes every contribution and
+    sums in f32 locally — one-shot compression for DCN-crossing reduces
+    where ring latency dominates. Wire format matches quant_reduce.cu's
+    role; the sum itself is exact given the quantized inputs."""
+    from jax.sharding import PartitionSpec as P
+
+    def one(g):
+        def inner(gl):
+            q, s, shape, pad = quantize_any(gl, block)
+            qg = jax.lax.all_gather(q, axis_name)  # [n, 1, L]
+            sg = jax.lax.all_gather(s, axis_name)  # [n, 1, L/block]
+            n = qg.shape[0]
+            deq = dequantize_int8(
+                qg.reshape(n, -1), sg.reshape(n, -1), block_m=1
+            )
+            total = jnp.sum(deq, axis=0)
+            if pad:
+                total = total[:-pad]
+            return total.reshape(shape)
+
+        fn = shard_map(
+            inner, mesh=mesh, in_specs=P(), out_specs=P()
+        )
+        return fn(g)
+
+    return jax.tree_util.tree_map(one, grads)

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dlrover_tpu.parallel import amp, remat
 from dlrover_tpu.parallel.mesh import BATCH_AXES, MeshSpec
 from dlrover_tpu.parallel.sharding import (
     Rules,
@@ -36,12 +37,23 @@ class Strategy:
     grad_accum > 1 keeps the *global* batch fixed as the job scales
     (reference: ElasticTrainer trainer/torch/elastic/trainer.py) — the
     train step scans over a leading microbatch axis.
+
+    precision/remat/loss_scale are the AMP + activation-checkpoint
+    optimizations of the reference's library (amp_optimization.py,
+    checkpoint_optimization.py) expressed as jit knobs: params are cast
+    to the policy's compute dtype before the loss, the loss body is
+    wrapped in jax.checkpoint with the named policy, and loss scaling
+    (for f16 experiments; bf16 needs none) skips non-finite steps.
     """
 
     mesh: MeshSpec = field(default_factory=MeshSpec)
     grad_accum: int = 1
     donate_state: bool = True
     batch_spec: Tuple = (BATCH_AXES, None)  # [batch, seq]
+    precision: str = "f32"       # "f32" | "bf16" | "half" (amp.get_policy)
+    remat: str = "none"          # remat.resolve_policy names
+    remat_save_names: Tuple = ()
+    loss_scale: bool = False
 
 
 @dataclass
@@ -91,6 +103,15 @@ def accelerate(
     """
     strategy = strategy or Strategy()
     mesh = strategy.mesh.build(devices)
+    policy = amp.get_policy(strategy.precision)
+
+    def _loss_body(params, batch):
+        return loss_fn(policy.cast_to_compute(params), batch, mesh)
+
+    if strategy.remat != "none":
+        _loss_body = remat.apply_remat(
+            _loss_body, strategy.remat, strategy.remat_save_names
+        )
 
     def _constrain_tree(tree):
         """Apply partition rules anywhere in the state tree: optimizer
@@ -104,24 +125,35 @@ def accelerate(
     def _init(key):
         params = init_params(key)
         opt_state = optimizer.init(params)
-        return _constrain_tree(
-            {
-                "params": params,
-                "opt_state": opt_state,
-                "step": jnp.zeros((), jnp.int32),
-            }
-        )
+        state = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": jnp.zeros((), jnp.int32),
+        }
+        if strategy.loss_scale:
+            state["loss_scale"] = amp.init_loss_scale()
+        return _constrain_tree(state)
 
     init_jit = jax.jit(_init)
 
-    def _grads(params, batch):
-        (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(params, batch, mesh)
+    def _grads(params, batch, scale=None):
+        def f(p, b):
+            loss, m = _loss_body(p, b)
+            if scale is not None:
+                loss = loss * scale.astype(loss.dtype)
+            return loss, m
+
+        (loss, metrics), grads = jax.value_and_grad(f, has_aux=True)(
+            params, batch
+        )
+        if scale is not None:
+            loss = loss / scale.astype(loss.dtype)
         return loss, metrics, grads
 
     def _train_step(state, batch):
         params = state["params"]
+        ls = state.get("loss_scale") if strategy.loss_scale else None
+        scale = ls.scale if ls is not None else None
         if strategy.grad_accum > 1:
             # Microbatches are weighted by their valid-token count
             # (metrics["loss_weight"] if the loss_fn provides one, else
@@ -129,7 +161,7 @@ def accelerate(
             # step instead of over-weighting sparse microbatches.
             def micro(carry, mb):
                 acc_grads, acc_loss, acc_w = carry
-                loss, m, grads = _grads(params, mb)
+                loss, m, grads = _grads(params, mb, scale)
                 w = m.get("loss_weight", jnp.ones((), jnp.float32))
                 w = w.astype(jnp.float32)
                 acc_grads = jax.tree_util.tree_map(
@@ -150,7 +182,10 @@ def accelerate(
             loss = loss_sum * inv
             metrics = {"loss": loss}
         else:
-            loss, metrics, grads = _grads(params, batch)
+            loss, metrics, grads = _grads(params, batch, scale)
+
+        if ls is not None:
+            grads = amp.unscale_grads(grads, ls)
 
         updates, new_opt = optimizer.update(
             grads, state["opt_state"], params
@@ -158,13 +193,24 @@ def accelerate(
         new_params = optax.apply_updates(params, updates)
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
-        new_state = _constrain_tree(
-            {
-                "params": new_params,
-                "opt_state": new_opt,
-                "step": state["step"] + 1,
-            }
-        )
+        new_state = {
+            "params": new_params,
+            "opt_state": new_opt,
+            "step": state["step"] + 1,
+        }
+        if ls is not None:
+            # skip the step entirely when grads overflowed, then back off
+            finite = amp.all_finite(grads)
+            keep = lambda n, o: jnp.where(finite, n, o)
+            new_state["params"] = jax.tree_util.tree_map(
+                keep, new_state["params"], params
+            )
+            new_state["opt_state"] = jax.tree_util.tree_map(
+                keep, new_state["opt_state"], state["opt_state"]
+            )
+            new_state["loss_scale"] = amp.adjust_loss_scale(ls, finite)
+            metrics["loss_scale"] = new_state["loss_scale"].scale
+        new_state = _constrain_tree(new_state)
         return new_state, metrics
 
     train_jit = jax.jit(
@@ -173,7 +219,7 @@ def accelerate(
     )
 
     def _eval_step(state, batch):
-        loss, metrics = loss_fn(state["params"], batch, mesh)
+        loss, metrics = _loss_body(state["params"], batch)
         return metrics
 
     return Accelerated(

@@ -1,0 +1,211 @@
+"""Mixed precision: dtype policies, loss scaling, delayed-scaling fp8.
+
+Reference parity: atorch AMP stack — `AmpNativeOptimization` /
+`HalfOptimization` (atorch/auto/opt_lib/amp_optimization.py:377,
+half_optimization.py) and `Fp8Optimization` (TransformerEngine patching,
+utils/patch_te.py); pipeline grad scaler (amp/pipe_amp.py:51).
+
+TPU design: bf16 is the native MXU dtype, so the default policy keeps
+f32 params with bf16 compute and needs NO loss scaling (bf16's exponent
+range equals f32). `DynamicLossScale` is still provided for f16
+experiments and parity. fp8 uses the MXU's native fp8 matmul via
+jnp.float8_e4m3fn operands with per-tensor delayed scaling (amax
+history), e5m2 for the gradient path — the TransformerEngine recipe,
+expressed functionally so it jits under pjit.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+
+# ---------------------------------------------------------------------------
+# dtype policy
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Policy:
+    """What dtype each tensor class lives in (haiku/flax mp convention)."""
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    output_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, tree):
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return _cast_floating(tree, self.output_dtype)
+
+
+def _cast_floating(tree, dtype):
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def get_policy(name: str) -> Policy:
+    """'bf16' (default compute policy), 'f32', 'half' (pure bf16)."""
+    if name in ("bf16", "mixed", "amp"):
+        return Policy()
+    if name in ("f32", "full"):
+        return Policy(jnp.float32, jnp.float32, jnp.float32)
+    if name in ("half", "pure_bf16"):
+        return Policy(jnp.bfloat16, jnp.bfloat16, jnp.bfloat16)
+    raise ValueError(f"unknown precision policy: {name}")
+
+
+# ---------------------------------------------------------------------------
+# dynamic loss scale (functional, jit-safe)
+# ---------------------------------------------------------------------------
+
+
+class LossScaleState(NamedTuple):
+    scale: jax.Array        # f32 scalar
+    good_steps: jax.Array   # i32 scalar
+
+
+def init_loss_scale(initial: float = 2.0**15) -> LossScaleState:
+    return LossScaleState(
+        scale=jnp.float32(initial), good_steps=jnp.int32(0)
+    )
+
+
+def scale_loss(loss: jax.Array, state: LossScaleState) -> jax.Array:
+    return loss * state.scale.astype(loss.dtype)
+
+
+def unscale_grads(grads, state: LossScaleState):
+    inv = (1.0 / state.scale).astype(jnp.float32)
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads
+    )
+
+
+def all_finite(grads) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(grads)
+    finite = jnp.bool_(True)
+    for g in leaves:
+        finite &= jnp.all(jnp.isfinite(g))
+    return finite
+
+
+def adjust_loss_scale(
+    state: LossScaleState,
+    grads_finite: jax.Array,
+    growth_interval: int = 2000,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    max_scale: float = 2.0**24,
+) -> LossScaleState:
+    """torch.cuda.amp.GradScaler update rule, branchless."""
+    grown = state.good_steps + 1 >= growth_interval
+    new_scale = jnp.where(
+        grads_finite,
+        jnp.where(
+            grown,
+            jnp.minimum(state.scale * growth_factor, max_scale),
+            state.scale,
+        ),
+        jnp.maximum(state.scale * backoff_factor, 1.0),
+    )
+    new_good = jnp.where(
+        grads_finite & ~grown, state.good_steps + 1, jnp.int32(0)
+    )
+    return LossScaleState(scale=new_scale, good_steps=new_good)
+
+
+# ---------------------------------------------------------------------------
+# fp8 delayed scaling
+# ---------------------------------------------------------------------------
+
+
+class Fp8State(NamedTuple):
+    """Per-matmul amax histories (delayed scaling): x, kernel, grad."""
+
+    amax_x: jax.Array  # [history_len]
+    amax_w: jax.Array
+    amax_g: jax.Array
+
+
+def init_fp8_state(history_len: int = 16) -> Fp8State:
+    z = jnp.zeros((history_len,), jnp.float32)
+    return Fp8State(amax_x=z, amax_w=z, amax_g=z)
+
+
+def _scale_from_history(amax_hist: jax.Array, fp8_max: float) -> jax.Array:
+    amax = jnp.max(amax_hist)
+    # first steps: no history yet → scale 1
+    return jnp.where(amax > 0, fp8_max / amax, 1.0)
+
+
+def _roll_in(hist: jax.Array, amax: jax.Array) -> jax.Array:
+    return jnp.roll(hist, 1).at[0].set(amax)
+
+
+def _quant(x, scale, dtype, qmax):
+    xs = x.astype(jnp.float32) * scale
+    return jnp.clip(xs, -qmax, qmax).astype(dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=())
+def _fp8_dot(x, w, sx, sw, sg):
+    qx = _quant(x, sx, jnp.float8_e4m3fn, E4M3_MAX)
+    qw = _quant(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
+    y = jnp.dot(qx, qw, preferred_element_type=jnp.float32)
+    return y / (sx * sw)
+
+
+def _fp8_dot_fwd(x, w, sx, sw, sg):
+    qx = _quant(x, sx, jnp.float8_e4m3fn, E4M3_MAX)
+    qw = _quant(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
+    y = jnp.dot(qx, qw, preferred_element_type=jnp.float32) / (sx * sw)
+    return y, (qx, qw, sx, sw, sg)
+
+
+def _fp8_dot_bwd(res, g):
+    qx, qw, sx, sw, sg = res
+    qg = _quant(g, sg, jnp.float8_e5m2, E5M2_MAX)
+    dx = jnp.dot(
+        qg, qw.T, preferred_element_type=jnp.float32
+    ) / (sg * sw)
+    dw = jnp.dot(
+        qx.T, qg, preferred_element_type=jnp.float32
+    ) / (sx * sg)
+    return dx, dw, None, None, None
+
+
+_fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+
+def fp8_dot(
+    x: jax.Array, w: jax.Array, state: Fp8State
+) -> Tuple[jax.Array, Fp8State]:
+    """2-D matmul in fp8 with delayed scaling; returns f32 result and the
+    updated amax history. The gradient amax is updated from the *current*
+    forward's inputs only (the true grad amax is observed next step via
+    the returned state — the delayed part of delayed scaling)."""
+    sx = _scale_from_history(state.amax_x, E4M3_MAX)
+    sw = _scale_from_history(state.amax_w, E4M3_MAX)
+    sg = _scale_from_history(state.amax_g, E5M2_MAX)
+    y = _fp8_dot(x, w, sx, sw, sg)
+    new_state = Fp8State(
+        amax_x=_roll_in(state.amax_x, jnp.max(jnp.abs(x)).astype(jnp.float32)),
+        amax_w=_roll_in(state.amax_w, jnp.max(jnp.abs(w)).astype(jnp.float32)),
+        # grad amax proxy: output magnitude (observed pre-bwd)
+        amax_g=_roll_in(state.amax_g, jnp.max(jnp.abs(y)).astype(jnp.float32)),
+    )
+    return y, new_state

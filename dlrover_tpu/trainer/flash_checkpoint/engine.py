@@ -76,34 +76,133 @@ def flatten_state(state: Any) -> Tuple[Dict[str, np.ndarray], bytes]:
     )
     flat = {}
     paths = []
+    shard_meta = {}
     for path, leaf in leaves_with_paths:
         p = _leaf_path_str(path)
         paths.append(p)
         if isinstance(leaf, jax.Array):
             # fully-addressable arrays: plain device_get; sharded
             # multi-host arrays: concatenate local shards is wrong —
-            # stage each addressable shard separately.
+            # stage each addressable shard separately and record how to
+            # reassemble them in aux.
             if leaf.is_fully_addressable:
                 flat[p] = np.asarray(jax.device_get(leaf))
             else:
-                for shard in leaf.addressable_shards:
-                    flat[f"{p}#shard{shard.index}"] = np.asarray(
-                        jax.device_get(shard.data)
-                    )
+                entry = {
+                    "shape": tuple(leaf.shape),
+                    "dtype": str(leaf.dtype),
+                    "keys": [],
+                    "indices": [],
+                }
+                for i, shard in enumerate(leaf.addressable_shards):
+                    key = f"{p}#shard{i}"
+                    flat[key] = np.asarray(jax.device_get(shard.data))
+                    entry["keys"].append(key)
+                    entry["indices"].append(shard.index)
+                shard_meta[p] = entry
         else:
             flat[p] = np.asarray(leaf)
-    aux = pickle.dumps({"treedef": treedef, "paths": paths})
+    aux = pickle.dumps(
+        {"treedef": treedef, "paths": paths, "shards": shard_meta}
+    )
     return flat, aux
 
 
+def _reassemble_sharded(
+    path: str,
+    entry: Dict,
+    flat: Dict[str, np.ndarray],
+    target_leaf,
+):
+    """Rebuild one multi-host leaf from its staged local shards.
+
+    With a `target_leaf` (the live array on the restoring mesh) the
+    local shards are placed directly on their devices via
+    make_array_from_single_device_arrays — each host restores only its
+    addressable slice, which is exactly what it staged. Without a
+    target the global array is stitched on host, requiring every shard
+    to be present in `flat`."""
+    import jax
+
+    if all(k in flat for k in entry["keys"]):
+        # full coverage (single host, or storage merged every host's
+        # shard files): stitch the global array — works for ANY restore
+        # mesh, since restore_to_shardings re-shards it afterwards
+        out = np.zeros(entry["shape"], dtype=np.dtype(entry["dtype"]))
+        for k, ix in zip(entry["keys"], entry["indices"]):
+            out[ix] = flat[k]
+        return out
+    if target_leaf is not None and hasattr(target_leaf, "sharding"):
+        # partial coverage (this host staged only its shards): place
+        # each saved shard directly on the device that owns that index
+        # in the restore sharding — valid only when the mesh layout
+        # still matches what was saved
+        sharding = target_leaf.sharding
+        shape = entry["shape"]
+        index_to_saved = {
+            _index_key(ix): flat[k]
+            for k, ix in zip(entry["keys"], entry["indices"])
+            if k in flat
+        }
+        arrays = []
+        for d, ix in sharding.addressable_devices_indices_map(
+            shape
+        ).items():
+            host = index_to_saved.get(_index_key(ix))
+            if host is None:
+                raise KeyError(
+                    f"staged state for {path!r} is missing the shard "
+                    f"at index {ix} needed by device {d}; the saved "
+                    "sharding does not cover the restore mesh"
+                )
+            arrays.append(jax.device_put(host, d))
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, arrays
+        )
+    raise KeyError(
+        f"cannot reassemble {path!r} on host: some shards were staged "
+        "on other hosts; pass `target` so each host restores its own "
+        "shards"
+    )
+
+
+def _index_key(ix) -> tuple:
+    return tuple(
+        (s.start, s.stop, s.step) if isinstance(s, slice) else s
+        for s in ix
+    )
+
+
 def unflatten_state(
-    flat: Dict[str, np.ndarray], aux: bytes
+    flat: Dict[str, np.ndarray], aux: bytes, target: Any = None
 ) -> Any:
+    """Inverse of flatten_state. `target` (a pytree of live arrays with
+    the restore-time shardings) is required to reassemble leaves that
+    were staged as multi-host shards."""
     import jax
 
     meta = pickle.loads(aux)
     treedef = meta["treedef"]
-    leaves = [flat[p] for p in meta["paths"]]
+    shard_meta = meta.get("shards", {})
+    target_leaves = None
+    if target is not None:
+        target_leaves = jax.tree_util.tree_leaves(target)
+    leaves = []
+    for i, p in enumerate(meta["paths"]):
+        if p in flat:
+            leaves.append(flat[p])
+        elif p in shard_meta:
+            tl = (
+                target_leaves[i]
+                if target_leaves is not None
+                and i < len(target_leaves)
+                else None
+            )
+            leaves.append(
+                _reassemble_sharded(p, shard_meta[p], flat, tl)
+            )
+        else:
+            raise KeyError(f"state leaf {p!r} missing from staged data")
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -227,31 +326,47 @@ class CheckpointEngine:
 
     # ---- load ------------------------------------------------------------
 
-    def load_from_memory(self) -> Tuple[int, Optional[Any]]:
+    def load_from_memory(
+        self, target: Any = None
+    ) -> Tuple[int, Optional[Any]]:
         meta, flat = self.shm_handler.load_flat_state()
         if meta is None or meta.step < 0:
             return -1, None
-        return meta.step, unflatten_state(flat, meta.aux)
+        return meta.step, unflatten_state(flat, meta.aux, target)
 
     def load_from_storage(
-        self, step: Optional[int] = None
+        self, step: Optional[int] = None, target: Any = None
     ) -> Tuple[int, Optional[Any]]:
         if step is None:
             step = read_tracker_step(self.storage, self.checkpoint_dir)
         if step < 0:
             return -1, None
         step_dir = os.path.join(self.checkpoint_dir, str(step))
-        shard = self.storage.read(
-            os.path.join(step_dir, f"host_{self.node_rank}.npz")
-        )
         aux = self.storage.read(
             os.path.join(step_dir, f"aux_{self.node_rank}.pkl")
         )
-        if shard is None or aux is None:
+        if aux is None:
             return -1, None
-        with np.load(io.BytesIO(shard)) as npz:
-            flat = {k: npz[k] for k in npz.files}
-        return step, unflatten_state(flat, aux)
+        # merge every host's shard file visible on this storage (shared
+        # filesystems expose all of them → full coverage enables restore
+        # onto a DIFFERENT mesh; local disk sees just our own, which the
+        # target-placement path handles)
+        flat: Dict[str, np.ndarray] = {}
+        names = [
+            n
+            for n in (self.storage.listdir(step_dir) or [])
+            if n.startswith("host_") and n.endswith(".npz")
+        ] or [f"host_{self.node_rank}.npz"]
+        for name in names:
+            shard = self.storage.read(os.path.join(step_dir, name))
+            if shard is None:
+                continue
+            with np.load(io.BytesIO(shard)) as npz:
+                for k in npz.files:
+                    flat[k] = npz[k]
+        if not flat:
+            return -1, None
+        return step, unflatten_state(flat, aux, target)
 
     def load(
         self, target: Any = None
@@ -260,19 +375,31 @@ class CheckpointEngine:
         if its step >= the tracker's; else read storage. If `target`
         is given, the restored host state is device_put onto its
         shardings."""
-        mem_step, mem_state = self.load_from_memory()
+        # compare steps BEFORE paying for any unflatten/device_put
+        shm_meta = self.shm_handler.get_meta()
+        mem_step = shm_meta.step if shm_meta is not None else -1
         disk_step = read_tracker_step(self.storage, self.checkpoint_dir)
-        if mem_state is not None and mem_step >= disk_step:
-            step, state = mem_step, mem_state
-        else:
+        step, state = -1, None
+        if mem_step >= 0 and mem_step >= disk_step:
+            try:
+                step, state = self.load_from_memory(target)
+            except KeyError as e:
+                # shm shards don't cover the (resized) mesh — fall back
+                # to storage, whose merged shard files re-shard fully
+                logger.warning(
+                    "shm restore failed (%s); falling back to storage", e
+                )
+        if state is None:
             step, state = self.load_from_storage(
-                disk_step if disk_step >= 0 else None
+                disk_step if disk_step >= 0 else None, target
             )
         if state is None and self.replica_manager is not None:
             # node replacement: local shm is empty and storage has no
             # shard — pull this rank's replica (reference replica.py:193
             # gathers the lost shard from the peer node's shm)
-            step, state = self.replica_manager.restore_state()
+            step, state = self.replica_manager.restore_state(
+                target=target
+            )
             if state is not None:
                 logger.info("restored step %d from replica", step)
         if state is not None and target is not None:

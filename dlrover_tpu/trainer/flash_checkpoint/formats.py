@@ -53,15 +53,38 @@ class FullCheckpointer:
         import pickle
         import time
 
+        if storage_type != StorageType.DISK:
+            # the full-gather format has no shm fast path; refusing is
+            # better than silently stalling the step loop on a gather
+            # the caller believed was a memory-stage
+            raise ValueError(
+                "FullCheckpointer only supports StorageType.DISK; use "
+                "ShardedCheckpointer for the in-memory fast path"
+            )
+
         t0 = time.monotonic()
-        # device → host with replication resolved: every leaf becomes a
-        # full ndarray regardless of its sharding
-        full = jax.tree_util.tree_map(
-            lambda x: np.asarray(jax.device_get(x))
-            if isinstance(x, jax.Array)
-            else np.asarray(x),
-            state,
-        )
+
+        def _to_host(x):
+            if isinstance(x, jax.Array):
+                if not x.is_fully_addressable:
+                    # multi-host sharded leaf: gather across processes
+                    from jax.experimental import multihost_utils
+
+                    return np.asarray(
+                        multihost_utils.process_allgather(
+                            x, tiled=True
+                        )
+                    )
+                return np.asarray(jax.device_get(x))
+            return np.asarray(x)
+
+        # device → host with replication/sharding resolved: every leaf
+        # becomes a full ndarray regardless of topology. ALL processes
+        # join the gather; only process 0 writes (shared storage would
+        # otherwise see interleaved writes to the same tmp file).
+        full = jax.tree_util.tree_map(_to_host, state)
+        if jax.process_index() != 0:
+            return time.monotonic() - t0
         path = os.path.join(self.checkpoint_dir, f"full_{step}.pkl")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
@@ -128,6 +151,11 @@ class OrbaxCheckpointer:
     ) -> float:
         import time
 
+        if storage_type != StorageType.DISK:
+            raise ValueError(
+                "OrbaxCheckpointer only supports StorageType.DISK; use "
+                "ShardedCheckpointer for the in-memory fast path"
+            )
         t0 = time.monotonic()
         self._mgr.save(
             step, args=self._ocp.args.StandardSave(state)
@@ -151,7 +179,22 @@ class OrbaxCheckpointer:
         return step, restored
 
     def wait_latest_checkpoint(self, step: int, timeout: float = 60.0):
-        self._mgr.wait_until_finished()
+        import threading
+        import time
+
+        # orbax's wait_until_finished has no timeout; bound it with a
+        # waiter thread so a hung tensorstore write can't hang shutdown
+        done = threading.Event()
+
+        def _wait():
+            try:
+                self._mgr.wait_until_finished()
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_wait, daemon=True)
+        t.start()
+        done.wait(timeout)
         return self._mgr.latest_step() == step
 
     def close(self):

@@ -139,8 +139,12 @@ class CkptReplicaManager:
         flat, aux = _unpack(blob)
         return meta["step"], flat, aux
 
-    def restore_state(self, node_rank: Optional[int] = None):
-        """Replica → live pytree (step, state) convenience."""
+    def restore_state(
+        self, node_rank: Optional[int] = None, target=None
+    ):
+        """Replica → live pytree (step, state) convenience. `target`
+        (live arrays on the restore mesh) is required when the backed-up
+        state held multi-host sharded leaves."""
         from dlrover_tpu.trainer.flash_checkpoint.engine import (
             unflatten_state,
         )
@@ -148,4 +152,4 @@ class CkptReplicaManager:
         step, flat, aux = self.restore(node_rank)
         if flat is None:
             return -1, None
-        return step, unflatten_state(flat, aux)
+        return step, unflatten_state(flat, aux, target)

@@ -171,6 +171,7 @@ class Trainer:
         # with their own resumable sampler (ElasticDataLoader) handle
         # this via sampler state; plain iterables get skipped here.
         skip = 0
+        start_epoch = 0
         if self.global_step > 0 and not hasattr(
             self.train_data, "load_state_dict"
         ):
@@ -178,17 +179,19 @@ class Trainer:
                 n_batches = len(self.train_data)
             except TypeError:
                 n_batches = 0
-            skip = (
-                self.global_step % n_batches
-                if n_batches
-                else self.global_step
-            )
+            if n_batches:
+                # fully-consumed epochs are NOT replayed; the partial
+                # epoch skips to where it left off
+                start_epoch = self.global_step // n_batches
+                skip = self.global_step % n_batches
+            else:
+                skip = self.global_step
 
         window_t0 = time.monotonic()
         window_steps = 0
         stop = False
         try:
-            for epoch in range(self.args.num_epochs):
+            for epoch in range(start_epoch, self.args.num_epochs):
                 if stop:
                     break
                 if hasattr(self.train_data, "set_epoch"):

@@ -104,13 +104,16 @@ def find_nonfinite(tree: Any, prefix: str = "") -> List[str]:
         if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
             parts = []
             for p in path:
-                parts.append(
-                    str(
-                        getattr(p, "key", None)
-                        or getattr(p, "idx", None)
-                        or getattr(p, "name", "")
-                    )
-                )
+                # attribute presence, not truthiness: idx=0 / key="" are
+                # valid path components
+                if hasattr(p, "key"):
+                    parts.append(str(p.key))
+                elif hasattr(p, "idx"):
+                    parts.append(str(p.idx))
+                elif hasattr(p, "name"):
+                    parts.append(str(p.name))
+                else:
+                    parts.append(str(p))
             bad.append(prefix + "/".join(parts))
         return leaf
 

@@ -1,0 +1,120 @@
+"""Pluggable state/stats backends: in-memory and local-file stores.
+
+Reference parity: dlrover/python/util/state/{memory_store.py:16,
+stats_backend.py:34, store_mananger.py:25} — a tiny store abstraction the
+master's stats reporters and diagnosis manager persist through, so tests
+run in-memory and production can point at a disk/remote backend.
+"""
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class Store:
+    """Backend interface: namespaced JSON-serializable blobs."""
+
+    def set(self, key: str, value: Any):
+        raise NotImplementedError
+
+    def get(self, key: str, default: Any = None) -> Any:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def keys(self) -> List[str]:
+        raise NotImplementedError
+
+
+class MemoryStore(Store):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, Any] = {}
+
+    def set(self, key: str, value: Any):
+        with self._lock:
+            self._data[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._data.get(key, default)
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._data.pop(key, None) is not None
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._data)
+
+
+class FileStore(Store):
+    """One JSON file per key under a base dir; atomic replace on write."""
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+        os.makedirs(base_dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> str:
+        safe = key.replace(os.sep, "_")
+        return os.path.join(self.base_dir, safe + ".json")
+
+    def set(self, key: str, value: Any):
+        with self._lock:
+            tmp = self._path(key) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(value, f)
+            os.replace(tmp, self._path(key))
+
+    def get(self, key: str, default: Any = None) -> Any:
+        try:
+            with open(self._path(key), "r") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return default
+
+    def delete(self, key: str) -> bool:
+        try:
+            os.remove(self._path(key))
+            return True
+        except OSError:
+            return False
+
+    def keys(self) -> List[str]:
+        return sorted(
+            f[: -len(".json")]
+            for f in os.listdir(self.base_dir)
+            if f.endswith(".json")
+        )
+
+
+class StoreManager:
+    """Factory keyed by backend name (reference store_mananger.py:25)."""
+
+    _stores: Dict[str, Store] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def build(
+        cls, backend: str = "memory", base_dir: Optional[str] = None
+    ) -> Store:
+        with cls._lock:
+            cache_key = f"{backend}:{base_dir or ''}"
+            store = cls._stores.get(cache_key)
+            if store is None:
+                if backend == "memory":
+                    store = MemoryStore()
+                elif backend == "file":
+                    store = FileStore(base_dir or "/tmp/dlrover_tpu/state")
+                else:
+                    raise ValueError(f"unknown store backend: {backend}")
+                cls._stores[cache_key] = store
+            return store
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._stores.clear()

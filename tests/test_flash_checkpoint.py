@@ -226,3 +226,60 @@ class TestDeletionStrategy:
         assert not (tmp_path / "1").exists()
         assert (tmp_path / "2").exists()
         assert (tmp_path / "3").exists()
+
+
+class TestShardedReassembly:
+    """unflatten_state with multi-host-style shard entries (regression:
+    shard keys used to KeyError on restore)."""
+
+    def _make(self):
+        import pickle
+
+        import jax
+
+        full = np.arange(8.0, dtype=np.float32)
+        flat = {
+            "w#shard0": full[:4],
+            "w#shard1": full[4:],
+            "step": np.int32(7),
+        }
+        treedef = jax.tree_util.tree_structure({"step": 0, "w": 0})
+        aux = pickle.dumps(
+            {
+                "treedef": treedef,
+                # dict flatten order is sorted: step, w
+                "paths": ["step", "w"],
+                "shards": {
+                    "w": {
+                        "shape": (8,),
+                        "dtype": "float32",
+                        "keys": ["w#shard0", "w#shard1"],
+                        "indices": [
+                            (slice(0, 4, None),),
+                            (slice(4, 8, None),),
+                        ],
+                    }
+                },
+            }
+        )
+        return flat, aux, full
+
+    def test_host_stitch_all_shards_present(self):
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            unflatten_state,
+        )
+
+        flat, aux, full = self._make()
+        state = unflatten_state(flat, aux)
+        np.testing.assert_array_equal(state["w"], full)
+        assert int(state["step"]) == 7
+
+    def test_missing_shard_raises_clear_error(self):
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            unflatten_state,
+        )
+
+        flat, aux, _ = self._make()
+        del flat["w#shard1"]
+        with pytest.raises(KeyError, match="staged on other hosts"):
+            unflatten_state(flat, aux)

@@ -1,0 +1,167 @@
+"""Aux master services: elastic PS versioning, topology placement,
+Bayesian HP search, agent config tuner, state backends.
+
+Mirrors reference tests for elastic_ps/net_topology (dlrover/python/tests)
+and brain/hpsearch; exercised end-to-end over real gRPC where the
+reference does (test tier 1).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.config_tuner import ParalConfigTuner, read_paral_config
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common import messages as msg
+from dlrover_tpu.master.hpsearch import BayesianOptimizer, SearchSpace
+from dlrover_tpu.master.master import LocalJobMaster
+from dlrover_tpu.master.net_topology import NetworkTopology, NodeTopologyMeta
+from dlrover_tpu.utils.state import FileStore, MemoryStore, StoreManager
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(num_nodes=1)
+    m.start()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def client(master):
+    c = MasterClient(master.addr, node_id=0, node_type="worker")
+    yield c
+    c.close()
+
+
+class TestElasticPs:
+    def test_register_and_version(self, master, client):
+        v1 = client.register_ps("10.0.0.1:2222")
+        assert v1 == 1
+        c2 = MasterClient(master.addr, node_id=1, node_type="ps")
+        v2 = c2.register_ps("10.0.0.2:2222")
+        assert v2 == 2
+        cluster = client.get_ps_cluster()
+        assert cluster.ps_addrs == ["10.0.0.1:2222", "10.0.0.2:2222"]
+        assert cluster.version == 2
+        # dead PS bumps the version again
+        assert c2.register_ps("", alive=False) == 3
+        assert client.get_ps_cluster().ps_addrs == ["10.0.0.1:2222"]
+        c2.close()
+
+    def test_local_version_staleness(self, master, client):
+        client.register_ps("10.0.0.1:2222")
+        client.update_cluster_version(0, "local")
+        assert client.get_cluster_version("global") == 1
+        assert client.get_cluster_version("local") == 0
+        svc = master.servicer.elastic_ps
+        assert svc.stale_workers("worker") == [0]
+        client.update_cluster_version(1, "local")
+        assert svc.stale_workers("worker") == []
+
+
+class TestTopology:
+    def test_snake_order_minimizes_dcn_cuts(self):
+        topo = NetworkTopology()
+        # two slices, 2x2 torus each, reported out of order
+        metas = [
+            NodeTopologyMeta(node_id=0, slice_id=1, coords=(0, 0, 0)),
+            NodeTopologyMeta(node_id=1, slice_id=0, coords=(1, 1, 0)),
+            NodeTopologyMeta(node_id=2, slice_id=0, coords=(0, 0, 0)),
+            NodeTopologyMeta(node_id=3, slice_id=1, coords=(1, 1, 0)),
+            NodeTopologyMeta(node_id=4, slice_id=0, coords=(0, 1, 0)),
+            NodeTopologyMeta(node_id=5, slice_id=0, coords=(1, 0, 0)),
+        ]
+        for m in metas:
+            topo.report(m)
+        order = topo.sorted_node_ids()
+        # slice 0 first, slice 1 second; exactly one DCN crossing
+        assert order[:4] == [2, 4, 1, 5]  # snake: (0,0),(0,1),(1,1),(1,0)
+        assert topo.dcn_cut_pairs(order) == 1
+        assert topo.same_slice(2, 4) and not topo.same_slice(2, 0)
+
+    def test_rpc_roundtrip(self, master, client):
+        client.report_topology(slice_id=1, coords=(0, 0, 0))
+        c2 = MasterClient(master.addr, node_id=1, node_type="worker")
+        c2.report_topology(slice_id=0, coords=(0, 0, 0))
+        assert client.get_topology_order() == [1, 0]
+        c2.close()
+
+    def test_unknown_coords_fall_back_to_node_id(self):
+        topo = NetworkTopology()
+        topo.report(NodeTopologyMeta(node_id=2))
+        topo.report(NodeTopologyMeta(node_id=0))
+        topo.report(NodeTopologyMeta(node_id=1))
+        assert topo.sorted_node_ids() == [0, 1, 2]
+
+
+class TestBayesianOptimizer:
+    def test_finds_quadratic_minimum(self):
+        space = SearchSpace(
+            names=["x", "y"], lows=[-4.0, -4.0], highs=[4.0, 4.0]
+        )
+        bo = BayesianOptimizer(space, n_init=5, seed=3)
+        for _ in range(30):
+            p = bo.suggest()
+            loss = (p["x"] - 1.0) ** 2 + (p["y"] + 2.0) ** 2
+            bo.tell(p, loss)
+        best_point, best_loss = bo.best
+        assert best_loss < 0.7
+        assert abs(best_point["x"] - 1.0) < 1.0
+        assert abs(best_point["y"] + 2.0) < 1.0
+
+    def test_integer_dims_rounded(self):
+        space = SearchSpace(
+            names=["bs"], lows=[1], highs=[64], integer=[True]
+        )
+        bo = BayesianOptimizer(space, n_init=2, seed=0)
+        p = bo.suggest()
+        assert p["bs"] == int(p["bs"]) and 1 <= p["bs"] <= 64
+
+
+class TestParalConfigTuner:
+    def test_mirror_to_file(self, master, client, tmp_path):
+        path = str(tmp_path / "paral.json")
+        tuner = ParalConfigTuner(client=client, path=path, interval=999)
+        assert tuner.poll_once() is True  # version 0 > initial -1
+        master.servicer.paral_config = msg.ParallelConfig(
+            dataloader_batch_size=32, grad_accum_steps=2, version=5
+        )
+        assert tuner.poll_once() is True
+        cfg = read_paral_config(path)
+        assert cfg.dataloader_batch_size == 32 and cfg.version == 5
+        # no newer version → no rewrite
+        assert tuner.poll_once() is False
+
+    def test_read_missing(self, tmp_path):
+        assert read_paral_config(str(tmp_path / "nope.json")) is None
+
+
+class TestStateBackends:
+    def test_memory_store(self):
+        s = MemoryStore()
+        s.set("a", {"x": 1})
+        assert s.get("a") == {"x": 1}
+        assert s.keys() == ["a"]
+        assert s.delete("a") and not s.delete("a")
+
+    def test_file_store_roundtrip(self, tmp_path):
+        s = FileStore(str(tmp_path))
+        s.set("job/metrics", [1, 2, 3])
+        assert s.get("job/metrics") == [1, 2, 3]
+        assert s.keys() == ["job_metrics"]
+        s2 = FileStore(str(tmp_path))  # fresh instance sees the file
+        assert s2.get("job_metrics") == [1, 2, 3]
+
+    def test_manager_caches(self, tmp_path):
+        StoreManager.reset()
+        a = StoreManager.build("memory")
+        b = StoreManager.build("memory")
+        assert a is b
+        f = StoreManager.build("file", str(tmp_path))
+        assert isinstance(f, FileStore)
+        with pytest.raises(ValueError):
+            StoreManager.build("redis")
+        StoreManager.reset()
