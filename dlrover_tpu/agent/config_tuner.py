@@ -81,6 +81,7 @@ class ParalConfigTuner:
     def start(self):
         if self._thread is not None:
             return
+        self._stop.clear()  # allow stop() → start() restart cycles
         self._thread = threading.Thread(
             target=self._run, name="paral-config-tuner", daemon=True
         )

@@ -1,0 +1,28 @@
+"""Brain: out-of-job resource optimization service.
+
+Reference parity: dlrover/go/brain — a standalone service that persists
+job runtime metrics to a datastore (MySQL there, sqlite here) and serves
+`optimize` RPCs through pluggable algorithms keyed by job stage
+(create / cold-create / init-adjust / running / OOM, for PS and worker
+roles). The master's BrainResourceOptimizer delegates to it; jobs keep
+working without it via the local heuristic optimizer."""
+
+from dlrover_tpu.brain.datastore import JobMetricsStore
+from dlrover_tpu.brain.algorithms import (
+    ALGORITHMS,
+    OptimizeContext,
+    run_algorithm,
+)
+from dlrover_tpu.brain.service import (
+    BrainClient,
+    BrainService,
+)
+
+__all__ = [
+    "ALGORITHMS",
+    "BrainClient",
+    "BrainService",
+    "JobMetricsStore",
+    "OptimizeContext",
+    "run_algorithm",
+]

@@ -1,0 +1,276 @@
+"""Brain gRPC service + client + master-side optimizer adapter.
+
+Reference parity: dlrover/proto/brain.proto:196 (`service Brain` —
+persist_metrics / optimize / get_job_metrics), served by the Go brain
+(optimize_request_processor.go), consumed via
+dlrover/python/brain/client.py (`BrainClient`) and
+master/resource/brain_optimizer.py (`BrainResoureOptimizer`).
+
+Runs on the same 2-RPC comm layer as the master (get = optimize/query,
+report = persist)."""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.brain.algorithms import (
+    OptimizeContext,
+    ResourceDelta,
+    run_algorithm,
+)
+from dlrover_tpu.brain.datastore import (
+    JobMeta,
+    JobMetricsStore,
+    RuntimeSample,
+)
+from dlrover_tpu.common.comm import (
+    Envelope,
+    MasterServicerBase,
+    MasterStub,
+    ReplyEnvelope,
+    build_master_server,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import BaseRequest, find_free_port
+
+
+# ---- wire messages ---------------------------------------------------------
+
+
+@dataclass
+class PersistJobMeta(BaseRequest):
+    job_uuid: str = ""
+    job_name: str = ""
+    user: str = ""
+    cluster: str = ""
+    status: str = "running"
+    resources: Dict = field(default_factory=dict)
+
+
+@dataclass
+class PersistRuntimeSample(BaseRequest):
+    job_uuid: str = ""
+    role: str = "worker"
+    num_nodes: int = 0
+    cpu_percent: float = 0.0
+    memory_mb: float = 0.0
+    samples_per_sec: float = 0.0
+    global_step: int = 0
+
+
+@dataclass
+class OptimizeRequest(BaseRequest):
+    job_uuid: str = ""
+    algorithm: str = ""
+    current: Dict[str, Dict] = field(default_factory=dict)
+
+
+@dataclass
+class OptimizeResponse:
+    role: str = ""
+    count: int = -1         # -1: no suggestion
+    cpu: float = -1.0
+    memory_mb: int = -1
+    reason: str = ""
+
+    @property
+    def empty(self) -> bool:
+        return self.count < 0 and self.cpu < 0 and self.memory_mb < 0
+
+
+@dataclass
+class JobMetricsQuery(BaseRequest):
+    job_uuid: str = ""
+    role: str = ""
+    limit: int = 100
+
+
+@dataclass
+class JobMetricsResponse:
+    samples: List[Dict] = field(default_factory=list)
+
+
+# ---- servicer --------------------------------------------------------------
+
+
+class BrainServicer(MasterServicerBase):
+    def __init__(self, store: Optional[JobMetricsStore] = None):
+        self.store = store or JobMetricsStore()
+
+    def report(self, env: Envelope) -> ReplyEnvelope:
+        req = env.payload
+        if isinstance(req, PersistJobMeta):
+            self.store.upsert_job(
+                JobMeta(
+                    job_uuid=req.job_uuid,
+                    job_name=req.job_name,
+                    user=req.user,
+                    cluster=req.cluster,
+                    status=req.status,
+                ),
+                req.resources,
+            )
+            return ReplyEnvelope()
+        if isinstance(req, PersistRuntimeSample):
+            self.store.add_sample(
+                RuntimeSample(
+                    job_uuid=req.job_uuid,
+                    role=req.role,
+                    num_nodes=req.num_nodes,
+                    cpu_percent=req.cpu_percent,
+                    memory_mb=req.memory_mb,
+                    samples_per_sec=req.samples_per_sec,
+                    global_step=req.global_step,
+                )
+            )
+            return ReplyEnvelope()
+        return ReplyEnvelope(
+            success=False, reason=f"unknown report {type(req).__name__}"
+        )
+
+    def get(self, env: Envelope) -> ReplyEnvelope:
+        req = env.payload
+        if isinstance(req, OptimizeRequest):
+            ctx = OptimizeContext(
+                job_uuid=req.job_uuid,
+                store=self.store,
+                current=req.current,
+            )
+            delta = run_algorithm(req.algorithm, ctx)
+            return ReplyEnvelope(payload=_delta_to_resp(delta))
+        if isinstance(req, JobMetricsQuery):
+            ss = self.store.samples(
+                req.job_uuid, role=req.role, limit=req.limit
+            )
+            return ReplyEnvelope(
+                payload=JobMetricsResponse(
+                    samples=[s.__dict__ for s in ss]
+                )
+            )
+        return ReplyEnvelope(
+            success=False, reason=f"unknown get {type(req).__name__}"
+        )
+
+
+def _delta_to_resp(d: ResourceDelta) -> OptimizeResponse:
+    return OptimizeResponse(
+        role=d.role,
+        count=d.count if d.count is not None else -1,
+        cpu=d.cpu if d.cpu is not None else -1.0,
+        memory_mb=d.memory_mb if d.memory_mb is not None else -1,
+        reason=d.reason,
+    )
+
+
+class BrainService:
+    def __init__(
+        self, store: Optional[JobMetricsStore] = None, port: int = 0
+    ):
+        self.servicer = BrainServicer(store)
+        self.port = port or find_free_port()
+        self._server = build_master_server(self.servicer, self.port)
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self):
+        self._server.start()
+        logger.info("brain service on port %d", self.port)
+
+    def stop(self):
+        self._server.stop(grace=0.5)
+        self.servicer.store.close()
+
+
+# ---- client ----------------------------------------------------------------
+
+
+class BrainClient:
+    """What masters/agents use to talk to the brain."""
+
+    def __init__(self, addr: str):
+        self._stub = MasterStub(addr)
+
+    def persist_job(
+        self,
+        job_uuid: str,
+        job_name: str = "",
+        user: str = "",
+        status: str = "running",
+        resources: Optional[Dict] = None,
+    ):
+        return self._stub.report(
+            PersistJobMeta(
+                job_uuid=job_uuid,
+                job_name=job_name,
+                user=user,
+                status=status,
+                resources=resources or {},
+            )
+        )
+
+    def persist_sample(self, job_uuid: str, role: str, **kw):
+        return self._stub.report(
+            PersistRuntimeSample(job_uuid=job_uuid, role=role, **kw)
+        )
+
+    def optimize(
+        self,
+        job_uuid: str,
+        algorithm: str,
+        current: Optional[Dict[str, Dict]] = None,
+    ) -> Optional[OptimizeResponse]:
+        resp = self._stub.get(
+            OptimizeRequest(
+                job_uuid=job_uuid,
+                algorithm=algorithm,
+                current=current or {},
+            )
+        )
+        if not resp.success:
+            logger.warning("brain optimize failed: %s", resp.reason)
+            return None
+        return resp.payload
+
+    def get_job_metrics(
+        self, job_uuid: str, role: str = "", limit: int = 100
+    ) -> List[Dict]:
+        resp = self._stub.get(
+            JobMetricsQuery(job_uuid=job_uuid, role=role, limit=limit)
+        )
+        return resp.payload.samples if resp.payload else []
+
+    def close(self):
+        self._stub.close()
+
+
+class BrainResourceOptimizer:
+    """Master-side adapter: stage name → brain algorithm → ScalePlan
+    delta (reference master/resource/brain_optimizer.py:64)."""
+
+    STAGE_TO_ALGO = {
+        ("ps", "create"): "optimize_job_ps_create_resource",
+        ("ps", "cold"): "optimize_job_ps_cold_create_resource",
+        ("ps", "init"): "optimize_job_ps_init_adjust_resource",
+        ("ps", "running"): "optimize_job_hot_ps_resource",
+        ("ps", "oom"): "optimize_job_ps_oom_resource",
+        ("ps", "util"): "optimize_job_ps_resource_util",
+        ("worker", "create"): "optimize_job_worker_create_resource",
+        ("worker", "oom"): "optimize_job_worker_create_oom_resource",
+        ("worker", "running"): "optimize_job_worker_resource",
+    }
+
+    def __init__(self, client: BrainClient, job_uuid: str):
+        self.client = client
+        self.job_uuid = job_uuid
+
+    def suggest(
+        self,
+        role: str,
+        stage: str,
+        current: Optional[Dict[str, Dict]] = None,
+    ) -> Optional[OptimizeResponse]:
+        algo = self.STAGE_TO_ALGO.get((role, stage))
+        if algo is None:
+            return None
+        return self.client.optimize(self.job_uuid, algo, current)

@@ -108,7 +108,8 @@ class BayesianOptimizer:
         y = np.asarray(self._y)
         y_mean, y_std = y.mean(), y.std() + 1e-12
         yn = (y - y_mean) / y_std
-        # median-heuristic lengthscale per dim
+        # fixed fraction-of-span lengthscale per dim (cheap, robust for
+        # the low-dimensional spaces we tune)
         span = np.asarray(self.space.highs) - np.asarray(self.space.lows)
         ls = np.maximum(span * 0.2, 1e-9)
 

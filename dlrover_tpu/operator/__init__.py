@@ -1,0 +1,31 @@
+"""ElasticJob operator: CRDs + reconcilers + controller loop.
+
+Reference parity: dlrover/go/operator — the Go controller-runtime
+operator owning the `ElasticJob` and `ScalePlan` CRDs
+(api/v1alpha1, controllers/elasticjob_controller.go,
+scaleplan_controller.go). Here the same reconcile semantics run as a
+Python controller against the REST adapter (scheduler/kubernetes.py);
+the control loop is level-triggered polling, which is what
+controller-runtime reduces to without informer caches."""
+
+from dlrover_tpu.operator.crds import (
+    ELASTIC_GROUP,
+    ELASTIC_VERSION,
+    elastic_job_crd,
+    scale_plan_crd,
+)
+from dlrover_tpu.operator.reconciler import (
+    ElasticJobReconciler,
+    ScalePlanReconciler,
+)
+from dlrover_tpu.operator.controller import OperatorController
+
+__all__ = [
+    "ELASTIC_GROUP",
+    "ELASTIC_VERSION",
+    "ElasticJobReconciler",
+    "OperatorController",
+    "ScalePlanReconciler",
+    "elastic_job_crd",
+    "scale_plan_crd",
+]

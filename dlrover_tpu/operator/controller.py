@@ -1,0 +1,92 @@
+"""Operator controller loop: poll CRs, reconcile, repeat.
+
+Reference parity: the controller-runtime manager in
+dlrover/go/operator/main.go wiring ElasticJobReconciler +
+ScalePlanReconciler with watches. Without informers, a level-triggered
+poll gives the same convergence (the Go reconcilers are also written to
+be safe under spurious requeues)."""
+
+import threading
+import time
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.operator.crds import (
+    ELASTIC_GROUP,
+    ELASTIC_VERSION,
+    ELASTICJOB_PLURAL,
+    SCALEPLAN_PLURAL,
+)
+from dlrover_tpu.operator.reconciler import (
+    ElasticJobReconciler,
+    ScalePlanReconciler,
+)
+
+
+class OperatorController:
+    def __init__(
+        self,
+        k8s_client,
+        poll_interval: float = 3.0,
+        job_reconciler: Optional[ElasticJobReconciler] = None,
+        plan_reconciler: Optional[ScalePlanReconciler] = None,
+    ):
+        self._k8s = k8s_client
+        self.poll_interval = poll_interval
+        self.jobs = job_reconciler or ElasticJobReconciler(k8s_client)
+        self.plans = plan_reconciler or ScalePlanReconciler(k8s_client)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def reconcile_once(self):
+        """One pass over every ElasticJob and pending ScalePlan."""
+        try:
+            job_crs = self._k8s.list_custom(
+                ELASTIC_GROUP, ELASTIC_VERSION, ELASTICJOB_PLURAL
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("list elasticjobs failed: %s", e)
+            job_crs = []
+        for cr in job_crs:
+            try:
+                self.jobs.reconcile(cr)
+            except Exception as e:  # noqa: BLE001
+                logger.exception(
+                    "reconcile job %s failed: %s",
+                    cr.get("metadata", {}).get("name"),
+                    e,
+                )
+        try:
+            plan_crs = self._k8s.list_custom(
+                ELASTIC_GROUP, ELASTIC_VERSION, SCALEPLAN_PLURAL
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("list scaleplans failed: %s", e)
+            plan_crs = []
+        for cr in plan_crs:
+            try:
+                self.plans.reconcile(cr)
+            except Exception as e:  # noqa: BLE001
+                logger.exception(
+                    "reconcile plan %s failed: %s",
+                    cr.get("metadata", {}).get("name"),
+                    e,
+                )
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="operator", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval):
+            self.reconcile_once()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,114 @@
+"""CRD definitions + CR helpers for ElasticJob / ScalePlan.
+
+Reference parity: dlrover/go/operator/api/v1alpha1 (group
+elastic.iml.github.io/v1alpha1; shared types
+operator/pkg/common/api/v1/types.go) — ElasticJob carries per-role
+replica specs and a distribution strategy; ScalePlan carries declarative
+replica resource specs plus explicit create/remove pod lists, owned by a
+job. The CRD manifests below are what an installer applies once per
+cluster."""
+
+from typing import Dict, List, Optional
+
+ELASTIC_GROUP = "elastic.dlrover-tpu.io"
+ELASTIC_VERSION = "v1alpha1"
+ELASTICJOB_PLURAL = "elasticjobs"
+SCALEPLAN_PLURAL = "scaleplans"
+
+
+def _crd(kind: str, plural: str) -> Dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{ELASTIC_GROUP}"},
+        "spec": {
+            "group": ELASTIC_GROUP,
+            "names": {
+                "kind": kind,
+                "plural": plural,
+                "singular": kind.lower(),
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": ELASTIC_VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def elastic_job_crd() -> Dict:
+    return _crd("ElasticJob", ELASTICJOB_PLURAL)
+
+
+def scale_plan_crd() -> Dict:
+    return _crd("ScalePlan", SCALEPLAN_PLURAL)
+
+
+# ---- CR accessors (reconcilers read through these) -------------------------
+
+
+class JobPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    SCALING = "Scaling"
+
+
+def job_name(cr: Dict) -> str:
+    return cr["metadata"]["name"]
+
+
+def job_phase(cr: Dict) -> str:
+    return cr.get("status", {}).get("phase", JobPhase.PENDING)
+
+
+def replica_specs(cr: Dict) -> Dict[str, Dict]:
+    """{'worker': {'replicas': 4, 'template': {...pod spec...}}, ...}"""
+    return cr.get("spec", {}).get("replicaSpecs", {})
+
+
+def make_elastic_job(
+    name: str,
+    workers: int = 1,
+    worker_template: Optional[Dict] = None,
+    master_template: Optional[Dict] = None,
+    distribution: str = "AllreduceStrategy",
+) -> Dict:
+    return {
+        "apiVersion": f"{ELASTIC_GROUP}/{ELASTIC_VERSION}",
+        "kind": "ElasticJob",
+        "metadata": {"name": name},
+        "spec": {
+            "distributionStrategy": distribution,
+            "replicaSpecs": {
+                "worker": {
+                    "replicas": workers,
+                    "template": worker_template or {},
+                },
+            },
+            "masterTemplate": master_template or {},
+        },
+    }
+
+
+def scaleplan_owner(cr: Dict) -> str:
+    return cr.get("spec", {}).get("ownerJob", "")
+
+
+def scaleplan_done(cr: Dict) -> bool:
+    return cr.get("status", {}).get("phase") in (
+        "Succeeded",
+        "Failed",
+    )

@@ -1,0 +1,252 @@
+"""Reconcilers: ElasticJob → master pod + status; ScalePlan → pods.
+
+Reference parity: dlrover/go/operator/pkg/controllers —
+`ElasticJobReconciler` (elasticjob_controller.go:47; Reconcile :85,
+createEasydlMaster :182, executeScaling :215, handleFaultPods :251),
+`ScalePlanReconciler` (scaleplan_controller.go), master pod builder
+(controllers/master/master.go).
+
+The operator owns exactly two things the in-job master cannot: creating
+the master pod itself, and executing declarative ScalePlans when the
+master chose the CRD scaler. Fault *worker* pods are the master's
+business (it watches and relaunches); fault *master* pods are ours."""
+
+import copy
+import time
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.operator import crds
+from dlrover_tpu.operator.crds import (
+    ELASTIC_GROUP,
+    ELASTIC_VERSION,
+    ELASTICJOB_PLURAL,
+    SCALEPLAN_PLURAL,
+    JobPhase,
+)
+
+MASTER_SUFFIX = "-dlrover-master"
+
+_MEM_UNITS_MB = {
+    "": 1 / (1024.0 * 1024.0),  # plain bytes
+    "k": 1e3 / (1024.0 * 1024.0),
+    "m": 1e6 / (1024.0 * 1024.0),
+    "g": 1e9 / (1024.0 * 1024.0),
+    "ki": 1 / 1024.0,
+    "mi": 1.0,
+    "gi": 1024.0,
+    "ti": 1024.0 * 1024.0,
+}
+
+
+def parse_memory_mb(quantity) -> int:
+    """Kubernetes memory quantity ('2Gi', '512Mi', '1G', bare bytes)
+    → MiB. Raises ValueError on junk (caller marks the plan Failed)."""
+    s = str(quantity).strip().lower()
+    if not s:
+        return 0
+    num = s.rstrip("abcdefghijklmnopqrstuvwxyz")
+    unit = s[len(num):]
+    if unit not in _MEM_UNITS_MB:
+        raise ValueError(f"unsupported memory quantity: {quantity!r}")
+    return int(float(num or 0) * _MEM_UNITS_MB[unit])
+
+
+def master_pod_name(job: str) -> str:
+    return job + MASTER_SUFFIX
+
+
+def build_master_pod(job_cr: Dict) -> Dict:
+    """The master pod manifest (reference controllers/master/master.go:
+    command runs the job master; labels tie it to the job)."""
+    job = crds.job_name(job_cr)
+    template = copy.deepcopy(
+        job_cr.get("spec", {}).get("masterTemplate") or {}
+    )
+    manifest = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {},
+        "spec": template.get("spec")
+        or {
+            "restartPolicy": "Never",
+            "containers": [
+                {
+                    "name": "master",
+                    "image": "dlrover-tpu-master",
+                    "command": [
+                        "python",
+                        "-m",
+                        "dlrover_tpu.master.main",
+                        "--job-name",
+                        job,
+                    ],
+                }
+            ],
+        },
+    }
+    manifest["metadata"] = {
+        "name": master_pod_name(job),
+        "labels": {
+            "app": job,
+            "elasticjob-name": job,
+            "node-type": "master",
+        },
+    }
+    return manifest
+
+
+class ElasticJobReconciler:
+    """Level-triggered reconcile of one ElasticJob CR."""
+
+    def __init__(self, k8s_client, master_restart_limit: int = 3):
+        self._k8s = k8s_client
+        self.master_restart_limit = master_restart_limit
+        self._master_restarts: Dict[str, int] = {}
+
+    def reconcile(self, job_cr: Dict) -> str:
+        """Returns the phase after reconciliation."""
+        job = crds.job_name(job_cr)
+        phase = crds.job_phase(job_cr)
+        if phase in (JobPhase.SUCCEEDED, JobPhase.FAILED):
+            return phase
+
+        master = self._get_pod(master_pod_name(job))
+        if master is None:
+            logger.info("operator: creating master pod for %s", job)
+            self._k8s.create_pod(build_master_pod(job_cr))
+            return self._set_phase(job, JobPhase.PENDING)
+
+        mphase = master.get("status", {}).get("phase", "Pending")
+        if mphase == "Running":
+            return self._set_phase(job, JobPhase.RUNNING)
+        if mphase == "Succeeded":
+            return self._set_phase(job, JobPhase.SUCCEEDED)
+        if mphase == "Failed":
+            # the master is the job's brain: relaunch it up to a limit
+            # (handleFaultPods path), then fail the job
+            n = self._master_restarts.get(job, 0)
+            if n >= self.master_restart_limit:
+                logger.warning(
+                    "operator: master of %s failed %d times; job failed",
+                    job,
+                    n,
+                )
+                return self._set_phase(job, JobPhase.FAILED)
+            self._master_restarts[job] = n + 1
+            self._k8s.delete_pod(master_pod_name(job))
+            self._k8s.create_pod(build_master_pod(job_cr))
+            logger.info(
+                "operator: relaunched master of %s (attempt %d)",
+                job,
+                n + 1,
+            )
+            return self._set_phase(job, JobPhase.PENDING)
+        return crds.job_phase(job_cr)
+
+    # ---- helpers ---------------------------------------------------------
+
+    def _get_pod(self, name: str) -> Optional[Dict]:
+        try:
+            return self._k8s.get_pod(name)
+        except Exception:
+            return None
+
+    def _set_phase(self, job: str, phase: str) -> str:
+        try:
+            self._k8s.patch_custom_status(
+                ELASTIC_GROUP,
+                ELASTIC_VERSION,
+                ELASTICJOB_PLURAL,
+                job,
+                {"phase": phase, "lastReconcile": time.time()},
+            )
+        except Exception as e:
+            logger.warning("status patch failed for %s: %s", job, e)
+        return phase
+
+
+class ScalePlanReconciler:
+    """Execute ScalePlan CRs written by the master's ElasticJobScaler
+    (reference scaleplan_controller.go + executeScaling :215)."""
+
+    def __init__(self, k8s_client, pod_scaler_factory=None):
+        self._k8s = k8s_client
+        # job name -> PodScaler; built lazily so each plan scales with
+        # its owner job's naming conventions
+        self._factory = pod_scaler_factory or self._default_factory
+        self._scalers: Dict[str, object] = {}
+
+    def _default_factory(self, job: str):
+        from dlrover_tpu.master.scaler import PodScaler
+        from dlrover_tpu.scheduler.job import JobArgs
+
+        return PodScaler(JobArgs(job_name=job), self._k8s)
+
+    def reconcile(self, plan_cr: Dict) -> bool:
+        """Returns True when the plan was executed (or already done)."""
+        if crds.scaleplan_done(plan_cr):
+            return True
+        job = crds.scaleplan_owner(plan_cr)
+        name = plan_cr["metadata"]["name"]
+        spec = plan_cr.get("spec", {})
+        scaler = self._scalers.get(job)
+        if scaler is None:
+            scaler = self._scalers[job] = self._factory(job)
+
+        from dlrover_tpu.common.node import (
+            Node,
+            NodeGroupResource,
+            NodeResource,
+        )
+        from dlrover_tpu.master.scaler import ScalePlan
+
+        # any failure from here on (malformed spec OR scaler error)
+        # marks the plan Failed so it is never retried forever
+        try:
+            plan = ScalePlan()
+            for role, g in spec.get(
+                "replicaResourceSpecs", {}
+            ).items():
+                res = g.get("resource", {})
+                plan.node_group_resources[role] = NodeGroupResource(
+                    count=int(g.get("replicas", 0)),
+                    node_resource=NodeResource(
+                        cpu=float(res.get("cpu", 0) or 0),
+                        memory_mb=parse_memory_mb(
+                            res.get("memory", "0Mi")
+                        ),
+                        chips=int(res.get("tpu", 0) or 0),
+                    ),
+                )
+            for p in spec.get("createPods", []):
+                plan.launch_nodes.append(
+                    Node(
+                        node_type=p.get("type", "worker"),
+                        node_id=int(p.get("id", 0)),
+                        rank_index=int(p.get("rankIndex", 0)),
+                    )
+                )
+            for p in spec.get("removePods", []):
+                plan.remove_nodes.append(
+                    Node(
+                        node_type=p.get("type", "worker"),
+                        node_id=int(p.get("id", 0)),
+                    )
+                )
+            scaler.scale(plan)
+            status = "Succeeded"
+        except Exception as e:  # noqa: BLE001 — record, don't crash loop
+            logger.warning("scaleplan %s failed: %s", name, e)
+            status = "Failed"
+        try:
+            self._k8s.patch_custom_status(
+                ELASTIC_GROUP,
+                ELASTIC_VERSION,
+                SCALEPLAN_PLURAL,
+                name,
+                {"phase": status, "finishedAt": time.time()},
+            )
+        except Exception as e:
+            logger.warning("scaleplan status patch failed: %s", e)
+        return True

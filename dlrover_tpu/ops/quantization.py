@@ -161,6 +161,12 @@ def _ring_reduce_scatter_q(x, axis_name: str, block: int):
     """
     n = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"ring reduce-scatter needs the local leading dim "
+            f"({x.shape[0]}) divisible by axis size ({n}); pad the "
+            "input (global leading dim must divide by n*n)"
+        )
     chunks = x.shape[0] // n
     perm = [(i, (i + 1) % n) for i in range(n)]
 

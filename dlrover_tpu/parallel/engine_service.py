@@ -1,0 +1,241 @@
+"""Acceleration-engine service: coordinate strategy search over gRPC.
+
+Reference parity: atorch auto/engine — `executor.py:36` assigns
+tune/dryrun tasks to client processes, `servicer.py`/`client.py` carry
+them over gRPC, and the strategy-generation algorithm picks candidates.
+
+TPU shape: dry-runs must execute where the devices are, so the service
+is a *coordinator*: it enumerates candidate strategies, hands them to
+polling executor clients (the training hosts), collects DryRunReports,
+and serves the winner. Single-host jobs can skip the service entirely
+and call StrategySearch directly (auto_engine.py)."""
+
+import threading
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+from dlrover_tpu.common.comm import (
+    Envelope,
+    MasterServicerBase,
+    MasterStub,
+    ReplyEnvelope,
+    build_master_server,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import BaseRequest, find_free_port
+from dlrover_tpu.parallel.accelerate import Strategy
+from dlrover_tpu.parallel.mesh import MeshSpec
+
+
+def strategy_to_dict(s: Strategy) -> Dict:
+    d = asdict(s)
+    d["batch_spec"] = None  # engine tunes mesh/remat/precision only
+    return d
+
+
+def strategy_from_dict(d: Dict) -> Strategy:
+    d = dict(d)
+    mesh = MeshSpec(**d.pop("mesh"))
+    d.pop("batch_spec", None)
+    return Strategy(mesh=mesh, **d)
+
+
+# ---- wire messages ---------------------------------------------------------
+
+
+@dataclass
+class StrategyTaskQuery(BaseRequest):
+    executor_id: int = 0
+
+
+@dataclass
+class StrategyTaskResponse:
+    task_id: int = -1  # -1: nothing to do (done or empty)
+    strategy: Optional[Dict] = None
+    run_steps: int = 0
+
+
+@dataclass
+class StrategyReport(BaseRequest):
+    task_id: int = -1
+    est_step_seconds: float = float("inf")
+    measured_step_seconds: float = 0.0
+    peak_memory_bytes: float = 0.0
+    fits_memory: bool = True
+    error: str = ""
+
+
+@dataclass
+class BestStrategyQuery(BaseRequest):
+    pass
+
+
+@dataclass
+class BestStrategyResponse:
+    found: bool = False
+    done: bool = False
+    strategy: Optional[Dict] = None
+
+
+# ---- service ---------------------------------------------------------------
+
+
+@dataclass
+class _Task:
+    task_id: int
+    strategy: Strategy
+    assigned: bool = False
+    report: Optional[StrategyReport] = None
+
+
+class AccelerationEngineServicer(MasterServicerBase):
+    """Task board for one search round."""
+
+    def __init__(self, candidates: List[Strategy], run_steps: int = 0):
+        self._lock = threading.Lock()
+        self._tasks = [
+            _Task(task_id=i, strategy=s)
+            for i, s in enumerate(candidates)
+        ]
+        self.run_steps = run_steps
+
+    def submit(self, candidates: List[Strategy]):
+        with self._lock:
+            base = len(self._tasks)
+            self._tasks.extend(
+                _Task(task_id=base + i, strategy=s)
+                for i, s in enumerate(candidates)
+            )
+
+    def get(self, env: Envelope) -> ReplyEnvelope:
+        req = env.payload
+        if isinstance(req, StrategyTaskQuery):
+            with self._lock:
+                for t in self._tasks:
+                    if not t.assigned:
+                        t.assigned = True
+                        return ReplyEnvelope(
+                            payload=StrategyTaskResponse(
+                                task_id=t.task_id,
+                                strategy=strategy_to_dict(t.strategy),
+                                run_steps=self.run_steps,
+                            )
+                        )
+            return ReplyEnvelope(payload=StrategyTaskResponse())
+        if isinstance(req, BestStrategyQuery):
+            with self._lock:
+                done = all(t.report is not None for t in self._tasks)
+                viable = [
+                    t
+                    for t in self._tasks
+                    if t.report is not None
+                    and t.report.fits_memory
+                    and not t.report.error
+                ]
+            if not viable:
+                return ReplyEnvelope(
+                    payload=BestStrategyResponse(done=done)
+                )
+            best = min(
+                viable,
+                key=lambda t: (
+                    t.report.measured_step_seconds
+                    or t.report.est_step_seconds
+                ),
+            )
+            return ReplyEnvelope(
+                payload=BestStrategyResponse(
+                    found=True,
+                    done=done,
+                    strategy=strategy_to_dict(best.strategy),
+                )
+            )
+        return ReplyEnvelope(
+            success=False, reason=f"unknown get {type(req).__name__}"
+        )
+
+    def report(self, env: Envelope) -> ReplyEnvelope:
+        req = env.payload
+        if isinstance(req, StrategyReport):
+            with self._lock:
+                if 0 <= req.task_id < len(self._tasks):
+                    self._tasks[req.task_id].report = req
+                    return ReplyEnvelope()
+            return ReplyEnvelope(success=False, reason="bad task id")
+        return ReplyEnvelope(
+            success=False, reason=f"unknown report {type(req).__name__}"
+        )
+
+
+class AccelerationEngineService:
+    """Server wrapper (the reference's standalone engine process)."""
+
+    def __init__(
+        self,
+        candidates: List[Strategy],
+        run_steps: int = 0,
+        port: int = 0,
+    ):
+        self.servicer = AccelerationEngineServicer(
+            candidates, run_steps
+        )
+        self.port = port or find_free_port()
+        self._server = build_master_server(self.servicer, self.port)
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self):
+        self._server.start()
+        logger.info("acceleration engine on port %d", self.port)
+
+    def stop(self):
+        self._server.stop(grace=0.5)
+
+
+class EngineExecutor:
+    """Client loop: pull candidate → dry-run locally → report.
+
+    `runner` is an auto_engine.DryRunner bound to the caller's model."""
+
+    def __init__(self, addr: str, runner, executor_id: int = 0):
+        self._stub = MasterStub(addr)
+        self.runner = runner
+        self.executor_id = executor_id
+
+    def run_once(self) -> bool:
+        """Process one task; False when the board is empty."""
+        resp = self._stub.get(
+            StrategyTaskQuery(executor_id=self.executor_id)
+        )
+        task = resp.payload
+        if task is None or task.task_id < 0:
+            return False
+        strategy = strategy_from_dict(task.strategy)
+        rep = self.runner.profile(strategy, run_steps=task.run_steps)
+        self._stub.report(
+            StrategyReport(
+                task_id=task.task_id,
+                est_step_seconds=rep.est_step_seconds,
+                measured_step_seconds=rep.measured_step_seconds,
+                peak_memory_bytes=rep.peak_memory_bytes,
+                fits_memory=rep.fits_memory,
+                error=rep.error,
+            )
+        )
+        return True
+
+    def drain(self):
+        while self.run_once():
+            pass
+
+    def best(self) -> Optional[Strategy]:
+        resp = self._stub.get(BestStrategyQuery())
+        payload = resp.payload
+        if payload is None or not payload.found:
+            return None
+        return strategy_from_dict(payload.strategy)
+
+    def close(self):
+        self._stub.close()

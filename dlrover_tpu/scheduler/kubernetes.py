@@ -139,6 +139,39 @@ class K8sClient:
             {"status": status},
         )
 
+    def list_custom(
+        self, group: str, version: str, plural: str,
+        label_selector: str = "",
+    ) -> List[Dict]:
+        params = (
+            {"labelSelector": label_selector} if label_selector else None
+        )
+        out = self._t.request(
+            "GET",
+            f"/apis/{group}/{version}/namespaces/{self.namespace}/"
+            f"{plural}",
+            params=params,
+        )
+        return out.get("items", [])
+
+    def get_custom(
+        self, group: str, version: str, plural: str, name: str
+    ) -> Dict:
+        return self._t.request(
+            "GET",
+            f"/apis/{group}/{version}/namespaces/{self.namespace}/"
+            f"{plural}/{name}",
+        )
+
+    def delete_custom(
+        self, group: str, version: str, plural: str, name: str
+    ) -> Dict:
+        return self._t.request(
+            "DELETE",
+            f"/apis/{group}/{version}/namespaces/{self.namespace}/"
+            f"{plural}/{name}",
+        )
+
 
 class FakeK8sClient(K8sClient):
     """In-memory fake for tier-1 tests (reference mock_k8s_client)."""
@@ -148,6 +181,7 @@ class FakeK8sClient(K8sClient):
         self.pods: Dict[str, Dict] = {}
         self.services: Dict[str, Dict] = {}
         self.customs: List[Dict] = []
+        self._custom_plurals: List[str] = []  # aligned with customs
         self.deleted: List[str] = []
 
     def create_pod(self, manifest):
@@ -174,7 +208,50 @@ class FakeK8sClient(K8sClient):
 
     def create_custom(self, group, version, plural, manifest):
         self.customs.append(manifest)
+        self._custom_plurals.append(plural.lower())
         return manifest
+
+    def list_custom(
+        self, group, version, plural, label_selector: str = ""
+    ):
+        # selector semantics match the real API: every k=v must match
+        want = {}
+        for part in filter(None, label_selector.split(",")):
+            k, _, v = part.partition("=")
+            want[k.strip()] = v.strip()
+        out = []
+        for c, p in zip(self.customs, self._custom_plurals):
+            if p != plural.lower():
+                continue
+            labels = c.get("metadata", {}).get("labels", {})
+            if all(labels.get(k) == v for k, v in want.items()):
+                out.append(c)
+        return out
+
+    def get_custom(self, group, version, plural, name):
+        for c in self.list_custom(group, version, plural):
+            if c["metadata"]["name"] == name:
+                return c
+        raise RuntimeError(f"k8s GET {plural}/{name} -> 404")
+
+    def delete_custom(self, group, version, plural, name):
+        keep = [
+            (c, p)
+            for c, p in zip(self.customs, self._custom_plurals)
+            if not (
+                p == plural.lower()
+                and c["metadata"]["name"] == name
+            )
+        ]
+        deleted = len(self.customs) - len(keep)
+        self.customs = [c for c, _ in keep]
+        self._custom_plurals = [p for _, p in keep]
+        return {"deleted": deleted}
+
+    def patch_custom_status(self, group, version, plural, name, status):
+        cr = self.get_custom(group, version, plural, name)
+        cr.setdefault("status", {}).update(status)
+        return cr
 
     def set_pod_phase(self, name: str, phase: str, reason: str = ""):
         pod = self.pods[name]

@@ -94,8 +94,11 @@ def flatten_state(state: Any) -> Tuple[Dict[str, np.ndarray], bytes]:
                     "keys": [],
                     "indices": [],
                 }
+                # keys carry the process index so shard files from
+                # different hosts can be merged without collisions
+                proc = jax.process_index()
                 for i, shard in enumerate(leaf.addressable_shards):
-                    key = f"{p}#shard{i}"
+                    key = f"{p}#shard{proc}_{i}"
                     flat[key] = np.asarray(jax.device_get(shard.data))
                     entry["keys"].append(key)
                     entry["indices"].append(shard.index)
@@ -171,6 +174,37 @@ def _index_key(ix) -> tuple:
         (s.start, s.stop, s.step) if isinstance(s, slice) else s
         for s in ix
     )
+
+
+def _merge_aux(own_aux: bytes, other_auxes) -> bytes:
+    """Union the per-host shard metadata so a merged flat dict can be
+    stitched to full coverage (each host's aux lists only the shard
+    keys/indices that host staged)."""
+    meta = pickle.loads(own_aux)
+    shards = meta.get("shards", {})
+    for raw in other_auxes:
+        if raw is None:
+            continue
+        try:
+            other = pickle.loads(raw)
+        except Exception:  # noqa: BLE001 — a torn aux never blocks restore
+            continue
+        for p, entry in other.get("shards", {}).items():
+            mine = shards.setdefault(
+                p,
+                {
+                    "shape": entry["shape"],
+                    "dtype": entry["dtype"],
+                    "keys": [],
+                    "indices": [],
+                },
+            )
+            for k, ix in zip(entry["keys"], entry["indices"]):
+                if k not in mine["keys"]:
+                    mine["keys"].append(k)
+                    mine["indices"].append(ix)
+    meta["shards"] = shards
+    return pickle.dumps(meta)
 
 
 def unflatten_state(
@@ -347,14 +381,16 @@ class CheckpointEngine:
         )
         if aux is None:
             return -1, None
-        # merge every host's shard file visible on this storage (shared
-        # filesystems expose all of them → full coverage enables restore
-        # onto a DIFFERENT mesh; local disk sees just our own, which the
-        # target-placement path handles)
+        # merge every host's shard + aux file visible on this storage
+        # (shared filesystems expose all of them → full shard coverage,
+        # with per-host shard indices unioned from the aux files, lets a
+        # DIFFERENT mesh restore; local disk sees just our own, which
+        # the target-placement path handles)
+        listing = self.storage.listdir(step_dir) or []
         flat: Dict[str, np.ndarray] = {}
         names = [
             n
-            for n in (self.storage.listdir(step_dir) or [])
+            for n in listing
             if n.startswith("host_") and n.endswith(".npz")
         ] or [f"host_{self.node_rank}.npz"]
         for name in names:
@@ -366,6 +402,15 @@ class CheckpointEngine:
                     flat[k] = npz[k]
         if not flat:
             return -1, None
+        aux = _merge_aux(
+            aux,
+            [
+                self.storage.read(os.path.join(step_dir, n))
+                for n in listing
+                if n.startswith("aux_")
+                and n != f"aux_{self.node_rank}.pkl"
+            ],
+        )
         return step, unflatten_state(flat, aux, target)
 
     def load(

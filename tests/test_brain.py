@@ -1,0 +1,180 @@
+"""Brain service: datastore, the nine optimize algorithms, gRPC
+round-trips, and the master-side adapter.
+
+Mirrors the Go brain's table-driven optalgorithm tests
+(dlrover/go/brain/.../optalgorithm/*_test.go)."""
+
+import pytest
+
+from dlrover_tpu.brain import (
+    ALGORITHMS,
+    BrainClient,
+    BrainService,
+    JobMetricsStore,
+    OptimizeContext,
+    run_algorithm,
+)
+from dlrover_tpu.brain.datastore import JobMeta, RuntimeSample
+from dlrover_tpu.brain.service import BrainResourceOptimizer
+
+
+def _seed_history(store, name="train-job-1", n_jobs=3):
+    """Successful historical jobs with ps+worker series."""
+    for j in range(n_jobs):
+        uuid = f"hist-{j}"
+        store.upsert_job(
+            JobMeta(job_uuid=uuid, job_name=f"train-job-{j}",
+                    user="alice", status="succeeded")
+        )
+        for t in range(5):
+            store.add_sample(RuntimeSample(
+                job_uuid=uuid, role="ps", num_nodes=2,
+                cpu_percent=40 + 5 * t, memory_mb=4000 + 100 * t,
+            ))
+            store.add_sample(RuntimeSample(
+                job_uuid=uuid, role="worker",
+                num_nodes=2 + t % 3,
+                samples_per_sec=100.0 * (2 + t % 3) * (0.95 ** (t % 3)),
+            ))
+
+
+class TestAlgorithms:
+    def test_all_nine_registered(self):
+        assert len(ALGORITHMS) == 9
+        assert "optimize_job_hot_ps_resource" in ALGORITHMS
+
+    def test_ps_create_uses_history(self):
+        store = JobMetricsStore()
+        _seed_history(store)
+        store.upsert_job(JobMeta(job_uuid="me", job_name="train-job-9",
+                                 user="alice"))
+        ctx = OptimizeContext(job_uuid="me", store=store)
+        d = run_algorithm("optimize_job_ps_create_resource", ctx)
+        assert d.count == 2
+        assert d.memory_mb == pytest.approx(4400 * 1.2, rel=0.01)
+        store.close()
+
+    def test_ps_create_cold_fallback(self):
+        store = JobMetricsStore()
+        store.upsert_job(JobMeta(job_uuid="me", job_name="novel-job"))
+        ctx = OptimizeContext(job_uuid="me", store=store)
+        d = run_algorithm("optimize_job_ps_create_resource", ctx)
+        assert d.reason == "cold start defaults"
+        assert d.memory_mb == 8 * 1024
+        store.close()
+
+    def test_hot_ps_scales_out(self):
+        store = JobMetricsStore()
+        for _ in range(5):
+            store.add_sample(RuntimeSample(
+                job_uuid="me", role="ps", num_nodes=2, cpu_percent=90,
+            ))
+        ctx = OptimizeContext(
+            job_uuid="me", store=store,
+            current={"ps": {"count": 2}},
+        )
+        d = run_algorithm("optimize_job_hot_ps_resource", ctx)
+        assert d.count >= 3 and "hot ps" in d.reason
+        # cool PS → no change
+        store2 = JobMetricsStore()
+        store2.add_sample(RuntimeSample(
+            job_uuid="me", role="ps", num_nodes=2, cpu_percent=30,
+        ))
+        d2 = run_algorithm(
+            "optimize_job_hot_ps_resource",
+            OptimizeContext(job_uuid="me", store=store2),
+        )
+        assert d2.empty
+        store.close()
+        store2.close()
+
+    def test_oom_algorithms_grow_memory(self):
+        store = JobMetricsStore()
+        ctx = OptimizeContext(
+            job_uuid="me", store=store,
+            current={"ps": {"memory_mb": 4000},
+                     "worker": {"memory_mb": 6000}},
+        )
+        assert run_algorithm(
+            "optimize_job_ps_oom_resource", ctx
+        ).memory_mb == 6000
+        assert run_algorithm(
+            "optimize_job_worker_create_oom_resource", ctx
+        ).memory_mb == 9000
+        store.close()
+
+    def test_util_shrinks_overallocation(self):
+        store = JobMetricsStore()
+        for _ in range(6):
+            store.add_sample(RuntimeSample(
+                job_uuid="me", role="ps", num_nodes=2,
+                memory_mb=1000,
+            ))
+        ctx = OptimizeContext(
+            job_uuid="me", store=store,
+            current={"ps": {"memory_mb": 16000}},
+        )
+        d = run_algorithm("optimize_job_ps_resource_util", ctx)
+        assert d.memory_mb == 2000
+        store.close()
+
+    def test_worker_running_falls_back_on_degrade(self):
+        store = JobMetricsStore()
+        # 2 workers: 100/host; then 4 workers: 60/host (degraded)
+        store.add_sample(RuntimeSample(
+            job_uuid="me", role="worker", num_nodes=2,
+            samples_per_sec=200.0, ts=1.0,
+        ))
+        store.add_sample(RuntimeSample(
+            job_uuid="me", role="worker", num_nodes=4,
+            samples_per_sec=240.0, ts=2.0,
+        ))
+        d = run_algorithm(
+            "optimize_job_worker_resource",
+            OptimizeContext(job_uuid="me", store=store),
+        )
+        assert d.count == 2 and "fall back" in d.reason
+        store.close()
+
+
+class TestBrainService:
+    @pytest.fixture()
+    def brain(self):
+        svc = BrainService()
+        svc.start()
+        client = BrainClient(svc.addr)
+        yield svc, client
+        client.close()
+        svc.stop()
+
+    def test_persist_and_query(self, brain):
+        svc, client = brain
+        client.persist_job("j1", job_name="demo", user="bob")
+        client.persist_sample(
+            "j1", "worker", num_nodes=2, samples_per_sec=123.0,
+            global_step=10,
+        )
+        samples = client.get_job_metrics("j1", role="worker")
+        assert len(samples) == 1
+        assert samples[0]["samples_per_sec"] == 123.0
+
+    def test_optimize_rpc(self, brain):
+        svc, client = brain
+        resp = client.optimize(
+            "j1", "optimize_job_ps_oom_resource",
+            current={"ps": {"memory_mb": 2000}},
+        )
+        assert resp.memory_mb == 3000
+
+    def test_unknown_algorithm_is_error(self, brain):
+        svc, client = brain
+        assert client.optimize("j1", "nope") is None
+
+    def test_master_adapter(self, brain):
+        svc, client = brain
+        opt = BrainResourceOptimizer(client, "j1")
+        resp = opt.suggest(
+            "worker", "oom", {"worker": {"memory_mb": 1000}}
+        )
+        assert resp.memory_mb == 1500
+        assert opt.suggest("worker", "bogus-stage") is None

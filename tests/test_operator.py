@@ -1,0 +1,202 @@
+"""Operator: ElasticJob/ScalePlan reconcile semantics against the fake
+k8s client (mirrors the Go operator's controller tests)."""
+
+import pytest
+
+from dlrover_tpu.operator import (
+    ElasticJobReconciler,
+    OperatorController,
+    ScalePlanReconciler,
+    elastic_job_crd,
+    scale_plan_crd,
+)
+from dlrover_tpu.operator.crds import (
+    ELASTIC_GROUP,
+    ELASTIC_VERSION,
+    ELASTICJOB_PLURAL,
+    JobPhase,
+    make_elastic_job,
+)
+from dlrover_tpu.operator.reconciler import master_pod_name
+from dlrover_tpu.scheduler.kubernetes import FakeK8sClient
+
+
+@pytest.fixture()
+def k8s():
+    return FakeK8sClient()
+
+
+def _submit_job(k8s, name="demo", workers=2):
+    cr = make_elastic_job(name, workers=workers)
+    k8s.create_custom(
+        ELASTIC_GROUP, ELASTIC_VERSION, ELASTICJOB_PLURAL, cr
+    )
+    return cr
+
+
+class TestCrds:
+    def test_crd_manifests_well_formed(self):
+        for crd in (elastic_job_crd(), scale_plan_crd()):
+            assert crd["spec"]["group"] == ELASTIC_GROUP
+            v = crd["spec"]["versions"][0]
+            assert v["storage"] and "status" in v["subresources"]
+
+
+class TestElasticJobReconciler:
+    def test_creates_master_pod(self, k8s):
+        cr = _submit_job(k8s)
+        rec = ElasticJobReconciler(k8s)
+        phase = rec.reconcile(cr)
+        assert phase == JobPhase.PENDING
+        assert master_pod_name("demo") in k8s.pods
+        master = k8s.pods[master_pod_name("demo")]
+        assert master["metadata"]["labels"]["node-type"] == "master"
+
+    def test_phase_follows_master(self, k8s):
+        cr = _submit_job(k8s)
+        rec = ElasticJobReconciler(k8s)
+        rec.reconcile(cr)
+        k8s.set_pod_phase(master_pod_name("demo"), "Running")
+        assert rec.reconcile(cr) == JobPhase.RUNNING
+        k8s.set_pod_phase(master_pod_name("demo"), "Succeeded")
+        assert rec.reconcile(cr) == JobPhase.SUCCEEDED
+        # terminal: no further action
+        assert rec.reconcile(cr) == JobPhase.SUCCEEDED
+
+    def test_master_failure_relaunches_then_fails(self, k8s):
+        cr = _submit_job(k8s)
+        rec = ElasticJobReconciler(k8s, master_restart_limit=2)
+        rec.reconcile(cr)
+        for attempt in range(2):
+            k8s.set_pod_phase(master_pod_name("demo"), "Failed")
+            phase = rec.reconcile(cr)
+            assert phase == JobPhase.PENDING  # relaunched
+            assert master_pod_name("demo") in k8s.pods
+        k8s.set_pod_phase(master_pod_name("demo"), "Failed")
+        assert rec.reconcile(cr) == JobPhase.FAILED
+
+
+class TestScalePlanReconciler:
+    def test_executes_group_resources(self, k8s):
+        plan_cr = {
+            "apiVersion": f"{ELASTIC_GROUP}/{ELASTIC_VERSION}",
+            "kind": "ScalePlan",
+            "metadata": {"name": "demo-plan-0"},
+            "spec": {
+                "ownerJob": "demo",
+                "replicaResourceSpecs": {
+                    "worker": {
+                        "replicas": 3,
+                        "resource": {
+                            "cpu": "4",
+                            "memory": "2048Mi",
+                            "tpu": "4",
+                        },
+                    }
+                },
+            },
+        }
+        k8s.create_custom(
+            ELASTIC_GROUP, ELASTIC_VERSION, "scaleplans", plan_cr
+        )
+        rec = ScalePlanReconciler(k8s)
+        assert rec.reconcile(plan_cr) is True
+        workers = [
+            p
+            for p in k8s.pods.values()
+            if p["metadata"]["labels"].get("node-type") == "worker"
+        ]
+        assert len(workers) == 3
+        limits = workers[0]["spec"]["containers"][0]["resources"][
+            "limits"
+        ]
+        assert limits["memory"] == "2048Mi"
+        assert plan_cr["status"]["phase"] == "Succeeded"
+        # terminal plan: second reconcile is a no-op
+        assert rec.reconcile(plan_cr) is True
+        assert len(k8s.pods) == 3
+
+    def test_create_and_remove_pods(self, k8s):
+        plan_cr = {
+            "kind": "ScalePlan",
+            "metadata": {"name": "demo-plan-1"},
+            "spec": {
+                "ownerJob": "demo",
+                "createPods": [
+                    {"type": "worker", "id": 7, "rankIndex": 1}
+                ],
+                "removePods": [{"type": "worker", "id": 7}],
+            },
+        }
+        k8s.create_custom(
+            ELASTIC_GROUP, ELASTIC_VERSION, "scaleplans", plan_cr
+        )
+        rec = ScalePlanReconciler(k8s)
+        rec.reconcile(plan_cr)
+        # created then removed in one plan execution
+        assert "demo-worker-7" in k8s.deleted
+
+
+class TestControllerLoop:
+    def test_end_to_end_reconcile_once(self, k8s):
+        _submit_job(k8s, name="loopjob")
+        ctl = OperatorController(k8s, poll_interval=0.05)
+        ctl.reconcile_once()
+        assert master_pod_name("loopjob") in k8s.pods
+        cr = k8s.get_custom(
+            ELASTIC_GROUP,
+            ELASTIC_VERSION,
+            ELASTICJOB_PLURAL,
+            "loopjob",
+        )
+        assert cr["status"]["phase"] == JobPhase.PENDING
+
+    def test_background_loop(self, k8s):
+        import time
+
+        _submit_job(k8s, name="bg")
+        ctl = OperatorController(k8s, poll_interval=0.05)
+        ctl.start()
+        try:
+            deadline = time.time() + 3
+            while time.time() < deadline:
+                if master_pod_name("bg") in k8s.pods:
+                    break
+                time.sleep(0.05)
+            assert master_pod_name("bg") in k8s.pods
+        finally:
+            ctl.stop()
+
+
+class TestQuantityParsing:
+    def test_memory_units(self):
+        from dlrover_tpu.operator.reconciler import parse_memory_mb
+
+        assert parse_memory_mb("2048Mi") == 2048
+        assert parse_memory_mb("2Gi") == 2048
+        assert parse_memory_mb("1G") == 953
+        assert parse_memory_mb("512Ki") == 0  # sub-MiB rounds down
+        assert parse_memory_mb("") == 0
+        with pytest.raises(ValueError):
+            parse_memory_mb("16Q")
+
+    def test_bad_quantity_marks_plan_failed(self, k8s):
+        plan_cr = {
+            "kind": "ScalePlan",
+            "metadata": {"name": "bad-plan"},
+            "spec": {
+                "ownerJob": "demo",
+                "replicaResourceSpecs": {
+                    "worker": {
+                        "replicas": 1,
+                        "resource": {"memory": "16Q"},
+                    }
+                },
+            },
+        }
+        k8s.create_custom(
+            ELASTIC_GROUP, ELASTIC_VERSION, "scaleplans", plan_cr
+        )
+        rec = ScalePlanReconciler(k8s)
+        rec.reconcile(plan_cr)
+        assert plan_cr["status"]["phase"] == "Failed"
