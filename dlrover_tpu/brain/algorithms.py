@@ -87,21 +87,27 @@ def ps_create(ctx: OptimizeContext) -> ResourceDelta:
     history = ctx.store.similar_jobs(
         me.job_name if me else "", me.user if me else ""
     )
-    peaks_mem, peaks_cpu, counts = [], [], []
+    peaks_mem, need_cpu, counts = [], [], []
     for job in history:
         ss = ctx.store.samples(job.job_uuid, role="ps")
         if not ss:
             continue
         peaks_mem.append(max(s.memory_mb for s in ss))
-        peaks_cpu.append(max(s.cpu_percent for s in ss))
         counts.append(max(s.num_nodes for s in ss))
+        # utilization is a fraction of that job's ACTUAL allocation
+        alloc = (
+            ctx.store.job_resources(job.job_uuid)
+            .get("ps", {})
+            .get("cpu", COLD_PS_DEFAULT_CPU)
+        )
+        peak_pct = max(s.cpu_percent for s in ss)
+        need_cpu.append(peak_pct / 100.0 * float(alloc))
     if not peaks_mem:
         return ps_cold_create(ctx)
     return ResourceDelta(
         role="ps",
         count=int(statistics.median(counts)),
-        cpu=float(statistics.median(peaks_cpu)) / 100.0
-        * COLD_PS_DEFAULT_CPU,
+        cpu=float(statistics.median(need_cpu)) * 1.2,
         memory_mb=int(statistics.median(peaks_mem) * 1.2),
         reason="sized from similar historical jobs",
     )

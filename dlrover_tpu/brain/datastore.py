@@ -113,14 +113,22 @@ class JobMetricsStore:
         """Historical jobs of the same name prefix/user — the
         'similar job' lookup behind the create-resource algorithm."""
         prefix = job_name.rstrip("0123456789-_")
+        # escape LIKE metacharacters — '_' is near-universal in job
+        # names and would otherwise match any single character
+        escaped = (
+            prefix.replace("\\", "\\\\")
+            .replace("%", "\\%")
+            .replace("_", "\\_")
+        )
         with self._lock:
             rows = self._conn.execute(
                 "SELECT job_uuid, job_name, user, cluster, status, "
                 "created_at FROM job_meta "
-                "WHERE job_name LIKE ? AND status='succeeded' "
+                "WHERE job_name LIKE ? ESCAPE '\\' "
+                "AND status='succeeded' "
                 + ("AND user=? " if user else "")
                 + "ORDER BY created_at DESC LIMIT ?",
-                (prefix + "%",) + ((user,) if user else ()) + (limit,),
+                (escaped + "%",) + ((user,) if user else ()) + (limit,),
             ).fetchall()
         return [JobMeta(*r) for r in rows]
 

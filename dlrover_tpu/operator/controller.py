@@ -37,6 +37,7 @@ class OperatorController:
         self.plans = plan_reconciler or ScalePlanReconciler(k8s_client)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._seen_jobs: set = set()
 
     def reconcile_once(self):
         """One pass over every ElasticJob and pending ScalePlan."""
@@ -46,8 +47,15 @@ class OperatorController:
             )
         except Exception as e:  # noqa: BLE001
             logger.warning("list elasticjobs failed: %s", e)
-            job_crs = []
-        for cr in job_crs:
+            job_crs = None
+        if job_crs is not None:
+            current = {
+                cr.get("metadata", {}).get("name") for cr in job_crs
+            }
+            for gone in self._seen_jobs - current:
+                self.jobs.cleanup(gone)
+            self._seen_jobs = current
+        for cr in job_crs or []:
             try:
                 self.jobs.reconcile(cr)
             except Exception as e:  # noqa: BLE001

@@ -93,6 +93,23 @@ def build_master_pod(job_cr: Dict) -> Dict:
             "node-type": "master",
         },
     }
+    # ownerReference → k8s garbage-collects the master when the
+    # ElasticJob CR is deleted (uid present only on real clusters)
+    uid = job_cr.get("metadata", {}).get("uid")
+    if uid:
+        manifest["metadata"]["ownerReferences"] = [
+            {
+                "apiVersion": job_cr.get(
+                    "apiVersion",
+                    f"{ELASTIC_GROUP}/{ELASTIC_VERSION}",
+                ),
+                "kind": "ElasticJob",
+                "name": job,
+                "uid": uid,
+                "controller": True,
+                "blockOwnerDeletion": True,
+            }
+        ]
     return manifest
 
 
@@ -103,6 +120,16 @@ class ElasticJobReconciler:
         self._k8s = k8s_client
         self.master_restart_limit = master_restart_limit
         self._master_restarts: Dict[str, int] = {}
+
+    def cleanup(self, job: str):
+        """Job CR deleted: remove its master pod (the fallback when
+        ownerReference GC is unavailable, e.g. uid-less CRs)."""
+        try:
+            self._k8s.delete_pod(master_pod_name(job))
+            logger.info("operator: deleted master pod of gone job %s", job)
+        except Exception:  # noqa: BLE001 — already gone is fine
+            pass
+        self._master_restarts.pop(job, None)
 
     def reconcile(self, job_cr: Dict) -> str:
         """Returns the phase after reconciliation."""

@@ -11,6 +11,7 @@ and serves the winner. Single-host jobs can skip the service entirely
 and call StrategySearch directly (auto_engine.py)."""
 
 import threading
+import time
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
@@ -85,19 +86,29 @@ class _Task:
     task_id: int
     strategy: Strategy
     assigned: bool = False
+    assigned_at: float = 0.0
     report: Optional[StrategyReport] = None
 
 
 class AccelerationEngineServicer(MasterServicerBase):
-    """Task board for one search round."""
+    """Task board for one search round. Tasks claimed by an executor
+    that never reports back are re-leased after `lease_seconds` (the
+    executor host may have been preempted — the very scenario this
+    framework exists for)."""
 
-    def __init__(self, candidates: List[Strategy], run_steps: int = 0):
+    def __init__(
+        self,
+        candidates: List[Strategy],
+        run_steps: int = 0,
+        lease_seconds: float = 300.0,
+    ):
         self._lock = threading.Lock()
         self._tasks = [
             _Task(task_id=i, strategy=s)
             for i, s in enumerate(candidates)
         ]
         self.run_steps = run_steps
+        self.lease_seconds = lease_seconds
 
     def submit(self, candidates: List[Strategy]):
         with self._lock:
@@ -110,10 +121,17 @@ class AccelerationEngineServicer(MasterServicerBase):
     def get(self, env: Envelope) -> ReplyEnvelope:
         req = env.payload
         if isinstance(req, StrategyTaskQuery):
+            now = time.monotonic()
             with self._lock:
                 for t in self._tasks:
-                    if not t.assigned:
+                    expired = (
+                        t.assigned
+                        and t.report is None
+                        and now - t.assigned_at > self.lease_seconds
+                    )
+                    if not t.assigned or expired:
                         t.assigned = True
+                        t.assigned_at = now
                         return ReplyEnvelope(
                             payload=StrategyTaskResponse(
                                 task_id=t.task_id,

@@ -1,0 +1,34 @@
+"""RLHF: PPO training over a multi-model engine.
+
+Reference parity: atorch/rl — `rl_train` (rl/main.py:16), PPO trainer
+(rl/trainer/ppo_trainer.py), `ModelEngine` holding actor / critic /
+ref / reward models (rl/model_engine/model_engine.py), replay buffer,
+and a generation backend (rl/inference_backend/vllm_backend.py).
+
+TPU shape: every model is a pure (apply_fn, params) pair sharded by the
+same accelerate() machinery as pretraining; generation runs as a
+fixed-shape jitted sampler (one compile, no dynamic shapes), and the
+PPO update is a single SPMD train step."""
+
+from dlrover_tpu.rl.ppo import (
+    GaeConfig,
+    PpoConfig,
+    PpoTrainer,
+    compute_gae,
+    ppo_loss,
+)
+from dlrover_tpu.rl.model_engine import ModelEngine
+from dlrover_tpu.rl.replay_buffer import Experience, ReplayBuffer
+from dlrover_tpu.rl.generate import sample_tokens
+
+__all__ = [
+    "Experience",
+    "GaeConfig",
+    "ModelEngine",
+    "PpoConfig",
+    "PpoTrainer",
+    "ReplayBuffer",
+    "compute_gae",
+    "ppo_loss",
+    "sample_tokens",
+]

@@ -1,0 +1,85 @@
+"""Fixed-shape autoregressive sampling (the inference backend).
+
+Reference parity: atorch rl/inference_backend/vllm_backend.py — actor
+rollouts for PPO. TPU design: ONE jitted step function over a padded
+[batch, max_len] token buffer; each decode step runs the full causal
+forward and writes position t (causality makes padding beyond t
+irrelevant), so the program has a single static shape — no recompiles,
+no KV-cache bookkeeping. O(L) full passes is the honest cost here; a
+paged KV-cache decoder is the serving-path optimization."""
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(
+    jax.jit,
+    static_argnames=("apply_fn", "max_len", "temperature", "greedy"),
+)
+def _decode(
+    params,
+    tokens: jax.Array,      # [B, max_len] prompt-padded with pad_id
+    start_pos: jax.Array,   # [B] first generation position
+    key: jax.Array,
+    apply_fn: Callable,
+    max_len: int,
+    temperature: float,
+    greedy: bool,
+    eos_id: int,
+):
+    def step(carry, t):
+        toks, done, k = carry
+        logits = apply_fn(params, toks)  # [B, L, V]
+        last = logits[:, t - 1, :]
+        if greedy:
+            nxt = jnp.argmax(last, axis=-1)
+            k2 = k
+        else:
+            k2, sub = jax.random.split(k)
+            nxt = jax.random.categorical(
+                sub, last / jnp.maximum(temperature, 1e-6), axis=-1
+            )
+        gen_here = t >= start_pos  # still inside the prompt? keep it
+        nxt = jnp.where(gen_here & ~done, nxt, toks[:, t])
+        done = done | (gen_here & (nxt == eos_id))
+        toks = toks.at[:, t].set(nxt)
+        return (toks, done, k2), None
+
+    B = tokens.shape[0]
+    done0 = jnp.zeros((B,), jnp.bool_)
+    (toks, done, _), _ = jax.lax.scan(
+        step,
+        (tokens, done0, key),
+        jnp.arange(1, max_len),
+    )
+    return toks, done
+
+
+def sample_tokens(
+    apply_fn: Callable,
+    params,
+    prompts: jax.Array,
+    prompt_lens: jax.Array,
+    max_len: int,
+    key: Optional[jax.Array] = None,
+    temperature: float = 1.0,
+    greedy: bool = False,
+    eos_id: int = -1,
+) -> Tuple[jax.Array, jax.Array]:
+    """prompts: [B, max_len] (positions >= prompt_lens[b] ignored).
+    Returns (tokens [B, max_len], done [B])."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    return _decode(
+        params,
+        prompts,
+        prompt_lens,
+        key,
+        apply_fn=apply_fn,
+        max_len=max_len,
+        temperature=temperature,
+        greedy=greedy,
+        eos_id=eos_id,
+    )

@@ -1,0 +1,82 @@
+"""Multi-model engine: actor / critic / ref / reward under one roof.
+
+Reference parity: atorch rl/model_engine/model_engine.py — owns the four
+RLHF models, their optimizers and placement. Here each model is a pure
+(apply_fn, params) pair; apply_fn(params, tokens) returns logits for
+actor/ref, per-token values for the critic, and a scalar sequence score
+for the reward model. The ref model is frozen actor params by default."""
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    apply_fn: Callable  # (params, tokens[B,L]) -> model-specific output
+    params: Any
+    trainable: bool = False
+
+
+class ModelEngine:
+    def __init__(
+        self,
+        actor: ModelSpec,
+        critic: ModelSpec,
+        reward_fn: Callable,  # (tokens[B,L], lens[B]) -> rewards [B]
+        ref: Optional[ModelSpec] = None,
+    ):
+        self.actor = actor
+        self.critic = critic
+        self.reward_fn = reward_fn
+        # frozen reference policy for the KL penalty; defaults to a
+        # snapshot of the actor at engine construction
+        self.ref = ref or ModelSpec(
+            apply_fn=actor.apply_fn,
+            params=jax.tree_util.tree_map(
+                jnp.copy, actor.params
+            ),
+            trainable=False,
+        )
+
+    # ---- pure helpers (used inside jitted PPO steps) ---------------------
+
+    @staticmethod
+    def token_logprobs(
+        apply_fn: Callable, params, tokens: jax.Array
+    ) -> jax.Array:
+        """log pi(token_t | tokens_<t) for t >= 1 → [B, L-1]."""
+        logits = apply_fn(params, tokens)[:, :-1, :]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        tgt = tokens[:, 1:]
+        return jnp.take_along_axis(
+            logp, tgt[..., None], axis=-1
+        ).squeeze(-1)
+
+    def actor_logprobs(self, tokens):
+        return self.token_logprobs(
+            self.actor.apply_fn, self.actor.params, tokens
+        )
+
+    def ref_logprobs(self, tokens):
+        return self.token_logprobs(
+            self.ref.apply_fn, self.ref.params, tokens
+        )
+
+    def values(self, tokens):
+        return self.critic.apply_fn(self.critic.params, tokens)
+
+    def rewards(self, tokens, lens):
+        return self.reward_fn(tokens, lens)
+
+    def sync_ref(self):
+        """Refresh the frozen reference to the current actor (some PPO
+        variants re-anchor periodically)."""
+        self.ref = dataclasses.replace(
+            self.ref,
+            params=jax.tree_util.tree_map(
+                jnp.copy, self.actor.params
+            ),
+        )

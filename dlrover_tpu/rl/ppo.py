@@ -1,0 +1,304 @@
+"""PPO: GAE advantages, clipped losses, and the rollout→update trainer.
+
+Reference parity: atorch rl/trainer/ppo_trainer.py + rl/main.py:16
+`rl_train` — make_experience (actor rollouts scored by reward model,
+KL-penalized against the ref policy, advantages via GAE) followed by
+clipped-surrogate policy and value updates over replay minibatches."""
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dlrover_tpu.rl.generate import sample_tokens
+from dlrover_tpu.rl.model_engine import ModelEngine
+from dlrover_tpu.rl.replay_buffer import Experience, ReplayBuffer
+
+
+@dataclasses.dataclass(frozen=True)
+class GaeConfig:
+    gamma: float = 1.0
+    lam: float = 0.95
+
+
+@dataclasses.dataclass(frozen=True)
+class PpoConfig:
+    clip_ratio: float = 0.2
+    value_clip: float = 0.2
+    vf_coef: float = 0.5
+    entropy_coef: float = 0.0
+    kl_coef: float = 0.1          # reward-side KL penalty vs ref
+    epochs: int = 2
+    minibatch_size: int = 8
+    max_len: int = 32
+    temperature: float = 1.0
+    gae: GaeConfig = GaeConfig()
+
+
+def compute_gae(
+    rewards: jnp.ndarray,   # [B, T] per-step rewards
+    values: jnp.ndarray,    # [B, T]
+    mask: jnp.ndarray,      # [B, T]
+    cfg: GaeConfig,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Masked generalized advantage estimation (right-to-left scan)."""
+
+    def step(carry, xs):
+        # carry holds the NEXT step's (advantage, value), already zeroed
+        # when that step is padding — masked steps must not bootstrap
+        adv_next, val_next = carry
+        r, v, m = xs
+        delta = r + cfg.gamma * val_next - v
+        adv = delta + cfg.gamma * cfg.lam * adv_next
+        return (adv * m, v * m), adv
+
+    T = rewards.shape[1]
+    xs = (rewards.T, values.T, mask.T)  # scan over time
+    (_, _), advs = jax.lax.scan(
+        step,
+        (jnp.zeros(rewards.shape[0]), jnp.zeros(rewards.shape[0])),
+        xs,
+        reverse=True,
+    )
+    advantages = advs.T * mask
+    returns = advantages + values
+    return advantages, returns
+
+
+def ppo_loss(
+    actor_params,
+    critic_params,
+    engine_actor_apply: Callable,
+    engine_critic_apply: Callable,
+    batch: Dict[str, jnp.ndarray],
+    cfg: PpoConfig,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    tokens = batch["tokens"]
+    mask = batch["mask"]
+    old_logp = batch["logprobs"]
+    old_values = batch["values"]
+    adv = batch["advantages"]
+    ret = batch["returns"]
+
+    # normalize advantages over generated positions
+    denom = jnp.maximum(mask.sum(), 1.0)
+    a_mean = (adv * mask).sum() / denom
+    a_std = jnp.sqrt(
+        ((adv - a_mean) ** 2 * mask).sum() / denom + 1e-8
+    )
+    adv = (adv - a_mean) / a_std
+
+    new_logp = ModelEngine.token_logprobs(
+        engine_actor_apply, actor_params, tokens
+    )
+    ratio = jnp.exp(new_logp - old_logp)
+    surr = jnp.minimum(
+        ratio * adv,
+        jnp.clip(
+            ratio, 1 - cfg.clip_ratio, 1 + cfg.clip_ratio
+        ) * adv,
+    )
+    pg_loss = -(surr * mask).sum() / denom
+
+    values = engine_critic_apply(critic_params, tokens)[:, :-1]
+    v_clipped = old_values + jnp.clip(
+        values - old_values, -cfg.value_clip, cfg.value_clip
+    )
+    vf = jnp.maximum(
+        (values - ret) ** 2, (v_clipped - ret) ** 2
+    )
+    vf_loss = 0.5 * (vf * mask).sum() / denom
+
+    entropy = -(new_logp * mask).sum() / denom  # logprob proxy
+
+    total = (
+        pg_loss
+        + cfg.vf_coef * vf_loss
+        - cfg.entropy_coef * entropy
+    )
+    return total, {
+        "pg_loss": pg_loss,
+        "vf_loss": vf_loss,
+        "ratio_mean": (ratio * mask).sum() / denom,
+    }
+
+
+class PpoTrainer:
+    """Rollout → experience → minibatch PPO epochs."""
+
+    def __init__(
+        self,
+        engine: ModelEngine,
+        cfg: PpoConfig = PpoConfig(),
+        actor_opt: Optional[optax.GradientTransformation] = None,
+        critic_opt: Optional[optax.GradientTransformation] = None,
+        eos_id: int = -1,
+    ):
+        self.engine = engine
+        self.cfg = cfg
+        self.eos_id = eos_id
+        self.actor_opt = actor_opt or optax.adam(1e-4)
+        self.critic_opt = critic_opt or optax.adam(1e-4)
+        self.actor_opt_state = self.actor_opt.init(engine.actor.params)
+        self.critic_opt_state = self.critic_opt.init(
+            engine.critic.params
+        )
+        self.buffer = ReplayBuffer()
+        self._update = jax.jit(self._update_fn)
+
+    # ---- rollout ---------------------------------------------------------
+
+    def make_experience(
+        self, prompts: jnp.ndarray, prompt_lens: jnp.ndarray,
+        key: jax.Array,
+    ) -> Experience:
+        cfg = self.cfg
+        eng = self.engine
+        tokens, _ = sample_tokens(
+            eng.actor.apply_fn,
+            eng.actor.params,
+            prompts,
+            prompt_lens,
+            cfg.max_len,
+            key=key,
+            temperature=cfg.temperature,
+            eos_id=self.eos_id,
+        )
+        logp = eng.actor_logprobs(tokens)         # [B, L-1]
+        ref_logp = eng.ref_logprobs(tokens)
+        values = eng.values(tokens)[:, :-1]       # [B, L-1]
+        seq_reward = eng.rewards(tokens, prompt_lens)  # [B]
+
+        B, L = tokens.shape
+        pos_full = jnp.arange(L)[None, :]
+        gen_full = pos_full >= prompt_lens[:, None]
+        # each sequence ends at its first generated EOS (or the buffer
+        # end); positions after it are padding and must not train
+        if self.eos_id >= 0:
+            is_eos = (tokens == self.eos_id) & gen_full
+            has_eos = is_eos.any(axis=1)
+            end_pos = jnp.where(
+                has_eos, jnp.argmax(is_eos, axis=1), L - 1
+            )
+        else:
+            end_pos = jnp.full((B,), L - 1, jnp.int32)
+
+        pos = jnp.arange(1, L)[None, :]
+        mask = (
+            (pos >= prompt_lens[:, None])
+            & (pos <= end_pos[:, None])
+        ).astype(jnp.float32)
+
+        # per-step reward: KL penalty everywhere + sequence reward on
+        # the sequence's actual final step (the standard RLHF shaping)
+        kl = logp - ref_logp
+        step_rewards = -cfg.kl_coef * kl * mask
+        last_idx = jnp.clip(end_pos - 1, 0, L - 2)
+        step_rewards = step_rewards.at[
+            jnp.arange(B), last_idx
+        ].add(seq_reward)
+
+        adv, ret = compute_gae(
+            step_rewards, values, mask, cfg.gae
+        )
+        return Experience(
+            tokens=np.asarray(tokens),
+            prompt_lens=np.asarray(prompt_lens),
+            logprobs=np.asarray(logp),
+            values=np.asarray(values),
+            advantages=np.asarray(adv),
+            returns=np.asarray(ret),
+            mask=np.asarray(mask),
+        )
+
+    # ---- update ----------------------------------------------------------
+
+    def _update_fn(
+        self, actor_params, critic_params,
+        actor_opt_state, critic_opt_state, batch,
+    ):
+        eng = self.engine
+        cfg = self.cfg
+
+        def actor_loss(ap):
+            return ppo_loss(
+                ap, critic_params,
+                eng.actor.apply_fn, eng.critic.apply_fn,
+                batch, cfg,
+            )
+
+        (loss, metrics), grads = jax.value_and_grad(
+            actor_loss, has_aux=True
+        )(actor_params)
+        a_up, actor_opt_state = self.actor_opt.update(
+            grads, actor_opt_state, actor_params
+        )
+        actor_params = optax.apply_updates(actor_params, a_up)
+
+        def critic_loss(cp):
+            total, m = ppo_loss(
+                actor_params, cp,
+                eng.actor.apply_fn, eng.critic.apply_fn,
+                batch, cfg,
+            )
+            return m["vf_loss"]
+
+        c_grads = jax.grad(critic_loss)(critic_params)
+        c_up, critic_opt_state = self.critic_opt.update(
+            c_grads, critic_opt_state, critic_params
+        )
+        critic_params = optax.apply_updates(critic_params, c_up)
+        metrics = dict(metrics)
+        metrics["loss"] = loss
+        return (
+            actor_params, critic_params,
+            actor_opt_state, critic_opt_state, metrics,
+        )
+
+    def train_on_buffer(self, rng=None) -> Dict[str, float]:
+        cfg = self.cfg
+        eng = self.engine
+        last_metrics: Dict[str, float] = {}
+        for mb in self.buffer.minibatches(
+            cfg.minibatch_size,
+            rng=rng or np.random.default_rng(0),
+            epochs=cfg.epochs,
+        ):
+            batch = {
+                "tokens": jnp.asarray(mb.tokens),
+                "mask": jnp.asarray(mb.mask),
+                "logprobs": jnp.asarray(mb.logprobs),
+                "values": jnp.asarray(mb.values),
+                "advantages": jnp.asarray(mb.advantages),
+                "returns": jnp.asarray(mb.returns),
+            }
+            (
+                eng.actor.params,
+                eng.critic.params,
+                self.actor_opt_state,
+                self.critic_opt_state,
+                metrics,
+            ) = self._update(
+                eng.actor.params,
+                eng.critic.params,
+                self.actor_opt_state,
+                self.critic_opt_state,
+                batch,
+            )
+            last_metrics = {
+                k: float(v) for k, v in metrics.items()
+            }
+        return last_metrics
+
+    def step(
+        self, prompts, prompt_lens, key
+    ) -> Dict[str, float]:
+        """One PPO iteration: rollout, buffer, update, clear."""
+        exp = self.make_experience(prompts, prompt_lens, key)
+        self.buffer.add(exp)
+        metrics = self.train_on_buffer()
+        self.buffer.clear()
+        return metrics

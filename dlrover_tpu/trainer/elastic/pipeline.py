@@ -1,0 +1,393 @@
+"""Input-pipeline acceleration: shm dataloader, device preloader,
+coworker data service.
+
+Reference parity: atorch data/{shm_dataloader.py,shm_context.py}
+(cross-process shared-memory batch transport), data/preloader.py
+(overlap host→device copy with compute), and
+service/coworker_data_service.py (CPU-pod preprocessing offload pulled
+by trainers over gRPC).
+
+TPU notes: the training process must spend its time in jitted device
+steps, not in Python collate loops — batches are produced in a separate
+*process* (shm ring) or separate *pods* (coworker service), and the
+preloader hides the host→HBM transfer behind the previous step's
+execution (async dispatch means device_put returns immediately; by the
+time the step needs the batch it is already resident)."""
+
+import multiprocessing as mp
+import pickle
+import queue as _queue
+import threading
+import time
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+# fork() in a process with live JAX/gRPC threads can deadlock the child;
+# the producer is spawned fresh instead
+_MP = mp.get_context("spawn")
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+# ---------------------------------------------------------------------------
+# shm ring dataloader
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ArraySpec:
+    name: str
+    shape: tuple
+    dtype: str
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape)) * np.dtype(self.dtype).itemsize
+
+
+class ShmBatchRing:
+    """Fixed-slot shared-memory ring carrying dict-of-ndarray batches.
+
+    One producer process fills free slots; one consumer drains ready
+    slots. Slot layout: the arrays of `specs` concatenated. Fixed shapes
+    are a feature on TPU (XLA recompiles on shape change anyway)."""
+
+    def __init__(
+        self,
+        specs: List[ArraySpec],
+        n_slots: int = 8,
+        name: Optional[str] = None,
+        create: bool = True,
+    ):
+        self.specs = list(specs)
+        self.n_slots = n_slots
+        self.slot_bytes = sum(s.nbytes for s in self.specs)
+        total = self.slot_bytes * n_slots
+        if create:
+            self.shm = shared_memory.SharedMemory(
+                create=True, size=max(total, 1), name=name
+            )
+        else:
+            self.shm = shared_memory.SharedMemory(name=name)
+        self.free = _MP.Queue()
+        self.ready = _MP.Queue()
+        for i in range(n_slots):
+            self.free.put(i)
+
+    # producer side --------------------------------------------------------
+
+    def put(self, batch: Dict[str, np.ndarray], timeout=None) -> None:
+        slot = self.free.get(timeout=timeout)
+        off = slot * self.slot_bytes
+        for spec in self.specs:
+            arr = np.ascontiguousarray(
+                batch[spec.name], dtype=np.dtype(spec.dtype)
+            )
+            if tuple(arr.shape) != tuple(spec.shape):
+                self.free.put(slot)
+                raise ValueError(
+                    f"batch[{spec.name!r}] shape {arr.shape} != spec "
+                    f"{spec.shape}"
+                )
+            view = np.ndarray(
+                spec.shape,
+                dtype=spec.dtype,
+                buffer=self.shm.buf,
+                offset=off,
+            )
+            view[...] = arr
+            off += spec.nbytes
+        self.ready.put(slot)
+
+    def put_eof(self):
+        self.ready.put(-1)
+
+    # consumer side --------------------------------------------------------
+
+    def get(self, timeout=None) -> Optional[Dict[str, np.ndarray]]:
+        """None signals end-of-stream."""
+        slot = self.ready.get(timeout=timeout)
+        if slot < 0:
+            return None
+        off = slot * self.slot_bytes
+        out = {}
+        for spec in self.specs:
+            view = np.ndarray(
+                spec.shape,
+                dtype=spec.dtype,
+                buffer=self.shm.buf,
+                offset=off,
+            )
+            out[spec.name] = np.array(view)  # copy out, free the slot
+            off += spec.nbytes
+        self.free.put(slot)
+        return out
+
+    def close(self, unlink: bool = False):
+        self.shm.close()
+        if unlink:
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:
+                pass
+
+
+def _producer_main(ring: ShmBatchRing, make_iter, n_batches: int):
+    it = make_iter()
+    produced = 0
+    for batch in it:
+        ring.put(batch)
+        produced += 1
+        if 0 < n_batches <= produced:
+            break
+    ring.put_eof()
+
+
+class ShmDataLoader:
+    """Producer-process dataloader over a ShmBatchRing.
+
+    make_iter: picklable zero-arg callable returning an iterator of
+    dict-of-ndarray batches (runs in the child process)."""
+
+    def __init__(
+        self,
+        make_iter: Callable[[], Iterable[Dict[str, np.ndarray]]],
+        specs: List[ArraySpec],
+        n_slots: int = 8,
+        n_batches: int = 0,
+    ):
+        self.ring = ShmBatchRing(specs, n_slots=n_slots)
+        self._proc = _MP.Process(
+            target=_producer_main,
+            args=(self.ring, make_iter, n_batches),
+            daemon=True,
+        )
+        self._started = False
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if not self._started:
+            self._proc.start()
+            self._started = True
+        while True:
+            batch = self.ring.get()
+            if batch is None:
+                break
+            yield batch
+
+    def close(self):
+        if self._started and self._proc.is_alive():
+            self._proc.terminate()
+        if self._started:
+            self._proc.join(timeout=5)
+        self.ring.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# device preloader (double buffering)
+# ---------------------------------------------------------------------------
+
+
+class DevicePreloader:
+    """Wrap a host-batch iterable; keep `depth` batches already
+    device_put so the step never waits on host→HBM DMA.
+
+    place(batch) -> device batch (e.g. Accelerated.shard_batch)."""
+
+    def __init__(
+        self,
+        source: Iterable,
+        place: Callable[[Any], Any],
+        depth: int = 2,
+    ):
+        self.source = source
+        self.place = place
+        self.depth = depth
+
+    def __iter__(self):
+        buf: _queue.Queue = _queue.Queue(maxsize=self.depth)
+        DONE = object()
+        err: List[BaseException] = []
+        abandoned = threading.Event()
+
+        def _feed():
+            try:
+                for b in self.source:
+                    placed = self.place(b)  # async dispatch: fast
+                    while not abandoned.is_set():
+                        try:
+                            buf.put(placed, timeout=0.5)
+                            break
+                        except _queue.Full:
+                            continue
+                    if abandoned.is_set():
+                        return  # consumer gone: drop refs, free HBM
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err.append(e)
+            finally:
+                while not abandoned.is_set():
+                    try:
+                        buf.put(DONE, timeout=0.5)
+                        break
+                    except _queue.Full:
+                        continue
+
+        t = threading.Thread(target=_feed, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = buf.get()
+                if item is DONE:
+                    break
+                yield item
+            t.join()
+            if err:
+                raise err[0]
+        finally:
+            # consumer broke out early (exception / early stop): unblock
+            # the feeder and release its device-resident batches
+            abandoned.set()
+            while not buf.empty():
+                try:
+                    buf.get_nowait()
+                except _queue.Empty:
+                    break
+
+
+# ---------------------------------------------------------------------------
+# coworker data service (CPU-pod preprocessing offload)
+# ---------------------------------------------------------------------------
+
+
+from dlrover_tpu.common.comm import (  # noqa: E402
+    Envelope,
+    MasterServicerBase,
+    MasterStub,
+    ReplyEnvelope,
+    build_master_server,
+)
+from dlrover_tpu.common.messages import BaseRequest, find_free_port  # noqa: E402
+
+
+@dataclass
+class PushBatch(BaseRequest):
+    data: bytes = b""  # pickled dict of ndarrays
+
+
+@dataclass
+class PullBatch(BaseRequest):
+    timeout: float = 0.0
+
+
+@dataclass
+class PulledBatch:
+    data: bytes = b""
+    eof: bool = False
+
+
+@dataclass
+class EndOfData(BaseRequest):
+    pass
+
+
+class CoworkerDataServicer(MasterServicerBase):
+    """Bounded batch queue: coworker pods report batches, trainers get
+    them (reference coworker_data_service.py)."""
+
+    def __init__(self, max_batches: int = 64):
+        self._q: _queue.Queue = _queue.Queue(maxsize=max_batches)
+        self._eof = threading.Event()
+
+    def report(self, env: Envelope) -> ReplyEnvelope:
+        req = env.payload
+        if isinstance(req, PushBatch):
+            try:
+                self._q.put(req.data, timeout=30)
+            except _queue.Full:
+                return ReplyEnvelope(
+                    success=False, reason="queue full"
+                )
+            return ReplyEnvelope()
+        if isinstance(req, EndOfData):
+            self._eof.set()
+            return ReplyEnvelope()
+        return ReplyEnvelope(success=False, reason="unknown report")
+
+    def get(self, env: Envelope) -> ReplyEnvelope:
+        req = env.payload
+        if isinstance(req, PullBatch):
+            try:
+                data = self._q.get(
+                    timeout=req.timeout if req.timeout > 0 else 0.01
+                )
+                return ReplyEnvelope(payload=PulledBatch(data=data))
+            except _queue.Empty:
+                return ReplyEnvelope(
+                    payload=PulledBatch(eof=self._eof.is_set())
+                )
+        return ReplyEnvelope(success=False, reason="unknown get")
+
+
+class CoworkerDataService:
+    def __init__(self, max_batches: int = 64, port: int = 0):
+        self.servicer = CoworkerDataServicer(max_batches)
+        self.port = port or find_free_port()
+        self._server = build_master_server(self.servicer, self.port)
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self):
+        self._server.start()
+        logger.info("coworker data service on port %d", self.port)
+
+    def stop(self):
+        self._server.stop(grace=0.5)
+
+
+class CoworkerProducer:
+    """Runs on CPU pods: push preprocessed batches."""
+
+    def __init__(self, addr: str):
+        self._stub = MasterStub(addr)
+
+    def push(self, batch: Dict[str, np.ndarray]):
+        resp = self._stub.report(
+            PushBatch(data=pickle.dumps(batch, protocol=4))
+        )
+        if not resp.success:
+            raise RuntimeError(f"push rejected: {resp.reason}")
+
+    def end(self):
+        self._stub.report(EndOfData())
+
+    def close(self):
+        self._stub.close()
+
+
+class CoworkerConsumer:
+    """Runs on training hosts: iterate remote batches."""
+
+    def __init__(self, addr: str, poll_timeout: float = 1.0):
+        self._stub = MasterStub(addr)
+        self.poll_timeout = poll_timeout
+
+    def __iter__(self):
+        while True:
+            resp = self._stub.get(
+                PullBatch(timeout=self.poll_timeout)
+            )
+            pulled = resp.payload
+            if pulled is None:
+                break
+            if pulled.data:
+                yield pickle.loads(pulled.data)
+            elif pulled.eof:
+                break
+            # else: transient empty queue — poll again
+
+    def close(self):
+        self._stub.close()

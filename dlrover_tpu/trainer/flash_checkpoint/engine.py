@@ -376,9 +376,20 @@ class CheckpointEngine:
         if step < 0:
             return -1, None
         step_dir = os.path.join(self.checkpoint_dir, str(step))
+        listing = self.storage.listdir(step_dir) or []
         aux = self.storage.read(
             os.path.join(step_dir, f"aux_{self.node_rank}.pkl")
         )
+        if aux is None:
+            # a host added by a scale-up has no aux of its own — any
+            # peer's aux carries the same treedef/paths
+            for n in listing:
+                if n.startswith("aux_"):
+                    aux = self.storage.read(
+                        os.path.join(step_dir, n)
+                    )
+                    if aux is not None:
+                        break
         if aux is None:
             return -1, None
         # merge every host's shard + aux file visible on this storage
@@ -386,7 +397,6 @@ class CheckpointEngine:
         # with per-host shard indices unioned from the aux files, lets a
         # DIFFERENT mesh restore; local disk sees just our own, which
         # the target-placement path handles)
-        listing = self.storage.listdir(step_dir) or []
         flat: Dict[str, np.ndarray] = {}
         names = [
             n

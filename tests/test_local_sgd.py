@@ -1,0 +1,135 @@
+"""Local SGD / HSDP: reducers + periodic-sync trainer on the 8-device
+CPU mesh (test tier 2)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlrover_tpu.parallel.local_sgd import (
+    LocalSgdConfig,
+    LocalSgdTrainer,
+    gta_reduce,
+    linear_reduce,
+    shard_map,
+    sparsify_reduce,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8-device mesh"
+)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def _run_reducer(fn, per_replica):
+    """per_replica: [8, ...] array — one slice per rank."""
+    mesh = _mesh()
+    f = shard_map(
+        lambda x: fn(x[0], "data")[None],
+        mesh=mesh,
+        in_specs=P("data"),
+        out_specs=P("data"),
+    )
+    out = f(per_replica)
+    return np.asarray(out)
+
+
+class TestReducers:
+    def test_linear_is_mean(self):
+        x = jnp.arange(8.0).reshape(8, 1)
+        out = _run_reducer(linear_reduce, x)
+        np.testing.assert_allclose(out, 3.5)
+
+    def test_gta_sign_election(self):
+        # 5 replicas push +1, 3 push -3: majority sign is +, so the
+        # merged value averages only the agreeing +1s
+        vals = jnp.array([1.0] * 5 + [-3.0] * 3).reshape(8, 1)
+        out = _run_reducer(gta_reduce, vals)
+        np.testing.assert_allclose(out, 1.0)
+        # linear would have been (5*1 - 3*3)/8 = -0.5: GTA protects the
+        # majority direction
+        lin = _run_reducer(linear_reduce, vals)
+        np.testing.assert_allclose(lin, -0.5)
+
+    def test_sparsify_keeps_top_fraction(self):
+        # each replica has one big entry and many small ones
+        base = jnp.full((8, 10), 0.01)
+        big = base.at[:, 0].set(5.0)
+        out = _run_reducer(
+            functools.partial(sparsify_reduce, density=0.1), big
+        )
+        np.testing.assert_allclose(out[:, 0], 5.0)
+        np.testing.assert_allclose(out[:, 1:], 0.0)
+
+
+class TestLocalSgdTrainer:
+    def _make(self, **cfg_kw):
+        target = jnp.linspace(-1.0, 1.0, 16).reshape(4, 4)
+
+        def init(key):
+            return {"w": jnp.zeros((4, 4))}
+
+        def loss_fn(params, batch):
+            # per-replica quadratic (batch unused beyond sharding shape)
+            return jnp.sum((params["w"] - target) ** 2) + 0.0 * jnp.sum(
+                batch
+            )
+
+        trainer = LocalSgdTrainer(
+            init,
+            loss_fn,
+            optax.sgd(0.3),
+            LocalSgdConfig(**cfg_kw),
+            mesh=_mesh(),
+        )
+        return trainer, target
+
+    def test_converges_with_periodic_sync(self):
+        trainer, target = self._make(sync_every=4, reducer="linear")
+        state = trainer.init(jax.random.PRNGKey(0))
+        batch = jnp.zeros((8, 2))
+        loss = None
+        for _ in range(24):
+            state, loss = trainer.step(state, batch)
+        assert float(loss) < 1e-3
+        merged = trainer.global_params(state)["w"]
+        np.testing.assert_allclose(
+            merged, np.asarray(target), atol=0.05
+        )
+
+    def test_anchor_only_moves_on_sync(self):
+        trainer, _ = self._make(sync_every=4)
+        state = trainer.init(jax.random.PRNGKey(0))
+        batch = jnp.zeros((8, 2))
+        anchor0 = trainer.global_params(state)["w"].copy()
+        for _ in range(3):  # steps 1-3: no sync yet
+            state, _ = trainer.step(state, batch)
+        np.testing.assert_array_equal(
+            trainer.global_params(state)["w"], anchor0
+        )
+        state, _ = trainer.step(state, batch)  # step 4: sync
+        assert not np.array_equal(
+            trainer.global_params(state)["w"], anchor0
+        )
+
+    def test_gta_and_momentum_variants_train(self):
+        # sparsify keeps only top-density deltas, so outer momentum
+        # would amplify the truncation oscillation — run it plain
+        for reducer, momentum in (("gta", 0.6), ("sparsify", 0.0)):
+            trainer, _ = self._make(
+                sync_every=2,
+                reducer=reducer,
+                outer_momentum=momentum,
+            )
+            state = trainer.init(jax.random.PRNGKey(1))
+            batch = jnp.zeros((8, 2))
+            for _ in range(20):
+                state, loss = trainer.step(state, batch)
+            assert float(loss) < 0.1, reducer

@@ -200,3 +200,16 @@ class TestQuantityParsing:
         rec = ScalePlanReconciler(k8s)
         rec.reconcile(plan_cr)
         assert plan_cr["status"]["phase"] == "Failed"
+
+
+class TestJobCleanup:
+    def test_deleted_job_removes_master(self, k8s):
+        _submit_job(k8s, name="gone")
+        ctl = OperatorController(k8s, poll_interval=0.05)
+        ctl.reconcile_once()
+        assert master_pod_name("gone") in k8s.pods
+        k8s.delete_custom(
+            ELASTIC_GROUP, ELASTIC_VERSION, ELASTICJOB_PLURAL, "gone"
+        )
+        ctl.reconcile_once()
+        assert master_pod_name("gone") not in k8s.pods
