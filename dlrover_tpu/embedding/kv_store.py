@@ -138,6 +138,14 @@ def _lib() -> ctypes.CDLL:
     lib.kv_import_full.argtypes = [
         p, i64p, f32p, u32p, i64, ctypes.c_int,
     ]
+    lib.kv_set_spill_path.restype = ctypes.c_int
+    lib.kv_set_spill_path.argtypes = [p, ctypes.c_char_p]
+    lib.kv_spill.restype = i64
+    lib.kv_spill.argtypes = [p, u32, ctypes.c_double]
+    lib.kv_disk_size.restype = i64
+    lib.kv_disk_size.argtypes = [p]
+    lib.kv_compact.restype = i64
+    lib.kv_compact.argtypes = [p]
     _LIB = lib
     return lib
 
@@ -239,6 +247,36 @@ class KvEmbeddingTable:
             ctypes.c_float(eps), step, ctypes.c_float(l1),
             ctypes.c_float(l2),
         )
+
+    # ---- hybrid DRAM/disk tier (reference tfplus hybrid_embedding) ----
+
+    def set_spill_path(self, path: str) -> bool:
+        """Enable the disk tier; cold rows move there via spill() and
+        promote back transparently on access."""
+        return bool(
+            self._lib.kv_set_spill_path(self._h, path.encode())
+        )
+
+    def spill(
+        self, min_freq: int = 0, max_idle_sec: float = 0.0
+    ) -> int:
+        """Demote cold rows (freq < min_freq OR idle > max_idle_sec)
+        to the disk tier. Returns rows moved."""
+        return int(
+            self._lib.kv_spill(
+                self._h,
+                ctypes.c_uint32(min_freq),
+                ctypes.c_double(max_idle_sec),
+            )
+        )
+
+    def disk_size(self) -> int:
+        return int(self._lib.kv_disk_size(self._h))
+
+    def compact(self) -> int:
+        """Rewrite the spill file dropping dead (promoted/evicted)
+        records; returns live disk rows."""
+        return int(self._lib.kv_compact(self._h))
 
     def evict(self, min_freq: int = 0, max_idle_sec: float = 0.0) -> int:
         """Drop cold (freq < min_freq) or idle rows; returns count."""

@@ -125,3 +125,71 @@ class KvEmbeddingLayer:
     def load_state_dict(self, state: dict):
         self._step = int(state.get("step", 0))
         self.table.load_state_dict(state)
+
+
+class MultiHashEmbeddingLayer:
+    """Compressed embedding via the quotient–remainder multi-hash trick.
+
+    Reference parity: TFPlus KvVariable multi-hash compression
+    (kv_variable.h — a huge key space backed by much smaller physical
+    tables). A key's vector is combine(q_table[key // buckets],
+    r_table[key % buckets]): collisions in one sub-table are
+    disambiguated by the other, so ~2*buckets rows serve buckets^2 keys.
+    combine is "add" or "mul" (element-wise).
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        buckets: int,
+        combine: str = "add",       # add | mul
+        optimizer: str = "adam",
+        lr: float = 1e-3,
+        initializer: str = "normal",
+        seed: int = 0,
+    ):
+        if combine not in ("add", "mul"):
+            raise ValueError(f"unknown combine: {combine}")
+        self.dim = dim
+        self.buckets = int(buckets)
+        self.combine = combine
+        self.q = KvEmbeddingLayer(
+            dim, optimizer=optimizer, lr=lr,
+            initializer=initializer, seed=seed,
+        )
+        self.r = KvEmbeddingLayer(
+            dim, optimizer=optimizer, lr=lr,
+            initializer=initializer, seed=seed + 1,
+        )
+
+    def _split(self, ids):
+        ids = np.asarray(ids)
+        return ids // self.buckets, ids % self.buckets
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        qi = ids // self.buckets
+        ri = ids % self.buckets
+        eq = self.q(qi)
+        er = self.r(ri)
+        return eq + er if self.combine == "add" else eq * er
+
+    def apply_grads(self, ids, grads):
+        """Chain rule through the combine: add → both get g;
+        mul → each gets g * other's value."""
+        qi, ri = self._split(ids)
+        if self.combine == "add":
+            self.q.apply_grads(qi, grads)
+            self.r.apply_grads(ri, grads)
+            return
+        g = np.asarray(grads, np.float32).reshape(-1, self.dim)
+        vq = self.q.table.lookup(qi.ravel(), insert_missing=True)
+        vr = self.r.table.lookup(ri.ravel(), insert_missing=True)
+        self.q.apply_grads(qi, g * vr)
+        self.r.apply_grads(ri, g * vq)
+
+    def state_dict(self) -> dict:
+        return {"q": self.q.state_dict(), "r": self.r.state_dict()}
+
+    def load_state_dict(self, state: dict):
+        self.q.load_state_dict(state["q"])
+        self.r.load_state_dict(state["r"])

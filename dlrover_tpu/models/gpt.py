@@ -1,0 +1,224 @@
+"""GPT-2 / nanoGPT model family (the reference's second workload).
+
+Reference parity: the nanoGPT examples are DLRover's acceptance
+workloads (examples/pytorch/nanogpt/{train.py,fsdp_train.py,ds_train.py}
+and atorch/examples/nanoGPTATorch); the perf baselines in BASELINE.md
+quote GPT-2 sizes. Architecture follows GPT-2: learned positional
+embeddings, pre-LayerNorm blocks, GELU MLP, standard (non-GQA) MHA,
+weight-tied LM head.
+
+TPU idiom matches models/llama.py: stacked layer weights consumed by
+one `lax.scan` body (single compiled layer, natural remat point), GSPMD
+partition rules over the canonical mesh axes, f32 logits."""
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.ops.attention import dot_product_attention
+from dlrover_tpu.parallel.sharding import constrain
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GptConfig:
+    vocab_size: int = 50304      # nanoGPT's padded GPT-2 vocab
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    max_seq_len: int = 1024
+    dropout: float = 0.0         # kept for config parity; eval-mode 0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.dim % self.n_heads == 0
+        return self.dim // self.n_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return 4 * self.dim
+
+    @classmethod
+    def gpt2(cls, **kw) -> "GptConfig":
+        return cls(**kw)  # 124M
+
+    @classmethod
+    def gpt2_medium(cls, **kw) -> "GptConfig":
+        return cls(dim=1024, n_layers=24, n_heads=16, **kw)
+
+    @classmethod
+    def gpt2_large(cls, **kw) -> "GptConfig":
+        return cls(dim=1280, n_layers=36, n_heads=20, **kw)
+
+    @classmethod
+    def gpt2_xl(cls, **kw) -> "GptConfig":
+        """The 1.5B flash-checkpoint benchmark model
+        (docs/blogs/flash_checkpoint.md:362)."""
+        return cls(dim=1600, n_layers=48, n_heads=25, **kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "GptConfig":
+        defaults = dict(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4,
+            max_seq_len=128, remat=False,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def init_params(cfg: GptConfig, key: jax.Array) -> Params:
+    L, D, M = cfg.n_layers, cfg.dim, cfg.mlp_dim
+    pd = cfg.param_dtype
+    ks = jax.random.split(key, 8)
+
+    def dense(key, shape, fan_in, scale=1.0):
+        return (
+            jax.random.normal(key, shape, pd)
+            * scale / math.sqrt(fan_in)
+        )
+
+    # GPT-2 residual-projection init: extra 1/sqrt(2L)
+    res = 1.0 / math.sqrt(2 * L)
+    return {
+        "wte": jax.random.normal(ks[0], (cfg.vocab_size, D), pd) * 0.02,
+        "wpe": jax.random.normal(ks[1], (cfg.max_seq_len, D), pd) * 0.01,
+        "layers": {
+            "ln1_g": jnp.ones((L, D), pd),
+            "ln1_b": jnp.zeros((L, D), pd),
+            "wqkv": dense(ks[2], (L, D, 3 * D), D),
+            "wo": dense(ks[3], (L, D, D), D, scale=res),
+            "ln2_g": jnp.ones((L, D), pd),
+            "ln2_b": jnp.zeros((L, D), pd),
+            "w_up": dense(ks[4], (L, D, M), D),
+            "b_up": jnp.zeros((L, M), pd),
+            "w_down": dense(ks[5], (L, M, D), M, scale=res),
+            "b_down": jnp.zeros((L, D), pd),
+        },
+        "lnf_g": jnp.ones((D,), pd),
+        "lnf_b": jnp.zeros((D,), pd),
+        # LM head tied to wte (GPT-2 convention)
+    }
+
+
+def partition_rules(cfg: GptConfig):
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"wte$", P("tensor", None)),
+        (r"wpe$", P(None, None)),
+        (r"layers/wqkv$", P(None, None, "tensor")),
+        (r"layers/wo$", P(None, "tensor", None)),
+        (r"layers/w_up$", P(None, None, "tensor")),
+        (r"layers/b_up$", P(None, "tensor")),
+        (r"layers/w_down$", P(None, "tensor", None)),
+        (r"layers/(ln1|ln2)_", P(None, None)),
+        (r"layers/b_down$", P(None, None)),
+        (r"ln[f]_", P(None)),
+    ]
+
+
+def _layer_norm(x, g, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def _block(cfg: GptConfig, mesh, x, lp):
+    B, S, D = x.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+
+    h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
+    qkv = h @ lp["wqkv"].astype(cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd)
+    k = k.reshape(B, S, H, hd)
+    v = v.reshape(B, S, H, hd)
+    q = constrain(q, mesh, ("data", "fsdp"), "seq", "tensor", None)
+    attn = dot_product_attention(q, k, v, causal=True)
+    attn = attn.reshape(B, S, D)
+    x = x + attn @ lp["wo"].astype(cfg.dtype)
+
+    h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.norm_eps)
+    up = h @ lp["w_up"].astype(cfg.dtype) + lp["b_up"].astype(cfg.dtype)
+    up = jax.nn.gelu(up)
+    x = x + up @ lp["w_down"].astype(cfg.dtype) + lp["b_down"].astype(
+        cfg.dtype
+    )
+    return constrain(x, mesh, ("data", "fsdp"), "seq", None)
+
+
+def apply(
+    cfg: GptConfig,
+    params: Params,
+    tokens: jax.Array,
+    mesh=None,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] f32."""
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = (
+        params["wte"].astype(cfg.dtype)[tokens]
+        + params["wpe"].astype(cfg.dtype)[positions]
+    )
+    x = constrain(x, mesh, ("data", "fsdp"), "seq", None)
+
+    def body(carry, lp):
+        return _block(cfg, mesh, carry, lp), None
+
+    if cfg.remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"], cfg.norm_eps)
+    logits = (x @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
+    return constrain(logits, mesh, ("data", "fsdp"), "seq", "tensor")
+
+
+def loss_fn(
+    cfg: GptConfig,
+    params: Params,
+    batch: Dict[str, jax.Array],
+    mesh=None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross entropy; batch: tokens [B, S] (+loss_mask)."""
+    tokens = batch["tokens"]
+    logits = apply(cfg, params, tokens[:, :-1], mesh=mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, targets[..., None], axis=-1
+    ).squeeze(-1)
+    mask = batch.get(
+        "loss_mask", jnp.ones_like(targets, jnp.float32)
+    ).astype(jnp.float32)
+    w = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / w
+    return loss, {"loss": loss, "loss_weight": w}
+
+
+def num_params(cfg: GptConfig) -> int:
+    D, L, M = cfg.dim, cfg.n_layers, cfg.mlp_dim
+    per_layer = 2 * D + (D * 3 * D) + D * D + 2 * D + D * M + M + (
+        M * D
+    ) + D
+    return (
+        cfg.vocab_size * D
+        + cfg.max_seq_len * D
+        + L * per_layer
+        + 2 * D
+    )

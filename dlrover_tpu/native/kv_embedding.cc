@@ -20,9 +20,11 @@
 #include <chrono>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <random>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -48,6 +50,19 @@ struct Shard {
   mutable std::mutex mu;
 };
 
+// Disk-tier index entry: where a spilled row lives in the spill file
+// plus the stats needed for eviction/export without touching the disk.
+// Reference parity: tfplus hybrid_embedding TableManager/StorageTable
+// (table_manager.h:45, storage_table.h:199) — tiered DRAM/SSD rows with
+// promotion on access.
+struct DiskRow {
+  int64_t offset = 0;      // byte offset of the data payload
+  int32_t state_mult = 1;  // how many dim-sized segments are stored
+  uint32_t freq = 0;
+  double last_access = 0.0;
+  uint64_t version = 0;
+};
+
 class KvTable {
  public:
   KvTable(int64_t dim, int init_mode, uint64_t seed, float init_scale)
@@ -71,6 +86,7 @@ class KvTable {
   // Gather rows for keys; missing keys: insert (insert_missing=1) with
   // the configured initializer, or return zeros without inserting (=0)
   // — the GatherOrInsert / GatherOrZeros pair of the reference.
+  // Rows spilled to the disk tier are transparently promoted back.
   void lookup(const int64_t* keys, int64_t n, float* out,
               int insert_missing) {
     const double t = now_sec();
@@ -79,6 +95,9 @@ class KvTable {
       Shard& sh = shard(k);
       std::lock_guard<std::mutex> g(sh.mu);
       auto it = sh.map.find(k);
+      if (it == sh.map.end() && promote_from_disk(k, sh)) {
+        it = sh.map.find(k);
+      }
       if (it == sh.map.end()) {
         if (!insert_missing) {
           std::memset(out + i * dim_, 0, sizeof(float) * dim_);
@@ -197,6 +216,24 @@ class KvTable {
         }
       }
     }
+    {
+      // disk-tier rows age out by the same criteria
+      std::lock_guard<std::mutex> g(disk_mu_);
+      for (auto it = disk_index_.begin();
+           it != disk_index_.end();) {
+        const DiskRow& r = it->second;
+        const bool idle =
+            max_idle_sec > 0 && (t - r.last_access) > max_idle_sec;
+        const bool cold = min_freq > 0 && r.freq < min_freq;
+        if (idle || cold) {
+          dead_bytes_ += sizeof(float) * r.state_mult * dim_;
+          it = disk_index_.erase(it);
+          ++removed;
+        } else {
+          ++it;
+        }
+      }
+    }
     return removed;
   }
 
@@ -207,6 +244,12 @@ class KvTable {
     for (const auto& sh : shards_) {
       std::lock_guard<std::mutex> g(sh.mu);
       for (const auto& kv : sh.map)
+        if (kv.second.version > since_version) ++n;
+    }
+    {
+      // spilled rows are still part of the table's state
+      std::lock_guard<std::mutex> g(disk_mu_);
+      for (const auto& kv : disk_index_)
         if (kv.second.version > since_version) ++n;
     }
     return n;
@@ -223,6 +266,22 @@ class KvTable {
         keys_out[n] = kv.first;
         std::memcpy(vals_out + n * dim_, kv.second.data.data(),
                     sizeof(float) * dim_);
+        ++n;
+      }
+    }
+    {
+      std::lock_guard<std::mutex> g(disk_mu_);
+      for (const auto& kv : disk_index_) {
+        if (!spill_file_) break;
+        if (kv.second.version <= since_version) continue;
+        if (n >= max_n) return n;
+        std::fseek(spill_file_, kv.second.offset, SEEK_SET);
+        if (std::fread(vals_out + n * dim_, sizeof(float), dim_,
+                       spill_file_) !=
+            static_cast<size_t>(dim_)) {
+          continue;
+        }
+        keys_out[n] = kv.first;
         ++n;
       }
     }
@@ -287,6 +346,31 @@ class KvTable {
         ++n;
       }
     }
+    {
+      std::lock_guard<std::mutex> g(disk_mu_);
+      std::vector<float> buf;
+      for (const auto& kv : disk_index_) {
+        if (!spill_file_) break;
+        if (kv.second.version <= since_version) continue;
+        if (n >= max_n) return n;
+        const size_t have = std::min(
+            static_cast<size_t>(kv.second.state_mult) * dim_,
+            static_cast<size_t>(w));
+        buf.resize(have);
+        std::fseek(spill_file_, kv.second.offset, SEEK_SET);
+        if (std::fread(buf.data(), sizeof(float), have,
+                       spill_file_) != have) {
+          continue;
+        }
+        float* dst = state_out + n * w;
+        std::memcpy(dst, buf.data(), sizeof(float) * have);
+        if (have < static_cast<size_t>(w))
+          std::memset(dst + have, 0, sizeof(float) * (w - have));
+        keys_out[n] = kv.first;
+        freq_out[n] = kv.second.freq;
+        ++n;
+      }
+    }
     return n;
   }
 
@@ -308,7 +392,158 @@ class KvTable {
 
   uint64_t version() const { return version_.load(); }
 
+  // ---- hybrid DRAM/disk tier -------------------------------------------
+
+  bool set_spill_path(const char* path) {
+    std::lock_guard<std::mutex> g(disk_mu_);
+    if (spill_file_) {
+      std::fclose(spill_file_);
+      spill_file_ = nullptr;
+    }
+    spill_path_ = path ? path : "";
+    disk_index_.clear();  // entries point into the old file either way
+    file_bytes_ = 0;
+    dead_bytes_ = 0;
+    if (spill_path_.empty()) return true;
+    spill_file_ = std::fopen(spill_path_.c_str(), "w+b");
+    return spill_file_ != nullptr;
+  }
+
+  // Move cold rows (freq < min_freq OR idle > max_idle_sec) to disk.
+  // Returns rows spilled; no-op without a spill path.
+  int64_t spill(uint32_t min_freq, double max_idle_sec) {
+    const double t = now_sec();
+    int64_t moved = 0;
+    for (auto& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
+      for (auto it = sh.map.begin(); it != sh.map.end();) {
+        const Slot& s = it->second;
+        const bool idle =
+            max_idle_sec > 0 && (t - s.last_access) > max_idle_sec;
+        const bool cold = min_freq > 0 && s.freq < min_freq;
+        if (!(idle || cold)) {
+          ++it;
+          continue;
+        }
+        {
+          std::lock_guard<std::mutex> dg(disk_mu_);
+          if (!spill_file_) return moved;
+          std::fseek(spill_file_, 0, SEEK_END);
+          DiskRow row;
+          row.offset = std::ftell(spill_file_);
+          row.state_mult =
+              static_cast<int32_t>(s.data.size() / dim_);
+          if (row.state_mult < 1) row.state_mult = 1;
+          row.freq = s.freq;
+          row.last_access = s.last_access;
+          row.version = s.version;
+          const size_t nfloats =
+              static_cast<size_t>(row.state_mult) * dim_;
+          if (std::fwrite(s.data.data(), sizeof(float), nfloats,
+                          spill_file_) != nfloats) {
+            return moved;  // disk full: keep the row in DRAM
+          }
+          auto old = disk_index_.find(it->first);
+          if (old != disk_index_.end()) {
+            dead_bytes_ += sizeof(float) * old->second.state_mult *
+                           dim_;
+          }
+          disk_index_[it->first] = row;
+          file_bytes_ += sizeof(float) * nfloats;
+        }
+        it = sh.map.erase(it);
+        ++moved;
+      }
+    }
+    return moved;
+  }
+
+  int64_t disk_size() const {
+    std::lock_guard<std::mutex> g(disk_mu_);
+    return static_cast<int64_t>(disk_index_.size());
+  }
+
+  // Rewrite the spill file keeping only live rows (call when
+  // promotions have made much of it dead). Returns live rows.
+  int64_t compact() {
+    std::lock_guard<std::mutex> g(disk_mu_);
+    if (!spill_file_ || spill_path_.empty()) return 0;
+    const std::string tmp = spill_path_ + ".compact";
+    FILE* nf = std::fopen(tmp.c_str(), "w+b");
+    if (!nf) return -1;
+    // stage all mutations; the live index/file change only after the
+    // rename succeeds, so any failure leaves the old tier intact
+    std::vector<float> buf;
+    std::unordered_map<int64_t, int64_t> new_offsets;
+    std::vector<int64_t> unreadable;
+    for (const auto& kv : disk_index_) {
+      const DiskRow& row = kv.second;
+      const size_t nfloats =
+          static_cast<size_t>(row.state_mult) * dim_;
+      buf.resize(nfloats);
+      std::fseek(spill_file_, row.offset, SEEK_SET);
+      if (std::fread(buf.data(), sizeof(float), nfloats,
+                     spill_file_) != nfloats) {
+        // unreadable in the old file: unrecoverable — drop on commit
+        unreadable.push_back(kv.first);
+        continue;
+      }
+      std::fseek(nf, 0, SEEK_END);
+      const int64_t off = std::ftell(nf);
+      if (std::fwrite(buf.data(), sizeof(float), nfloats, nf) !=
+          nfloats) {
+        std::fclose(nf);  // disk full mid-compact: abort
+        std::remove(tmp.c_str());
+        return -1;
+      }
+      new_offsets[kv.first] = off;
+    }
+    if (std::fflush(nf) != 0 ||
+        std::rename(tmp.c_str(), spill_path_.c_str()) != 0) {
+      std::fclose(nf);
+      std::remove(tmp.c_str());
+      return -1;
+    }
+    std::fclose(spill_file_);
+    spill_file_ = nf;
+    for (int64_t key : unreadable) disk_index_.erase(key);
+    for (const auto& kv : new_offsets)
+      disk_index_[kv.first].offset = kv.second;
+    dead_bytes_ = 0;
+    file_bytes_ = 0;
+    for (const auto& kv : disk_index_) {
+      file_bytes_ +=
+          sizeof(float) * kv.second.state_mult * dim_;
+    }
+    return static_cast<int64_t>(disk_index_.size());
+  }
+
  private:
+  // caller holds the shard lock for `key`; takes the disk lock inside
+  // (lock order everywhere: shard → disk)
+  bool promote_from_disk(int64_t key, Shard& sh) {
+    std::lock_guard<std::mutex> g(disk_mu_);
+    if (!spill_file_) return false;
+    auto it = disk_index_.find(key);
+    if (it == disk_index_.end()) return false;
+    const DiskRow& row = it->second;
+    const size_t nfloats =
+        static_cast<size_t>(row.state_mult) * dim_;
+    Slot slot;
+    slot.data.resize(nfloats);
+    std::fseek(spill_file_, row.offset, SEEK_SET);
+    if (std::fread(slot.data.data(), sizeof(float), nfloats,
+                   spill_file_) != nfloats) {
+      return false;
+    }
+    slot.freq = row.freq;
+    slot.last_access = row.last_access;
+    slot.version = row.version;
+    sh.map.emplace(key, std::move(slot));
+    dead_bytes_ += sizeof(float) * nfloats;
+    disk_index_.erase(it);
+    return true;
+  }
   Shard& shard(int64_t key) {
     // splitmix64 scramble → shard index
     uint64_t x = static_cast<uint64_t>(key) + 0x9e3779b97f4a7c15ULL;
@@ -332,12 +567,16 @@ class KvTable {
   }
 
   // find-or-create + run f(slot), all under the shard lock so a
-  // concurrent evict() cannot invalidate the slot mid-update
+  // concurrent evict() cannot invalidate the slot mid-update; checks
+  // the disk tier before re-initializing
   template <typename F>
   void with_slot(int64_t key, int state_mult, F&& f) {
     Shard& sh = shard(key);
     std::lock_guard<std::mutex> g(sh.mu);
     auto it = sh.map.find(key);
+    if (it == sh.map.end() && promote_from_disk(key, sh)) {
+      it = sh.map.find(key);
+    }
     if (it == sh.map.end()) {
       it = sh.map.emplace(key, Slot{}).first;
       init_value(key, it->second);
@@ -353,6 +592,20 @@ class KvTable {
   const uint64_t seed_;
   std::atomic<uint64_t> version_;
   Shard shards_[kNumShards];
+
+  // disk tier (guarded by disk_mu_)
+  mutable std::mutex disk_mu_;
+  std::string spill_path_;
+  FILE* spill_file_ = nullptr;
+  std::unordered_map<int64_t, DiskRow> disk_index_;
+  int64_t file_bytes_ = 0;
+  int64_t dead_bytes_ = 0;
+
+ public:
+  ~KvTable() {
+    std::lock_guard<std::mutex> g(disk_mu_);
+    if (spill_file_) std::fclose(spill_file_);
+  }
 };
 
 }  // namespace
@@ -437,6 +690,22 @@ void kv_import_full(void* t, const int64_t* keys, const float* state,
                     const uint32_t* freq, int64_t n, int state_mult) {
   static_cast<KvTable*>(t)->import_full(keys, state, freq, n,
                                         state_mult);
+}
+
+int kv_set_spill_path(void* t, const char* path) {
+  return static_cast<KvTable*>(t)->set_spill_path(path) ? 1 : 0;
+}
+
+int64_t kv_spill(void* t, uint32_t min_freq, double max_idle_sec) {
+  return static_cast<KvTable*>(t)->spill(min_freq, max_idle_sec);
+}
+
+int64_t kv_disk_size(void* t) {
+  return static_cast<KvTable*>(t)->disk_size();
+}
+
+int64_t kv_compact(void* t) {
+  return static_cast<KvTable*>(t)->compact();
 }
 
 }  // extern "C"

@@ -38,6 +38,11 @@ class OperatorController:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._seen_jobs: set = set()
+        # jobs must be missing this many consecutive polls before we
+        # garbage-collect their master pod — one flaky/empty list
+        # response must not mass-delete masters
+        self.miss_threshold = 2
+        self._miss_counts: dict = {}
 
     def reconcile_once(self):
         """One pass over every ElasticJob and pending ScalePlan."""
@@ -52,9 +57,16 @@ class OperatorController:
             current = {
                 cr.get("metadata", {}).get("name") for cr in job_crs
             }
+            for name in current:
+                self._miss_counts.pop(name, None)
             for gone in self._seen_jobs - current:
-                self.jobs.cleanup(gone)
-            self._seen_jobs = current
+                n = self._miss_counts.get(gone, 0) + 1
+                self._miss_counts[gone] = n
+                if n >= self.miss_threshold:
+                    self.jobs.cleanup(gone)
+                    self._miss_counts.pop(gone, None)
+            # keep still-missing jobs in the watch set until confirmed
+            self._seen_jobs = current | set(self._miss_counts)
         for cr in job_crs or []:
             try:
                 self.jobs.reconcile(cr)

@@ -304,7 +304,9 @@ class CoworkerDataServicer(MasterServicerBase):
         req = env.payload
         if isinstance(req, PushBatch):
             try:
-                self._q.put(req.data, timeout=30)
+                # never block a gRPC handler thread on a full queue —
+                # producers back off and retry on the rejection
+                self._q.put_nowait(req.data)
             except _queue.Full:
                 return ReplyEnvelope(
                     success=False, reason="queue full"
@@ -354,12 +356,21 @@ class CoworkerProducer:
     def __init__(self, addr: str):
         self._stub = MasterStub(addr)
 
-    def push(self, batch: Dict[str, np.ndarray]):
-        resp = self._stub.report(
-            PushBatch(data=pickle.dumps(batch, protocol=4))
-        )
-        if not resp.success:
-            raise RuntimeError(f"push rejected: {resp.reason}")
+    def push(
+        self,
+        batch: Dict[str, np.ndarray],
+        retries: int = 40,
+        backoff: float = 0.25,
+    ):
+        data = pickle.dumps(batch, protocol=4)
+        for _ in range(retries):
+            resp = self._stub.report(PushBatch(data=data))
+            if resp.success:
+                return
+            if resp.reason != "queue full":
+                raise RuntimeError(f"push rejected: {resp.reason}")
+            time.sleep(backoff)  # consumer is behind: back off
+        raise RuntimeError("push rejected: queue full (gave up)")
 
     def end(self):
         self._stub.report(EndOfData())

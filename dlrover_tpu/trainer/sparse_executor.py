@@ -1,0 +1,138 @@
+"""Sparse (PS-style) training executor with cluster-version failover.
+
+Reference parity: the TF PS stack — `EstimatorExecutor`
+(dlrover/trainer/tensorflow/executor/estimator_executor.py:52) builds a
+session from master-supplied TF_CONFIG and runs train_and_evaluate;
+`TensorflowFailover` (failover/tensorflow_failover.py:33) watches the
+cluster version and rebuilds the session from checkpoint when the PS
+membership changes; session hooks report data shards and global step.
+
+TPU re-design: the "PS" role is the host-side KvEmbedding shard set
+(dense state is SPMD on the mesh and needs no PS). The executor runs a
+user train_step over batches, reports the global step and shard
+completion to the master, and polls the elastic-PS cluster version —
+when embedding-shard membership changes it checkpoints the sparse
+tables, fires rebuild callbacks (re-resolve shard map), restores, and
+continues; the dense SPMD program is untouched."""
+
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class SparseTrainingExecutor:
+    def __init__(
+        self,
+        train_step: Callable[[Any], Dict],
+        embedding_layers: Optional[Dict[str, Any]] = None,
+        master_client=None,
+        ckpt_dir: Optional[str] = None,
+        version_poll_steps: int = 20,
+        report_steps: int = 10,
+    ):
+        """train_step(batch) -> metrics dict. embedding_layers:
+        {name: KvEmbeddingLayer-like} (state_dict/load_state_dict)."""
+        self.train_step = train_step
+        self.embedding_layers = embedding_layers or {}
+        self.mc = master_client
+        self.ckpt_dir = ckpt_dir
+        self.version_poll_steps = version_poll_steps
+        self.report_steps = report_steps
+        self.global_step = 0
+        self.rebuild_count = 0
+        self._local_version = 0
+        self._rebuild_callbacks: List[Callable[[int], None]] = []
+
+    def on_rebuild(self, fn: Callable[[int], None]):
+        """Register a callback(new_version) fired after failover —
+        re-resolve embedding shard maps, reset readers, etc."""
+        self._rebuild_callbacks.append(fn)
+
+    # ---- failover --------------------------------------------------------
+
+    def _cluster_version(self) -> int:
+        if self.mc is None:
+            return self._local_version
+        try:
+            return self.mc.get_cluster_version("global")
+        except Exception:  # master briefly unreachable: keep training
+            return self._local_version
+
+    def _checkpoint_sparse(self):
+        if not self.ckpt_dir:
+            return
+        import pickle
+
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        for name, layer in self.embedding_layers.items():
+            path = os.path.join(self.ckpt_dir, f"sparse_{name}.pkl")
+            with open(path + ".tmp", "wb") as f:
+                pickle.dump(layer.state_dict(), f, protocol=4)
+            os.replace(path + ".tmp", path)
+
+    def _restore_sparse(self):
+        if not self.ckpt_dir:
+            return
+        import pickle
+
+        for name, layer in self.embedding_layers.items():
+            path = os.path.join(self.ckpt_dir, f"sparse_{name}.pkl")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    layer.load_state_dict(pickle.load(f))
+
+    def failover(self, new_version: int):
+        """The session-rebuild equivalent: persist sparse state, let
+        callbacks re-resolve the new shard layout, restore, and ack the
+        version to the master."""
+        logger.info(
+            "sparse failover: cluster version %d -> %d",
+            self._local_version,
+            new_version,
+        )
+        self._checkpoint_sparse()
+        for cb in self._rebuild_callbacks:
+            cb(new_version)
+        self._restore_sparse()
+        self._local_version = new_version
+        self.rebuild_count += 1
+        if self.mc is not None:
+            try:
+                self.mc.update_cluster_version(new_version, "local")
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ---- loop ------------------------------------------------------------
+
+    def train(
+        self,
+        batches: Iterable,
+        max_steps: int = 0,
+    ) -> Dict[str, float]:
+        """Run until the iterable ends (or max_steps). Returns the last
+        metrics."""
+        metrics: Dict[str, float] = {}
+        self._local_version = self._cluster_version()
+        for batch in batches:
+            if (
+                self.global_step % self.version_poll_steps == 0
+                and self.global_step > 0
+            ):
+                v = self._cluster_version()
+                if v != self._local_version:
+                    self.failover(v)
+            metrics = dict(self.train_step(batch) or {})
+            self.global_step += 1
+            if (
+                self.mc is not None
+                and self.global_step % self.report_steps == 0
+            ):
+                try:
+                    self.mc.report_global_step(self.global_step)
+                except Exception:  # noqa: BLE001
+                    pass
+            if 0 < max_steps <= self.global_step:
+                break
+        return metrics

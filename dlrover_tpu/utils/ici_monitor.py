@@ -1,0 +1,107 @@
+"""Interconnect (ICI/DCN) health monitor.
+
+Reference parity: atorch/atorch/utils/ib_monitor.py — a background
+watcher of the InfiniBand fabric counters. TPU hosts expose no IB
+counters; the observable is *achieved collective bandwidth*, so the
+monitor times a small psum/all_gather per mesh axis (the same micro-
+bench family as the pre-flight node check, node_check/utils.py
+bm_allgather) and tracks a rolling baseline — a link degradation shows
+up as a bandwidth drop on the axis that rides it."""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+@dataclass
+class LinkStats:
+    axis: str
+    gbps: float
+    elapsed_s: float
+    ts: float = field(default_factory=time.time)
+
+
+def _bench_axis(mesh, axis: str, mbytes: float = 4.0) -> LinkStats:
+    """Time an all_gather of `mbytes` per device over one axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+    if n <= 1:
+        return LinkStats(axis=axis, gbps=float("inf"), elapsed_s=0.0)
+    rows = max(int(mbytes * 1e6 / 4 / 1024), 1) * n
+    x = jnp.ones((rows, 1024), jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
+
+    @jax.jit
+    def gather(x):
+        # all_gather via resharding to replicated: XLA emits the
+        # collective for the axis the input was sharded on
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, None))
+        )
+
+    gather(x).block_until_ready()  # compile + warm
+    t0 = time.monotonic()
+    gather(x).block_until_ready()
+    dt = max(time.monotonic() - t0, 1e-9)
+    moved = x.nbytes * (n - 1) / n  # ring all-gather wire bytes/device
+    return LinkStats(axis=axis, gbps=moved / dt / 1e9, elapsed_s=dt)
+
+
+class IciMonitor:
+    """Rolling per-axis bandwidth tracker with degradation detection."""
+
+    def __init__(
+        self,
+        mesh,
+        window: int = 10,
+        degrade_ratio: float = 0.5,
+        mbytes: float = 4.0,
+    ):
+        self.mesh = mesh
+        self.window = window
+        self.degrade_ratio = degrade_ratio
+        self.mbytes = mbytes
+        self._history: Dict[str, List[float]] = {}
+
+    def probe(self) -> Dict[str, LinkStats]:
+        out = {}
+        for axis in self.mesh.axis_names:
+            if self.mesh.shape[axis] <= 1:
+                continue
+            stats = _bench_axis(self.mesh, axis, self.mbytes)
+            hist = self._history.setdefault(axis, [])
+            hist.append(stats.gbps)
+            del hist[: -self.window]
+            out[axis] = stats
+        return out
+
+    def baseline(self, axis: str) -> Optional[float]:
+        hist = self._history.get(axis)
+        if not hist:
+            return None
+        return float(np.median(hist))
+
+    def degraded_axes(self) -> List[str]:
+        """Axes whose latest probe fell below degrade_ratio x the
+        rolling median — report these to the master's diagnosis chain."""
+        bad = []
+        for axis, hist in self._history.items():
+            if len(hist) < 3:
+                continue
+            base = float(np.median(hist[:-1]))
+            if base > 0 and hist[-1] < base * self.degrade_ratio:
+                bad.append(axis)
+                logger.warning(
+                    "ICI axis %s degraded: %.2f GB/s vs median %.2f",
+                    axis,
+                    hist[-1],
+                    base,
+                )
+        return bad

@@ -1,0 +1,91 @@
+"""Chrome-trace parsing: per-op time summary from a profiler dump.
+
+Reference parity: atorch/atorch/utils/parse_trace_json.py — digest a
+torch-profiler chrome trace into per-op totals to spot the hot ops. The
+JAX profiler (utils/prof.py device_trace) emits the same chrome trace
+format (trace.json.gz under the log dir's plugins/profile tree)."""
+
+import gzip
+import json
+import os
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+
+def load_trace(path: str) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def find_trace_file(log_dir: str) -> Optional[str]:
+    """Locate the newest trace.json(.gz) under a profiler log dir."""
+    newest: Tuple[float, Optional[str]] = (-1.0, None)
+    for root, _dirs, files in os.walk(log_dir):
+        for fn in files:
+            if fn.endswith(("trace.json", "trace.json.gz")):
+                p = os.path.join(root, fn)
+                m = os.path.getmtime(p)
+                if m > newest[0]:
+                    newest = (m, p)
+    return newest[1]
+
+
+def op_summary(
+    trace: dict, top: int = 20
+) -> List[Dict[str, float]]:
+    """Aggregate complete events ('ph' == 'X') by name → total/self
+    duration, count; sorted by total time."""
+    totals: Dict[str, float] = defaultdict(float)
+    counts: Dict[str, int] = defaultdict(int)
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        dur = float(ev.get("dur", 0.0))  # microseconds
+        totals[name] += dur
+        counts[name] += 1
+    out = [
+        {
+            "name": name,
+            "total_us": t,
+            "count": counts[name],
+            "avg_us": t / max(counts[name], 1),
+        }
+        for name, t in totals.items()
+    ]
+    out.sort(key=lambda r: -r["total_us"])
+    return out[:top]
+
+
+def step_gaps(
+    trace: dict, step_marker: str = "train_step"
+) -> List[float]:
+    """Idle gaps (us) between consecutive occurrences of a step marker
+    event — the input-pipeline-stall signal."""
+    spans = sorted(
+        (float(ev["ts"]), float(ev["ts"]) + float(ev.get("dur", 0)))
+        for ev in trace.get("traceEvents", [])
+        if ev.get("ph") == "X" and step_marker in ev.get("name", "")
+    )
+    return [
+        max(0.0, b_start - a_end)
+        for (_, a_end), (b_start, _) in zip(spans, spans[1:])
+    ]
+
+
+def summarize(log_dir_or_file: str, top: int = 20) -> Dict:
+    path = (
+        log_dir_or_file
+        if os.path.isfile(log_dir_or_file)
+        else find_trace_file(log_dir_or_file)
+    )
+    if path is None:
+        return {"error": f"no trace under {log_dir_or_file}"}
+    trace = load_trace(path)
+    ops = op_summary(trace, top)
+    return {
+        "file": path,
+        "ops": ops,
+        "total_us": sum(o["total_us"] for o in ops),
+    }

@@ -1,0 +1,153 @@
+"""Hybrid DRAM/disk embedding tier + multi-hash compression.
+
+Mirrors tfplus hybrid_embedding expectations: cold rows demote to disk,
+promote transparently on access with intact values/moments, exports
+cover both tiers, and compaction reclaims dead records."""
+
+import os
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.embedding.kv_store import KvEmbeddingTable
+from dlrover_tpu.embedding.layer import MultiHashEmbeddingLayer
+
+DIM = 8
+
+
+@pytest.fixture()
+def table(tmp_path):
+    t = KvEmbeddingTable(DIM, initializer="normal", seed=7)
+    assert t.set_spill_path(str(tmp_path / "spill.bin"))
+    return t
+
+
+class TestSpillPromote:
+    def test_spill_moves_cold_rows(self, table):
+        hot = np.arange(0, 10, dtype=np.int64)
+        cold = np.arange(100, 110, dtype=np.int64)
+        for _ in range(5):
+            table.lookup(hot)       # freq 5
+        table.lookup(cold)          # freq 1
+        moved = table.spill(min_freq=3)
+        assert moved == 10
+        assert table.disk_size() == 10
+        assert len(table) == 10     # only hot rows in DRAM
+
+    def test_promotion_preserves_values(self, table):
+        keys = np.array([42, 43], dtype=np.int64)
+        before = table.lookup(keys).copy()
+        assert table.spill(min_freq=100) == 2  # everything is cold
+        assert len(table) == 0
+        after = table.lookup(keys)  # transparent promotion
+        np.testing.assert_array_equal(before, after)
+        assert table.disk_size() == 0  # promoted rows left the tier
+
+    def test_optimizer_state_survives_roundtrip(self, table):
+        keys = np.array([7], dtype=np.int64)
+        table.lookup(keys)
+        g = np.ones((1, DIM), np.float32)
+        table.apply_adam(keys, g, lr=0.1, step=1)
+        assert table.state_mult == 3  # value + m + v
+        k1, s1, f1, _ = table.export_full()
+        table.spill(min_freq=100)
+        # update after promotion continues the adam trajectory
+        table.apply_adam(keys, g, lr=0.1, step=2)
+        k2, s2, f2, _ = table.export_full()
+        assert not np.allclose(
+            s1[:, DIM : 2 * DIM], s2[:, DIM : 2 * DIM]
+        )  # moments advanced, not reset
+        assert np.abs(s2[:, DIM : 2 * DIM]).max() > 0
+
+    def test_exports_cover_disk_tier(self, table):
+        keys = np.arange(20, dtype=np.int64)
+        vals = table.lookup(keys).copy()
+        table.spill(min_freq=100)  # all to disk
+        ek, ev = table.export()
+        assert set(ek.tolist()) == set(keys.tolist())
+        order = np.argsort(ek)
+        np.testing.assert_allclose(ev[order], vals, rtol=1e-6)
+
+    def test_evict_reaches_disk_rows(self, table):
+        table.lookup(np.arange(5, dtype=np.int64))
+        table.spill(min_freq=100)
+        assert table.disk_size() == 5
+        removed = table.evict(min_freq=100)
+        assert removed == 5
+        assert table.disk_size() == 0
+
+    def test_compact_keeps_live_rows(self, table, tmp_path):
+        keys = np.arange(50, dtype=np.int64)
+        vals = table.lookup(keys).copy()
+        table.spill(min_freq=100)
+        # promote half (making half the file dead)
+        table.lookup(keys[:25])
+        assert table.disk_size() == 25
+        live = table.compact()
+        assert live == 25
+        # promoted + compact-surviving rows all read back correctly
+        after = table.lookup(keys)
+        np.testing.assert_allclose(after, vals, rtol=1e-6)
+
+
+class TestMultiHash:
+    def test_compression_and_determinism(self):
+        layer = MultiHashEmbeddingLayer(
+            DIM, buckets=16, optimizer="sgd", lr=0.1, seed=3
+        )
+        import jax.numpy as jnp
+
+        ids = jnp.array([5, 21, 300], dtype=jnp.int32)
+        e1 = np.asarray(layer(ids))
+        e2 = np.asarray(layer(ids))
+        np.testing.assert_array_equal(e1, e2)
+        # 300 = 18*16 + 12 vs 5 = 0*16+5: distinct vectors
+        assert not np.allclose(e1[0], e1[2])
+        # physical rows ≤ 2 * distinct sub-keys, not one per id
+        assert len(layer.q.table) + len(layer.r.table) <= 6
+
+    def test_training_moves_lookup(self):
+        import jax.numpy as jnp
+
+        layer = MultiHashEmbeddingLayer(
+            DIM, buckets=8, optimizer="sgd", lr=0.5, seed=0
+        )
+        ids = jnp.array([3, 70], dtype=jnp.int32)
+        before = np.asarray(layer(ids)).copy()
+        layer.apply_grads(
+            np.asarray(ids), np.ones((2, DIM), np.float32)
+        )
+        after = np.asarray(layer(ids))
+        assert not np.allclose(before, after)
+
+    def test_mul_combine_chain_rule(self):
+        import jax.numpy as jnp
+
+        layer = MultiHashEmbeddingLayer(
+            DIM, buckets=8, combine="mul", optimizer="sgd",
+            lr=0.1, seed=1,
+        )
+        ids = jnp.array([9], dtype=jnp.int32)
+        before = np.asarray(layer(ids)).copy()
+        layer.apply_grads(
+            np.asarray(ids), np.ones((1, DIM), np.float32)
+        )
+        after = np.asarray(layer(ids))
+        assert not np.allclose(before, after)
+
+    def test_state_roundtrip(self):
+        import jax.numpy as jnp
+
+        layer = MultiHashEmbeddingLayer(
+            DIM, buckets=8, optimizer="sgd", lr=0.1, seed=5
+        )
+        ids = jnp.array([1, 2, 3], dtype=jnp.int32)
+        ref = np.asarray(layer(ids)).copy()
+        state = layer.state_dict()
+        layer2 = MultiHashEmbeddingLayer(
+            DIM, buckets=8, optimizer="sgd", lr=0.1, seed=99
+        )
+        layer2.load_state_dict(state)
+        np.testing.assert_allclose(
+            np.asarray(layer2(ids)), ref, rtol=1e-6
+        )

@@ -211,5 +211,9 @@ class TestJobCleanup:
         k8s.delete_custom(
             ELASTIC_GROUP, ELASTIC_VERSION, ELASTICJOB_PLURAL, "gone"
         )
+        # one missing poll is NOT enough (a flaky list response must
+        # not delete masters); the threshold-th consecutive miss is
+        ctl.reconcile_once()
+        assert master_pod_name("gone") in k8s.pods
         ctl.reconcile_once()
         assert master_pod_name("gone") not in k8s.pods
