@@ -68,14 +68,20 @@ def main():
     )
     batch = acc.shard_batch({"tokens": tokens})
 
+    def _sync(metrics):
+        # fetch a real scalar: forces completion of the whole dependent
+        # step chain even on backends whose block_until_ready returns
+        # early for remote/async buffers (the axon tunnel does)
+        return float(jax.device_get(metrics["loss"]))
+
     for _ in range(warmup):
         state, metrics = acc.train_step(state, batch)
-    jax.block_until_ready(state)
+    _sync(metrics)
 
     t0 = time.monotonic()
     for _ in range(iters):
         state, metrics = acc.train_step(state, batch)
-    jax.block_until_ready(state)
+    final_loss = _sync(metrics)
     elapsed = time.monotonic() - t0
 
     tokens_per_step = batch_size * seq_len
@@ -87,6 +93,7 @@ def main():
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = PEAK_TFLOPS.get(gen, PEAK_TFLOPS["v5e"])
     mfu = achieved_tflops / peak if on_tpu else 0.0
+    suspect = on_tpu and mfu > 1.0  # >100% of peak = broken timing
 
     print(
         json.dumps(
@@ -103,7 +110,8 @@ def main():
                     "backend": jax.default_backend(),
                     "n_devices": n_dev,
                     "step_ms": round(elapsed / iters * 1e3, 1),
-                    "loss": float(metrics["loss"]),
+                    "loss": final_loss,
+                    "suspect_timing": suspect,
                 },
             }
         )

@@ -42,6 +42,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16          # compute dtype
     param_dtype: Any = jnp.float32     # storage dtype
     remat: bool = True                 # checkpoint each layer in scan
+    # remat.resolve_policy name: "full" recomputes everything (min HBM);
+    # "dots_no_batch" saves matmul outputs (≈no recompute, more HBM)
+    remat_policy: str = "full"
     attn_impl: str = "auto"            # auto | flash | reference
     seq_parallel: str = "none"         # none | ring | ulysses
     tie_embeddings: bool = False
@@ -330,8 +333,10 @@ def apply(
             return y, aux
 
         if cfg.remat:
+            from dlrover_tpu.parallel.remat import resolve_policy
+
             body = jax.checkpoint(
-                body, policy=jax.checkpoint_policies.nothing_saveable
+                body, policy=resolve_policy(cfg.remat_policy)
             )
         x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
 

@@ -112,7 +112,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows
         o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
         lse = m_scr[:, :1] + jnp.log(l)
-        lse_ref[0, 0] = lse[:, 0]
+        # lse rides an 8-lane padded layout: Mosaic requires the last
+        # two block dims to tile (8, 128) or match the array dims, so a
+        # bare [block_q] vector output cannot lower on real TPU
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref[0, 0].shape)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
@@ -139,12 +142,12 @@ def _fwd(q, k, v, causal, scale, block_q, block_k):
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, h, qi, ki: (b, h, qi)),
+            pl.BlockSpec((1, 1, block_q, 8),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s_q, 8), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -188,8 +191,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0].reshape(block_q, 1)
-        delta = delta_ref[0, 0].reshape(block_q, 1)
+        lse = lse_ref[0, 0][:, :1]      # [bq, 1] from the 8-lane pad
+        delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -240,8 +243,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0].reshape(1, block_q)
-        delta = delta_ref[0, 0].reshape(1, block_q)
+        # transposed padded layout [8, bq]: row 0 is the real data
+        lse = lse_ref[0, 0][:1, :]      # [1, bq]
+        delta = delta_ref[0, 0][:1, :]
         # transposed score block: [bk, bq]
         s_t = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())),
@@ -284,6 +288,11 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k):
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # [B, H, S]
+    # mirror lse's 8-lane padded layout (see _fwd) + a transposed view
+    # for the dkv kernel, whose rows are key blocks
+    delta_p = jnp.broadcast_to(delta[..., None], (b, h, s_q, 8))
+    lse_t = jnp.swapaxes(lse, 2, 3)      # [B, H, 8, S]
+    delta_t = jnp.swapaxes(delta_p, 2, 3)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal,
@@ -301,10 +310,10 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k):
                          lambda b, h, qi, ki: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, h, qi, ki: (b, h, qi)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, h, qi, ki: (b, h, qi)),
+            pl.BlockSpec((1, 1, block_q, 8),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 8),
+                         lambda b, h, qi, ki: (b, h, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -316,7 +325,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k):
             ),
         ),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta_p)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal,
@@ -334,10 +343,10 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k):
                          lambda b, h, ki, qi: (b, h, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, h, ki, qi: (b, h, qi)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b, h, ki, qi: (b, h, qi)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b, h, ki, qi: (b, h, 0, qi)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b, h, ki, qi: (b, h, 0, qi)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d),
@@ -359,7 +368,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k):
             ),
         ),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_t, delta_t)
     return dq, dk, dv
 
 
