@@ -22,3 +22,18 @@ Layer map (mirrors reference SURVEY.md §1, re-architected for TPU):
 """
 
 __version__ = "0.1.0"
+
+
+def init(*args, **kwargs):
+    """Join the multi-host world the agent rendezvoused for this
+    process (worker-side bootstrap; see dlrover_tpu.runtime.init)."""
+    from dlrover_tpu import runtime
+
+    return runtime.init(*args, **kwargs)
+
+
+def shutdown():
+    """Tear down the distributed runtime (dlrover_tpu.runtime.shutdown)."""
+    from dlrover_tpu import runtime
+
+    return runtime.shutdown()

@@ -177,6 +177,14 @@ class MasterClient:
         resp = self.get(msg.NumNodesWaiting(rdzv_name=rdzv_name))
         return resp.waiting_num if resp else 0
 
+    def rdzv_state(
+        self, rdzv_name: str = "training"
+    ) -> msg.RendezvousStateResponse:
+        """Read-only rendezvous snapshot (round/world_size/waiting) —
+        the staleness signal workers and agents poll."""
+        resp = self.get(msg.RendezvousStateQuery(rdzv_name=rdzv_name))
+        return resp if resp else msg.RendezvousStateResponse()
+
     def report_network_check(self, normal: bool, elapsed: float):
         return self.report(
             msg.NetworkCheckResult(

@@ -31,6 +31,7 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.messages import find_free_port
+from dlrover_tpu.runtime import MEMBERSHIP_RESTART_EXIT_CODE
 
 CommWorld = Dict[int, Tuple[int, int, str]]
 
@@ -158,6 +159,7 @@ class ElasticTrainingAgent:
         )
         self.worker: Optional[WorkerProcess] = None
         self.restart_count = 0
+        self._current_round = 0
         self._stop = threading.Event()
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._coordinator_port = find_free_port()
@@ -265,6 +267,7 @@ class ElasticTrainingAgent:
             stderr=stderr,
         )
         self.worker = WorkerProcess(proc, env)
+        self._current_round = rnd
         self.client.report_node_status(NodeStatus.RUNNING)
         logger.info(
             "started worker pid=%d rank=%d world=%d round=%d%s",
@@ -282,10 +285,24 @@ class ElasticTrainingAgent:
             self.worker = None
 
     def _membership_changed(self) -> bool:
+        """Reference _membership_changed training.py:720 — extended
+        with world-invalidation: if a member of our current world died,
+        the master cleared the world (rendezvous.remove_node) and every
+        survivor must re-rendezvous (SPMD workers cannot outlive their
+        world)."""
         try:
-            return self.client.num_nodes_waiting() > 0
+            st = self.client.rdzv_state()
         except Exception:  # noqa: BLE001
             return False
+        if st.waiting_num > 0:
+            return True
+        if st.round > self._current_round:
+            return True  # a newer round formed without us
+        return (
+            st.round == self._current_round
+            and self._current_round > 0
+            and st.world_size == 0
+        )
 
     def _restart_worker(self) -> Tuple[int, CommWorld]:
         """Reference _restart_workers :713."""
@@ -308,7 +325,9 @@ class ElasticTrainingAgent:
 
     def _monitor_loop(self) -> int:
         while not self._stop.is_set():
-            time.sleep(self.config.monitor_interval)
+            self._stop.wait(self.config.monitor_interval)
+            if self._stop.is_set():
+                break
             code = self.worker.poll() if self.worker else None
             if code is None:
                 if self._membership_changed():
@@ -323,6 +342,16 @@ class ElasticTrainingAgent:
                 logger.info("worker succeeded")
                 self.client.report_node_status(NodeStatus.SUCCEEDED)
                 return 0
+            if code == MEMBERSHIP_RESTART_EXIT_CODE:
+                # the worker's MembershipWatch saw the world go stale
+                # and exited voluntarily — re-rendezvous immediately;
+                # this is elasticity, not a failure (no restart budget)
+                logger.info(
+                    "worker requested membership restart (code %d)",
+                    code,
+                )
+                self._restart_worker()
+                continue
             # failure path: persist any staged shm checkpoint first
             # (reference _save_ckpt_to_storage training.py:674)
             logger.warning("worker exited with code %d", code)
@@ -354,6 +383,22 @@ class ElasticTrainingAgent:
 
     def stop(self):
         self._stop.set()
+
+    def leave(self):
+        """Graceful departure (preemption notice / scale-down): stop
+        supervising, then tell the master this node is gone so it
+        invalidates the rendezvous world — survivors re-rendezvous
+        instead of hanging on our collectives. The TPU analogue of a
+        SIGTERM-with-grace pod eviction. Order matters: stop first so
+        the monitor loop cannot re-join the rendezvous after the
+        DELETED report cleaned us out of it."""
+        self.stop()
+        try:
+            self.client.report_node_status(
+                NodeStatus.DELETED, "preempted"
+            )
+        except Exception:  # noqa: BLE001 — master may be gone too
+            logger.warning("leave report failed", exc_info=True)
 
 
 def launch_agent(

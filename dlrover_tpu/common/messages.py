@@ -172,6 +172,21 @@ class NumNodesWaitingResponse:
 
 
 @dataclass
+class RendezvousStateQuery(BaseRequest):
+    rdzv_name: str = "training"
+
+
+@dataclass
+class RendezvousStateResponse:
+    """Read-only rendezvous snapshot (no round-completion side effects):
+    workers and agents poll it to learn the current world went stale."""
+
+    round: int = 0
+    world_size: int = 0
+    waiting_num: int = 0
+
+
+@dataclass
 class NetworkCheckResult(BaseRequest):
     node_id: int = 0
     normal: bool = True

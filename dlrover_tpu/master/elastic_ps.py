@@ -74,12 +74,12 @@ class ElasticPsService:
         with self._lock:
             if version_type == VersionType.GLOBAL:
                 return self._global_version
-            table = (
-                self._local_versions
-                if version_type == VersionType.LOCAL
-                else self._restored_versions
-            )
-            return table.get(node_type, {}).get(node_id, 0)
+            if version_type == VersionType.LOCAL:
+                return self._local_versions.get(node_type, {}).get(node_id, 0)
+            # never-reported RESTORED defaults to -1 so it is
+            # distinguishable from "restored at version 0" (reference
+            # ElasticPsService failover semantics, elastic_ps.py:18)
+            return self._restored_versions.get(node_type, {}).get(node_id, -1)
 
     def update_version(
         self,

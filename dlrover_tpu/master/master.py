@@ -78,6 +78,9 @@ class JobMaster:
     def _poll_once(self) -> bool:
         """One watch iteration; True = job finished (either way)."""
         s = self.servicer
+        # heartbeat deaths flow through update_node_status → the
+        # SpmdWorldCallback invalidates the rendezvous world so
+        # survivors re-form instead of hanging on dead collectives
         s.node_manager.process_dead_nodes()
         if s.task_manager.has_datasets() and s.task_manager.finished():
             logger.info("all dataset tasks completed — job succeeded")

@@ -20,6 +20,12 @@ from dlrover_tpu.common.constants import (
 )
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.node import Node
+from dlrover_tpu.master.status_flow import (
+    CallbackRegistry,
+    IllegalTransitionError,
+    NodeEventCallback,
+    resolve_transition,
+)
 
 
 class NodeEvent:
@@ -51,6 +57,11 @@ class JobNodeManager:
         self.on_node_failed: Optional[Callable[[Node], None]] = None
         self.on_relaunch: Optional[Callable[[Node], None]] = None
         self._next_ids: Dict[str, int] = {}
+        # composable observers (reference NodeEventCallback framework)
+        self.callbacks = CallbackRegistry()
+
+    def register_callback(self, cb: NodeEventCallback):
+        self.callbacks.register(cb)
 
     # ---- membership ------------------------------------------------------
 
@@ -87,23 +98,50 @@ class JobNodeManager:
     # ---- status / heartbeat ingestion -----------------------------------
 
     def update_node_status(
-        self, node_type: str, node_id: int, status: str, exit_reason=""
+        self,
+        node_type: str,
+        node_id: int,
+        status: str,
+        exit_reason="",
+        strict: bool = False,
     ) -> Optional[Node]:
+        """Apply an externally-reported status change, validated against
+        the allowed-transition table (reference NodeStateFlow
+        status_flow.py:136). Illegal jumps — e.g. a stale RUNNING report
+        racing a DELETED — are rejected: logged and ignored, or raised
+        when `strict`."""
         node = self.get_node(node_type, node_id)
         if node is None:
             node = Node(node_type, node_id)
             self.add_node(node)
         old = node.status
-        node.update_from_event(status, exit_reason)
-        if old != status:
-            logger.info(
-                "node %s-%d: %s -> %s (%s)",
-                node_type,
-                node_id,
+        try:
+            transition = resolve_transition(old, status)
+        except IllegalTransitionError:
+            if strict:
+                raise
+            logger.warning(
+                "ignored illegal status transition %s -> %s for "
+                "node %s-%d (%s)",
                 old,
                 status,
+                node_type,
+                node_id,
                 exit_reason,
             )
+            return node
+        if transition is None:  # same-status no-op
+            return node
+        node.update_from_event(status, exit_reason)
+        logger.info(
+            "node %s-%d: %s -> %s (%s)",
+            node_type,
+            node_id,
+            old,
+            status,
+            exit_reason,
+        )
+        self.callbacks.fire(node, status)
         if status == NodeStatus.FAILED:
             self._handle_failure(node)
         return node
@@ -118,7 +156,7 @@ class JobNodeManager:
             NodeStatus.INITIAL,
             NodeStatus.PENDING,
         ):
-            node.update_status(NodeStatus.RUNNING)
+            self.update_node_status(node_type, node_id, NodeStatus.RUNNING)
 
     # ---- failure / relaunch policy --------------------------------------
 
@@ -185,7 +223,13 @@ class JobNodeManager:
     # ---- job-level state -------------------------------------------------
 
     def all_workers_finished(self) -> bool:
-        workers = self.get_nodes(NodeType.WORKER)
+        """DELETED workers (preempted / scaled away) don't block job
+        success — only live membership must succeed."""
+        workers = [
+            n
+            for n in self.get_nodes(NodeType.WORKER)
+            if n.status != NodeStatus.DELETED
+        ]
         return bool(workers) and all(
             n.status == NodeStatus.SUCCEEDED for n in workers
         )

@@ -86,11 +86,17 @@ class RendezvousManager:
             self._latest_join_time = now
             return self._round
 
-    def remove_node(self, node_id: int):
+    def remove_node(self, node_id: int, invalidate: bool = True):
+        """Drop a node. `invalidate=True` (death/leave) clears the
+        current world so survivors re-rendezvous; `invalidate=False`
+        (graceful SUCCEEDED exit) leaves the world intact — SPMD peers
+        all reach the final step together, so a finished peer must not
+        restart the rest."""
         with self._lock:
             self._waiting.pop(node_id, None)
-            # a member death invalidates the current world
-            if any(nid == node_id for nid, _, _ in self._world.values()):
+            if invalidate and any(
+                nid == node_id for nid, _, _ in self._world.values()
+            ):
                 self._world = {}
 
     def num_nodes_waiting(self) -> int:
@@ -100,6 +106,20 @@ class RendezvousManager:
             if self._world and self._waiting:
                 return len(self._waiting)
             return 0
+
+    def state(self) -> Tuple[int, int, int]:
+        """(round, world_size, waiting_num) — a pure read: unlike
+        get_comm_world it can never complete a round, so monitor loops
+        may poll it without racing the joiners. world_size == 0 with
+        round > 0 means the current world was invalidated by a member
+        death (remove_node)."""
+        with self._lock:
+            waiting = (
+                len(self._waiting)
+                if (self._world and self._waiting)
+                else 0
+            )
+            return self._round, len(self._world), waiting
 
     # ---- round completion ------------------------------------------------
 

@@ -59,6 +59,28 @@ class MasterServicer(MasterServicerBase):
         self.run_configs = {}
         self._ckpt_steps = {}  # path -> latest committed step
         self.job_stage = "init"
+        # composable node-event observers (reference event_callback.py):
+        # data-shard recovery, SPMD world invalidation, sparse cluster
+        # versioning and throughput bookkeeping all ride node events
+        from dlrover_tpu.master.status_flow import (
+            SparseClusterCallback,
+            SpeedMonitorCallback,
+            SpmdWorldCallback,
+            TaskRescheduleCallback,
+        )
+
+        self.node_manager.register_callback(
+            TaskRescheduleCallback(self.task_manager)
+        )
+        self.node_manager.register_callback(
+            SpmdWorldCallback(self.rdzv_managers)
+        )
+        self.node_manager.register_callback(
+            SparseClusterCallback(self.elastic_ps)
+        )
+        self.node_manager.register_callback(
+            SpeedMonitorCallback(self.speed_monitor)
+        )
 
     def _rdzv(self, name: str):
         return self.rdzv_managers[name]
@@ -105,6 +127,16 @@ class MasterServicer(MasterServicerBase):
             return ReplyEnvelope(
                 payload=msg.NumNodesWaitingResponse(
                     waiting_num=rdzv.num_nodes_waiting()
+                )
+            )
+        if isinstance(req, msg.RendezvousStateQuery):
+            rdzv = self._rdzv(req.rdzv_name)
+            rnd, world_size, waiting = rdzv.state()
+            return ReplyEnvelope(
+                payload=msg.RendezvousStateResponse(
+                    round=rnd,
+                    world_size=world_size,
+                    waiting_num=waiting,
                 )
             )
         if isinstance(req, msg.NetworkCheckQuery):
@@ -222,15 +254,11 @@ class MasterServicer(MasterServicerBase):
             self.node_manager.add_node(node)
             return ReplyEnvelope()
         if isinstance(req, msg.NodeStatusReport):
+            # shard recovery / world invalidation / speed bookkeeping
+            # all fire via the node manager's callback registry
             self.node_manager.update_node_status(
                 req.node_type, req.node_id, req.status, req.exit_reason
             )
-            if req.status == NodeStatus.RUNNING:
-                self.speed_monitor.add_running_worker(req.node_id)
-            elif NodeStatus.is_terminal(req.status):
-                self.speed_monitor.remove_running_worker(req.node_id)
-                self.task_manager.recover_tasks(req.node_id)
-                self._rdzv("training").remove_node(req.node_id)
             return ReplyEnvelope()
         if isinstance(req, msg.HeartBeat):
             self.node_manager.report_heartbeat(

@@ -27,25 +27,32 @@ from dlrover_tpu.operator.crds import (
 
 MASTER_SUFFIX = "-dlrover-master"
 
+_BYTES_PER_MIB = 1024.0 * 1024.0
+# case-sensitive: k8s quantity suffixes distinguish 'M' (megabytes)
+# from 'm' (milli-units — metrics APIs emit e.g. '128974848m')
 _MEM_UNITS_MB = {
-    "": 1 / (1024.0 * 1024.0),  # plain bytes
-    "k": 1e3 / (1024.0 * 1024.0),
-    "m": 1e6 / (1024.0 * 1024.0),
-    "g": 1e9 / (1024.0 * 1024.0),
-    "ki": 1 / 1024.0,
-    "mi": 1.0,
-    "gi": 1024.0,
-    "ti": 1024.0 * 1024.0,
+    "": 1 / _BYTES_PER_MIB,  # plain bytes
+    "m": 1e-3 / _BYTES_PER_MIB,  # millibytes
+    "k": 1e3 / _BYTES_PER_MIB,
+    "M": 1e6 / _BYTES_PER_MIB,
+    "G": 1e9 / _BYTES_PER_MIB,
+    "T": 1e12 / _BYTES_PER_MIB,
+    "Ki": 1 / 1024.0,
+    "Mi": 1.0,
+    "Gi": 1024.0,
+    "Ti": 1024.0 * 1024.0,
 }
 
 
 def parse_memory_mb(quantity) -> int:
-    """Kubernetes memory quantity ('2Gi', '512Mi', '1G', bare bytes)
-    → MiB. Raises ValueError on junk (caller marks the plan Failed)."""
-    s = str(quantity).strip().lower()
+    """Kubernetes memory quantity ('2Gi', '512Mi', '1G', bare bytes,
+    milli-quantity '...m') → MiB. Suffixes are case-sensitive per the
+    k8s resource.Quantity grammar. Raises ValueError on junk (caller
+    marks the plan Failed)."""
+    s = str(quantity).strip()
     if not s:
         return 0
-    num = s.rstrip("abcdefghijklmnopqrstuvwxyz")
+    num = s.rstrip("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ")
     unit = s[len(num):]
     if unit not in _MEM_UNITS_MB:
         raise ValueError(f"unsupported memory quantity: {quantity!r}")

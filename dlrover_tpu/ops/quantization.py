@@ -209,17 +209,34 @@ def quantized_reduce_scatter(
 def quantized_all_reduce_tree(
     grads, mesh, axis_name: str, block: int = DEFAULT_BLOCK
 ):
-    """Compressed gradient all-reduce over a pytree: each rank quantizes
-    its leaf once (own scale), all-gathers the int8 payload + scales
-    (1/4 the f32 wire bytes), then dequantizes every contribution and
-    sums in f32 locally — one-shot compression for DCN-crossing reduces
-    where ring latency dominates. Wire format matches quant_reduce.cu's
-    role; the sum itself is exact given the quantized inputs."""
+    """Compressed gradient all-reduce over a pytree of *per-rank
+    contributions*: each leaf has a leading axis of size n (= mesh axis
+    size) holding rank i's gradient at index i, sharded over
+    `axis_name`. Each rank quantizes its own slice once (own scale),
+    all-gathers the int8 payload + scales (1/4 the f32 wire bytes),
+    then dequantizes every contribution and sums in f32 locally —
+    one-shot compression for DCN-crossing reduces where ring latency
+    dominates. Returns the replicated sum with the leading axis dropped.
+    Wire format matches quant_reduce.cu's role; the sum itself is exact
+    given the quantized inputs.
+
+    Distinct inputs must arrive as distinct shards: a replicated
+    jax.Array holds one value per-rank, so a plain in_specs=P() design
+    cannot combine different gradients (it would just scale by n)."""
     from jax.sharding import PartitionSpec as P
 
+    n_ranks = mesh.shape[axis_name]
+
     def one(g):
+        if g.shape[0] != n_ranks:
+            raise ValueError(
+                f"leaf leading dim {g.shape[0]} != axis size {n_ranks}; "
+                "stack per-rank contributions on axis 0"
+            )
+
         def inner(gl):
-            q, s, shape, pad = quantize_any(gl, block)
+            # gl: [1, ...] — this rank's contribution
+            q, s, shape, pad = quantize_any(gl[0], block)
             qg = jax.lax.all_gather(q, axis_name)  # [n, 1, L]
             sg = jax.lax.all_gather(s, axis_name)  # [n, 1, L/block]
             n = qg.shape[0]
@@ -232,7 +249,7 @@ def quantized_all_reduce_tree(
             return total.reshape(shape)
 
         fn = shard_map(
-            inner, mesh=mesh, in_specs=P(), out_specs=P()
+            inner, mesh=mesh, in_specs=P(axis_name), out_specs=P()
         )
         return fn(g)
 

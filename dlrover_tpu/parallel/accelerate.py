@@ -222,11 +222,17 @@ def accelerate(
         loss, metrics = _loss_body(state["params"], batch)
         return metrics
 
+    # the NamedSharding tree of the train state, derived without
+    # materializing any arrays — consumers: checkpoint restore onto a
+    # fresh mesh (engine.load target) and auto_engine memory analysis
+    abstract_state = jax.eval_shape(_init, jax.random.PRNGKey(0))
+    state_shardings = tree_shardings(abstract_state, mesh, rules)
+
     return Accelerated(
         mesh=mesh,
         strategy=strategy,
         init=init_jit,
         train_step=train_jit,
         eval_step=jax.jit(_eval_step),
-        state_shardings=None,
+        state_shardings=state_shardings,
     )

@@ -162,22 +162,29 @@ def _quant(x, scale, dtype, qmax):
 
 
 @partial(jax.custom_vjp, nondiff_argnums=())
-def _fp8_dot(x, w, sx, sw, sg):
+def _fp8_dot(x, w, sx, sw):
     qx = _quant(x, sx, jnp.float8_e4m3fn, E4M3_MAX)
     qw = _quant(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
     y = jnp.dot(qx, qw, preferred_element_type=jnp.float32)
     return y / (sx * sw)
 
 
-def _fp8_dot_fwd(x, w, sx, sw, sg):
+def _fp8_dot_fwd(x, w, sx, sw):
     qx = _quant(x, sx, jnp.float8_e4m3fn, E4M3_MAX)
     qw = _quant(w, sw, jnp.float8_e4m3fn, E4M3_MAX)
     y = jnp.dot(qx, qw, preferred_element_type=jnp.float32) / (sx * sw)
-    return y, (qx, qw, sx, sw, sg)
+    return y, (qx, qw, sx, sw)
 
 
 def _fp8_dot_bwd(res, g):
-    qx, qw, sx, sw, sg = res
+    qx, qw, sx, sw = res
+    # just-in-time e5m2 scaling from the *observed* cotangent: the amax
+    # reduction fuses into the bwd epilogue under XLA, so the delayed
+    # (history-based) gradient scale the GPU recipe uses to hide the
+    # reduction latency is unnecessary here — and a forward-output proxy
+    # can clip or flush gradients whose magnitude differs from |y|.
+    gmax = jnp.max(jnp.abs(g)).astype(jnp.float32)
+    sg = jnp.where(gmax > 0, E5M2_MAX / gmax, 1.0)
     qg = _quant(g, sg, jnp.float8_e5m2, E5M2_MAX)
     dx = jnp.dot(
         qg, qw.T, preferred_element_type=jnp.float32
@@ -185,7 +192,7 @@ def _fp8_dot_bwd(res, g):
     dw = jnp.dot(
         qx.T, qg, preferred_element_type=jnp.float32
     ) / (sx * sg)
-    return dx, dw, None, None, None
+    return dx, dw, None, None
 
 
 _fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
@@ -194,18 +201,19 @@ _fp8_dot.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
 def fp8_dot(
     x: jax.Array, w: jax.Array, state: Fp8State
 ) -> Tuple[jax.Array, Fp8State]:
-    """2-D matmul in fp8 with delayed scaling; returns f32 result and the
-    updated amax history. The gradient amax is updated from the *current*
-    forward's inputs only (the true grad amax is observed next step via
-    the returned state — the delayed part of delayed scaling)."""
+    """2-D matmul in fp8; returns f32 result and the updated amax history.
+
+    Forward operands use delayed scaling (amax history, TE recipe); the
+    gradient path quantizes with a scale computed from the actual
+    cotangent inside the backward pass (see _fp8_dot_bwd), so the
+    amax_g history is monitoring-only: it records the forward-output
+    magnitude as an a-priori estimate of gradient scale."""
     sx = _scale_from_history(state.amax_x, E4M3_MAX)
     sw = _scale_from_history(state.amax_w, E4M3_MAX)
-    sg = _scale_from_history(state.amax_g, E5M2_MAX)
-    y = _fp8_dot(x, w, sx, sw, sg)
+    y = _fp8_dot(x, w, sx, sw)
     new_state = Fp8State(
         amax_x=_roll_in(state.amax_x, jnp.max(jnp.abs(x)).astype(jnp.float32)),
         amax_w=_roll_in(state.amax_w, jnp.max(jnp.abs(w)).astype(jnp.float32)),
-        # grad amax proxy: output magnitude (observed pre-bwd)
         amax_g=_roll_in(state.amax_g, jnp.max(jnp.abs(y)).astype(jnp.float32)),
     )
     return y, new_state

@@ -76,15 +76,32 @@ class DryRunner:
         self, strategy: Strategy, run_steps: int = 0
     ) -> DryRunReport:
         report = DryRunReport(strategy=strategy)
+        state = None
         try:
             t0 = time.monotonic()
             acc, batch = self.build(strategy)
-            state = acc.init(jax.random.PRNGKey(0))
             batch = acc.shard_batch(batch)
             step = acc.train_step
             if not hasattr(step, "lower"):  # plain callable → wrap
                 step = jax.jit(step)
-            compiled = step.lower(state, batch).compile()
+            if acc.state_shardings is not None and run_steps <= 0:
+                # AOT path: compile against abstract state carrying the
+                # strategy's shardings — no model-sized allocation
+                # during the search (the point of cost-model search)
+                abstract = jax.eval_shape(
+                    acc.init, jax.random.PRNGKey(0)
+                )
+                spec_state = jax.tree_util.tree_map(
+                    lambda a, s: jax.ShapeDtypeStruct(
+                        a.shape, a.dtype, sharding=s
+                    ),
+                    abstract,
+                    acc.state_shardings,
+                )
+                compiled = step.lower(spec_state, batch).compile()
+            else:
+                state = acc.init(jax.random.PRNGKey(0))
+                compiled = step.lower(state, batch).compile()
             report.compile_seconds = time.monotonic() - t0
         except Exception as e:  # noqa: BLE001 — search survives bad points
             report.error = f"{type(e).__name__}: {e}"
@@ -116,6 +133,8 @@ class DryRunner:
 
         if run_steps > 0 and report.fits_memory:
             try:
+                if state is None:
+                    state = acc.init(jax.random.PRNGKey(0))
                 state, _ = acc.train_step(state, batch)  # warmup
                 jax.block_until_ready(state)
                 t0 = time.monotonic()

@@ -1,0 +1,243 @@
+"""Worker-side multi-host runtime bootstrap.
+
+Reference parity: the reference's workers (re)build the collective
+runtime after every rendezvous — torchelastic assigns ranks and the
+training process calls `init_process_group` with the rendezvous store
+(dlrover/python/elastic_agent/torch/training.py:253 `next_rendezvous`,
+:488 `_assign_worker_ranks`; atorch/atorch/distributed/distributed.py:664
+`init_distributed`, :796 `reset_distributed`).
+
+TPU re-design: the per-host agent exports the coordination env
+(DLROVER_TPU_COORDINATOR_ADDR / NODE_RANK / NODE_NUM — see
+agent/training.py _worker_env) and this module is the piece the worker
+process calls to consume it: `dlrover_tpu.init()` joins the multi-host
+world via `jax.distributed.initialize` over DCN; collectives inside jit
+then ride ICI via XLA. A new rendezvous round means a fresh worker
+process (the agent restarts it), so `init()` is normally called once per
+process — but it also supports in-process re-init (`shutdown()` +
+`init()`) for single-process tests and custom supervisors.
+
+Because SPMD workers cannot outlive their world (a peer's death leaves
+collectives hanging until slow runtime heartbeats fire), the worker
+also runs a `MembershipWatch`: a thread polling the master's rendezvous
+state; the moment the world is invalidated (member died) or new nodes
+are waiting to join, the worker exits with MEMBERSHIP_RESTART_EXIT_CODE
+so its agent immediately re-rendezvouses — master-driven preemption,
+the TPU answer to "NCCL error propagation restarts the ranks".
+"""
+
+import atexit
+import os
+import threading
+from typing import Callable, Optional
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+# Worker exit code meaning "restart me into a new rendezvous round" —
+# the agent treats it as a membership restart, not a failure.
+MEMBERSHIP_RESTART_EXIT_CODE = 77
+
+
+class RuntimeContext:
+    """What this process knows about its place in the job."""
+
+    def __init__(self):
+        self.initialized = False
+        self.coordinator_addr: Optional[str] = None
+        self.node_rank = 0
+        self.node_num = 1
+        self.rdzv_round = 0
+        self.watch: Optional["MembershipWatch"] = None
+
+    def reset(self):
+        self.initialized = False
+        self.coordinator_addr = None
+
+
+_ctx = RuntimeContext()
+
+
+def context() -> RuntimeContext:
+    return _ctx
+
+
+def is_initialized() -> bool:
+    return _ctx.initialized
+
+
+def node_rank() -> int:
+    return _ctx.node_rank
+
+
+def node_count() -> int:
+    return _ctx.node_num
+
+
+def init(
+    coordinator_addr: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    membership_watch: bool = True,
+    watch_interval: float = 1.0,
+) -> RuntimeContext:
+    """Join the multi-host JAX world the agent rendezvoused for us.
+
+    Reads DLROVER_TPU_COORDINATOR_ADDR / NODE_RANK / NODE_NUM (exported
+    by the agent, agent/training.py:_worker_env) unless overridden, and
+    calls `jax.distributed.initialize`. Single-node jobs (NODE_NUM==1 or
+    no coordinator env) are a no-op apart from context bookkeeping, so
+    user scripts can call `dlrover_tpu.init()` unconditionally.
+
+    Re-init: if the process is already initialized with different
+    coordinates, the previous runtime is shut down first (the
+    `reset_distributed` path in the reference).
+    """
+    addr = coordinator_addr or os.environ.get(NodeEnv.COORDINATOR_ADDR)
+    num = (
+        num_processes
+        if num_processes is not None
+        else int(os.environ.get(NodeEnv.NODE_NUM, "1"))
+    )
+    rank = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get(NodeEnv.NODE_RANK, "0"))
+    )
+    _ctx.node_rank = rank
+    _ctx.node_num = num
+    _ctx.rdzv_round = int(
+        os.environ.get("DLROVER_TPU_RDZV_ROUND", "0")
+    )
+    if num > 1 and addr:
+        import jax
+
+        if _ctx.initialized:
+            if _ctx.coordinator_addr == addr and _ctx.node_num == num:
+                return _ctx  # idempotent
+            shutdown()
+        logger.info(
+            "jax.distributed.initialize coordinator=%s rank=%d/%d",
+            addr,
+            rank,
+            num,
+        )
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=num,
+            process_id=rank,
+        )
+        _ctx.initialized = True
+        _ctx.coordinator_addr = addr
+        atexit.register(_shutdown_quietly)
+    else:
+        _ctx.initialized = False
+        _ctx.coordinator_addr = None
+    if membership_watch and os.environ.get(NodeEnv.MASTER_ADDR):
+        start_membership_watch(interval=watch_interval)
+    return _ctx
+
+
+def shutdown():
+    """Tear down the distributed runtime (re-init support)."""
+    if _ctx.watch is not None:
+        _ctx.watch.stop()
+        _ctx.watch = None
+    if _ctx.initialized:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — peer may already be gone
+            logger.warning("jax.distributed.shutdown failed", exc_info=True)
+        _ctx.reset()
+
+
+def _shutdown_quietly():
+    try:
+        if _ctx.initialized:
+            import jax
+
+            jax.distributed.shutdown()
+            _ctx.reset()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class MembershipWatch:
+    """Poll the master rendezvous state; exit when the world is stale.
+
+    Stale means: a member of our world died (the master invalidated the
+    world — rendezvous.remove_node), a newer round formed without us, or
+    nodes are waiting to join. The agent supervising this process
+    understands MEMBERSHIP_RESTART_EXIT_CODE and restarts us into the
+    next round without burning a failure-restart budget.
+    """
+
+    def __init__(
+        self,
+        client=None,
+        interval: float = 1.0,
+        on_change: Optional[Callable[[], None]] = None,
+    ):
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        self.client = client or MasterClient.singleton()
+        self.interval = interval
+        self.on_change = on_change or self._default_exit
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _default_exit():
+        logger.info(
+            "membership change detected — exiting for re-rendezvous "
+            "(code %d)",
+            MEMBERSHIP_RESTART_EXIT_CODE,
+        )
+        os._exit(MEMBERSHIP_RESTART_EXIT_CODE)
+
+    def _stale(self) -> bool:
+        try:
+            st = self.client.rdzv_state()
+        except Exception:  # noqa: BLE001 — master briefly unreachable
+            return False
+        if st.waiting_num > 0:
+            return True
+        if st.round > _ctx.rdzv_round:
+            return True  # a newer world formed without us
+        if st.round == _ctx.rdzv_round and st.world_size == 0:
+            return True  # our world was invalidated (member death)
+        return False
+
+    def _loop(self):
+        while not self._stop.is_set():
+            if self._stale():
+                self.on_change()
+                return
+            self._stop.wait(self.interval)
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="membership-watch", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+
+def start_membership_watch(
+    client=None,
+    interval: float = 1.0,
+    on_change: Optional[Callable[[], None]] = None,
+) -> MembershipWatch:
+    if _ctx.watch is not None:
+        return _ctx.watch
+    watch = MembershipWatch(
+        client=client, interval=interval, on_change=on_change
+    )
+    watch.start()
+    _ctx.watch = watch
+    return watch

@@ -127,20 +127,35 @@ def _reassemble_sharded(
     to be present in `flat`."""
     import jax
 
-    if all(k in flat for k in entry["keys"]):
+    present = [
+        (k, ix)
+        for k, ix in zip(entry["keys"], entry["indices"])
+        if k in flat
+    ]
+    # true coverage check: the distinct shard indices must tile the full
+    # shape. "all listed keys present" is NOT enough — an aux written by
+    # one host lists only that host's shards, and stitching those into
+    # zeros would silently fabricate a wrong (and per-host different)
+    # global array.
+    total = int(np.prod(entry["shape"])) if entry["shape"] else 1
+    seen = {}
+    for k, ix in present:
+        seen[_index_key(ix)] = flat[k].size
+    covered = sum(seen.values())
+    if present and covered >= total:
         # full coverage (single host, or storage merged every host's
         # shard files): stitch the global array — works for ANY restore
         # mesh, since restore_to_shardings re-shards it afterwards
         out = np.zeros(entry["shape"], dtype=np.dtype(entry["dtype"]))
-        for k, ix in zip(entry["keys"], entry["indices"]):
+        for k, ix in present:
             out[ix] = flat[k]
         return out
-    if target_leaf is not None and hasattr(target_leaf, "sharding"):
+    sharding = _leaf_sharding(target_leaf)
+    if sharding is not None:
         # partial coverage (this host staged only its shards): place
         # each saved shard directly on the device that owns that index
         # in the restore sharding — valid only when the mesh layout
         # still matches what was saved
-        sharding = target_leaf.sharding
         shape = entry["shape"]
         index_to_saved = {
             _index_key(ix): flat[k]
@@ -174,6 +189,18 @@ def _index_key(ix) -> tuple:
         (s.start, s.stop, s.step) if isinstance(s, slice) else s
         for s in ix
     )
+
+
+def _leaf_sharding(ref):
+    """A restore target leaf may be a live array (carries .sharding) or
+    a bare jax.sharding.Sharding (e.g. Accelerated.state_shardings)."""
+    import jax
+
+    if ref is None:
+        return None
+    if isinstance(ref, jax.sharding.Sharding):
+        return ref
+    return getattr(ref, "sharding", None)
 
 
 def _merge_aux(own_aux: bytes, other_auxes) -> bytes:
@@ -243,12 +270,15 @@ def unflatten_state(
 def restore_to_shardings(state: Any, target: Any) -> Any:
     """device_put a host-restored state onto `target`'s shardings —
     the re-shard-on-resume path (SURVEY.md §7 'hard parts': elastic
-    world resize re-shards checkpointed state onto the new mesh)."""
+    world resize re-shards checkpointed state onto the new mesh).
+    `target` leaves may be live arrays or bare Shardings
+    (Accelerated.state_shardings)."""
     import jax
 
     def _put(host, ref):
-        if hasattr(ref, "sharding"):
-            return jax.device_put(host, ref.sharding)
+        sharding = _leaf_sharding(ref)
+        if sharding is not None:
+            return jax.device_put(host, sharding)
         return host
 
     return jax.tree_util.tree_map(_put, state, target)
@@ -380,6 +410,30 @@ class CheckpointEngine:
         aux = self.storage.read(
             os.path.join(step_dir, f"aux_{self.node_rank}.pkl")
         )
+        # fast path: rank-local shard file + own aux only. When the mesh
+        # is unchanged each host needs exactly the shards it staged, so
+        # skip materializing every peer's host_*.npz (O(model size) host
+        # RAM per host on shared storage). Falls back to the full merge
+        # when local shards don't cover the restore sharding.
+        if aux is not None:
+            own = self.storage.read(
+                os.path.join(step_dir, f"host_{self.node_rank}.npz")
+            )
+            if own is not None:
+                local_flat: Dict[str, np.ndarray] = {}
+                with np.load(io.BytesIO(own)) as npz:
+                    for k in npz.files:
+                        local_flat[k] = npz[k]
+                try:
+                    return step, unflatten_state(
+                        local_flat, aux, target
+                    )
+                except KeyError:
+                    logger.info(
+                        "rank-local restore of step %d does not cover "
+                        "the restore sharding; merging all host files",
+                        step,
+                    )
         if aux is None:
             # a host added by a scale-up has no aux of its own — any
             # peer's aux carries the same treedef/paths

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+import dlrover_tpu
 from dlrover_tpu.agent.monitor import write_step_metrics
 from dlrover_tpu.common.constants import NodeEnv
 from dlrover_tpu.models import llama
@@ -42,13 +43,17 @@ def main():
     args = p.parse_args()
 
     restart_count = int(os.environ.get(NodeEnv.RESTART_COUNT, "0"))
+    # join the multi-host world the agent rendezvoused for us (no-op on
+    # single-node runs); installs the membership watch so this process
+    # restarts itself when the world changes
+    dlrover_tpu.init()
     cfg = llama.LlamaConfig.tiny()
     acc = accelerate(
         init_params=lambda k: llama.init_params(cfg, k),
         loss_fn=lambda pm, b, m: llama.loss_fn(cfg, pm, b, mesh=m),
         rules=llama.partition_rules(cfg),
         optimizer=optax.adam(1e-2),
-        strategy=Strategy(mesh=MeshSpec.fit(jax.local_device_count())),
+        strategy=Strategy(mesh=MeshSpec.fit(jax.device_count())),
     )
     state = acc.init(jax.random.PRNGKey(0))
     tokens = jax.random.randint(

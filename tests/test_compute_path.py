@@ -169,11 +169,19 @@ class TestCompressedCollectives:
 
     def test_quantized_all_reduce_tree(self):
         mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
-        g = {"w": jax.random.normal(jax.random.PRNGKey(4), (33, 9))}
+        # distinct per-rank contributions stacked on axis 0
+        g = {"w": jax.random.normal(jax.random.PRNGKey(4), (8, 33, 9))}
         out = quantized_all_reduce_tree(g, mesh, "dp", block=64)
-        ref = g["w"] * 8.0  # replicated input summed over 8 ranks
+        ref = jnp.sum(g["w"], axis=0)
+        assert out["w"].shape == (33, 9)
         rel = jnp.abs(out["w"] - ref).max() / (jnp.abs(ref).max() + 1e-9)
         assert float(rel) < 0.02
+
+    def test_quantized_all_reduce_tree_rejects_bad_leading_dim(self):
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+        g = {"w": jnp.ones((3, 5))}
+        with pytest.raises(ValueError, match="leading dim"):
+            quantized_all_reduce_tree(g, mesh, "dp", block=64)
 
 
 class TestInt8Adam:

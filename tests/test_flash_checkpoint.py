@@ -172,6 +172,31 @@ class TestEngineEndToEnd:
         assert restored["w"].sharding == state["w"].sharding
         eng.close()
 
+    def test_restore_to_bare_sharding_target(self, tmp_path):
+        """The target may be a tree of NamedShardings instead of live
+        arrays (Accelerated.state_shardings) — no live state needed to
+        re-place a restored checkpoint."""
+        import jax
+        from jax.sharding import (
+            Mesh,
+            NamedSharding,
+            PartitionSpec as P,
+        )
+
+        eng = self._engine(tmp_path)
+        state = {"w": jnp.arange(16, dtype=jnp.float32)}
+        eng.save_to_memory(1, state)
+        mesh = Mesh(np.array(jax.devices()[:8]), ("fsdp",))
+        target = {"w": NamedSharding(mesh, P("fsdp"))}
+        step, restored = eng.load(target=target)
+        assert step == 1
+        assert restored["w"].sharding == target["w"]
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]),
+            np.arange(16, dtype=np.float32),
+        )
+        eng.close()
+
     def test_checkpointer_api(self, tmp_path):
         ck = Checkpointer(
             str(tmp_path / "ck"), job_name=f"ckr_{time.time_ns()}"

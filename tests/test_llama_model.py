@@ -111,3 +111,24 @@ def test_optimizer_state_sharded_like_params(cfg):
     mu_wq = state["opt_state"][0].mu["layers"]["wq"]
     assert wq.sharding == mu_wq.sharding
     assert not wq.sharding.is_fully_replicated
+
+
+def test_state_shardings_match_live_state(cfg):
+    """Accelerated.state_shardings (derived abstractly) must equal the
+    shardings of the materialized state — checkpoint restore + the AOT
+    dry-runner consume it without reverse-engineering a live tree."""
+    acc = accelerate(
+        init_params=lambda k: llama.init_params(cfg, k),
+        loss_fn=lambda p, b, m: llama.loss_fn(cfg, p, b, mesh=m),
+        rules=llama.partition_rules(cfg),
+        optimizer=optax.adam(1e-3),
+        strategy=Strategy(mesh=MeshSpec(fsdp=4, tensor=2)),
+    )
+    assert acc.state_shardings is not None
+    state = acc.init(jax.random.PRNGKey(0))
+    live = jax.tree_util.tree_map(lambda a: a.sharding, state)
+    flat_live = jax.tree_util.tree_leaves(live)
+    flat_decl = jax.tree_util.tree_leaves(acc.state_shardings)
+    assert len(flat_live) == len(flat_decl)
+    for got, want in zip(flat_live, flat_decl):
+        assert got == want

@@ -177,6 +177,9 @@ class TestQuantityParsing:
         assert parse_memory_mb("1G") == 953
         assert parse_memory_mb("512Ki") == 0  # sub-MiB rounds down
         assert parse_memory_mb("") == 0
+        # milli suffix (metrics APIs): 128974848m = ~128975 bytes = 0 MiB
+        assert parse_memory_mb("128974848m") == 0
+        assert parse_memory_mb("2000000000000m") == 1907  # 2 GB in milli
         with pytest.raises(ValueError):
             parse_memory_mb("16Q")
 
