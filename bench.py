@@ -36,15 +36,20 @@ def main():
     n_dev = jax.local_device_count()
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
+        # head_dim 128 (Llama-2's own head size) fills all 128 MXU lanes
+        # in the flash kernel; "proj" remat saves the [B,S,dim]-sized
+        # projection outputs and recomputes only the mlp-wide matmuls +
+        # flash fwd — measured best on v5e (0.56 MFU vs 0.27 in r2)
         cfg = llama.LlamaConfig(
             vocab_size=32000,
             dim=1024,
             n_layers=24,
-            n_heads=16,
-            n_kv_heads=16,
+            n_heads=8,
+            n_kv_heads=8,
             mlp_dim=4096,
             max_seq_len=2048,
             remat=True,
+            remat_policy="proj",
             attn_impl="auto",
         )
         batch_size, seq_len = 8, 2048

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.ops.attention import dot_product_attention
+from dlrover_tpu.parallel.remat import checkpoint_name
 from dlrover_tpu.parallel.sharding import constrain
 
 Params = Dict[str, Any]
@@ -226,9 +227,9 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
     }
 
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(b, s, H, hd)
-    k = (h @ lp["wk"]).reshape(b, s, KV, hd)
-    v = (h @ lp["wv"]).reshape(b, s, KV, hd)
+    q = checkpoint_name((h @ lp["wq"]).reshape(b, s, H, hd), "qkv_proj")
+    k = checkpoint_name((h @ lp["wk"]).reshape(b, s, KV, hd), "qkv_proj")
+    v = checkpoint_name((h @ lp["wv"]).reshape(b, s, KV, hd), "qkv_proj")
     q = constrain(q, mesh, ("data", "fsdp"), "seq", "tensor", None)
     k = constrain(k, mesh, ("data", "fsdp"), "seq", "tensor", None)
     v = constrain(v, mesh, ("data", "fsdp"), "seq", "tensor", None)
@@ -250,9 +251,10 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
         attn = dot_product_attention(
             q, k, v, causal=True, impl=cfg.attn_impl
         )
-    attn = attn.reshape(b, s, H * hd)
+    attn = checkpoint_name(attn.reshape(b, s, H * hd), "attn_out")
     x = x + constrain(
-        attn @ lp["wo"], mesh, ("data", "fsdp"), "seq", None
+        checkpoint_name(attn @ lp["wo"], "attn_proj"),
+        mesh, ("data", "fsdp"), "seq", None,
     )
 
     h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
@@ -269,13 +271,14 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
         )
         x = x + constrain(ff_out, mesh, ("data", "fsdp"), "seq", None)
         return x, moe_metrics["moe_aux_loss"]
-    gate = jax.nn.silu(h @ lp["w_gate"])
-    up = h @ lp["w_up"]
+    gate = jax.nn.silu(checkpoint_name(h @ lp["w_gate"], "mlp_gate"))
+    up = checkpoint_name(h @ lp["w_up"], "mlp_up")
     ff = constrain(
         gate * up, mesh, ("data", "fsdp"), "seq", "tensor"
     )
     x = x + constrain(
-        ff @ lp["w_down"], mesh, ("data", "fsdp"), "seq", None
+        checkpoint_name(ff @ lp["w_down"], "mlp_down"),
+        mesh, ("data", "fsdp"), "seq", None,
     )
     return x, jnp.zeros((), jnp.float32)
 

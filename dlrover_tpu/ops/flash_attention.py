@@ -21,30 +21,72 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Blocks as large as the VMEM budget allows: the 1024^2 score tile
+# measured 2.2x faster than 128^2 at head_dim 64 on v5e (grid-step
+# overhead dominates small tiles when the contraction dim is short).
+_MAX_BLOCK = 1024
+# VMEM bytes budgeted per kernel invocation (v5e has ~16 MB; leave
+# headroom for Mosaic's double buffering of the HBM->VMEM pipeline)
+_VMEM_BUDGET = 8 * 1024 * 1024
 
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def supports(q, k, segment_ids=None, block_q=DEFAULT_BLOCK_Q,
-             block_k=DEFAULT_BLOCK_K) -> bool:
+def _pick_block(s: int, cap: int) -> int:
+    """Largest power-of-two block <= cap that divides s (min 128)."""
+    b = cap
+    while b >= 128:
+        if s % b == 0:
+            return b
+        b //= 2
+    return 0
+
+
+def _vmem_estimate(bq: int, bk: int, d: int) -> int:
+    """Rough per-invocation VMEM bytes for the worst (dkv) kernel:
+    f32 score tile + f32 dk/dv/acc scratches + bf16 staged blocks."""
+    return 4 * bq * bk + 8 * d * bq + 10 * d * bk
+
+
+def auto_blocks(s_q: int, s_k: int, d: int) -> Tuple[int, int]:
+    """Pick (block_q, block_k) for the shapes: as large as the VMEM
+    budget allows given head_dim d. Returns (0, 0) when no block >= 128
+    divides the sequence (then the caller must use the XLA reference)."""
+    bq = _pick_block(s_q, _MAX_BLOCK)
+    bk = _pick_block(s_k, _MAX_BLOCK)
+    while max(bq, bk) >= 256 and _vmem_estimate(bq, bk, d) > _VMEM_BUDGET:
+        if bq >= bk:
+            bq //= 2
+        else:
+            bk //= 2
+    return bq, bk
+
+
+def supports(q, k, segment_ids=None, block_q=None, block_k=None) -> bool:
     """Whether the flash path handles these shapes (else XLA reference)."""
     if segment_ids is not None:
         return False
     d = q.shape[-1]
     s_q = q.shape[1]
     s_k = k.shape[1]
-    if d % 128 != 0:
+    # Mosaic pads the minor dim to the 128-lane register width, so any
+    # multiple-of-8 head_dim lowers; below 32 the pad waste is too high
+    # to beat the XLA reference
+    if d % 8 != 0 or d < 32 or d > 512:
         return False
     if s_q != s_k:
         # the kernel's causal mask is top-left aligned; cross-length
         # (KV-cache decode) needs the bottom-right offset the XLA
         # reference applies — don't take the flash path
         return False
-    if s_q % block_q != 0 or s_k % block_k != 0:
+    auto_q, auto_k = auto_blocks(s_q, s_k, d)
+    bq = block_q or auto_q
+    bk = block_k or auto_k
+    if not bq or not bk:
+        return False
+    if s_q % bq != 0 or s_k % bk != 0:
         return False
     if q.shape[2] % k.shape[2] != 0:
         return False
@@ -405,15 +447,29 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jax.Array:
-    """Flash attention on [B, S, H, D] tensors; returns [B, S, H, D]."""
+    """Flash attention on [B, S, H, D] tensors; returns [B, S, H, D].
+
+    block_q/block_k default to the VMEM-budget auto choice (auto_blocks);
+    pass explicit sizes only for tuning experiments."""
     if causal and q.shape[1] != k.shape[1]:
         raise ValueError(
             "flash_attention causal masking requires equal q/k lengths "
             f"(got {q.shape[1]} vs {k.shape[1]}); use the XLA reference "
             "path for KV-cache decode"
+        )
+    if block_q is None or block_k is None:
+        auto_q, auto_k = auto_blocks(
+            q.shape[1], k.shape[1], q.shape[-1]
+        )
+        block_q = block_q or auto_q
+        block_k = block_k or auto_k
+    if not block_q or not block_k:
+        raise ValueError(
+            f"no flash block size divides seq lengths "
+            f"{q.shape[1]}/{k.shape[1]}; use the XLA reference path"
         )
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5

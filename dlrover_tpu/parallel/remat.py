@@ -48,6 +48,19 @@ def resolve_policy(
         return _P.dots_saveable
     if name == "dots_no_batch":
         return _P.dots_with_no_batch_dims_saveable
+    if name == "proj":
+        # save the [B,S,dim]-sized projection outputs (cheap in HBM),
+        # recompute the mlp_dim-wide matmuls + the flash-attention fwd —
+        # measured best MFU/HBM tradeoff for the decoder on v5e
+        return _P.save_only_these_names(
+            "qkv_proj", "attn_proj", "mlp_down"
+        )
+    if name == "proj_mlp":
+        # additionally save the mlp_dim-wide gate/up activations —
+        # near-zero recompute, ~4x the activation HBM of "proj"
+        return _P.save_only_these_names(
+            "qkv_proj", "attn_proj", "mlp_down", "mlp_gate", "mlp_up"
+        )
     if name == "save_names":
         return _P.save_only_these_names(*save_names)
     if name == "offload_names":
