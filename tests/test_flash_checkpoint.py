@@ -140,6 +140,35 @@ class TestEngineEndToEnd:
         )
         eng.close()
 
+    def test_async_memory_save_load(self, tmp_path):
+        eng = self._engine(tmp_path)
+        state = {"w": jnp.arange(8, dtype=jnp.float32), "s": jnp.asarray(4)}
+        blocked = eng.save_to_memory_async(4, state)
+        assert blocked < 1.0
+        eng.wait_for_staging()
+        step, restored = eng.load_from_memory()
+        assert step == 4
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.arange(8, dtype=np.float32)
+        )
+        eng.close()
+
+    def test_async_save_snapshot_isolated_from_donation(self, tmp_path):
+        # the async path must snapshot before returning: deleting the
+        # caller's state right after the call (what buffer donation by
+        # the next train_step effectively does) must not corrupt staging
+        eng = self._engine(tmp_path)
+        state = {"w": jnp.full((1024,), 7.0)}
+        eng.save_to_memory_async(5, state)
+        state["w"].delete()
+        eng.wait_for_staging()
+        step, restored = eng.load_from_memory()
+        assert step == 5
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.full((1024,), 7.0, np.float32)
+        )
+        eng.close()
+
     def test_disk_save_commit_load(self, tmp_path):
         eng = self._engine(tmp_path)
         state = {"w": jnp.ones((16,)), "step": jnp.asarray(9)}
