@@ -188,6 +188,11 @@ class AsyncCheckpointSaver:
         self.event_queue = SharedQueue(CKPT_QUEUE_NAME, job_name)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # serializes the commit phase: the saver loop and the agent's
+        # crash/teardown persist may race, and the tracker's
+        # check-then-write below must not interleave (a stale reader
+        # could regress the tracker to an older step)
+        self._commit_lock = threading.Lock()
         self.last_persisted_step = -1
 
     # ---- lifecycle -------------------------------------------------------
@@ -254,7 +259,9 @@ class AsyncCheckpointSaver:
             time.monotonic() - t0,
         )
 
-    def save_step_checkpoint(self, step: int, path: str):
+    def save_step_checkpoint(
+        self, step: int, path: str, commit_timeout: float = None
+    ):
         """Persist the current shm state for `step` under `path/step/`."""
         with self.shm_handler.lock:
             meta, flat = self.shm_handler.load_flat_state()
@@ -268,7 +275,7 @@ class AsyncCheckpointSaver:
             step_dir = os.path.join(path, str(step))
             self.storage.makedirs(step_dir)
             self.persist_to_storage(step_dir, meta, flat)
-        self.commit_checkpoint(step, path)
+        self.commit_checkpoint(step, path, timeout=commit_timeout)
         self.last_persisted_step = step
 
     def persist_to_storage(
@@ -303,52 +310,87 @@ class AsyncCheckpointSaver:
             f"{CheckpointConstant.DONE_FILE_PREFIX}{self.node_rank}",
         )
         self.storage.write(b"1", done_file)
+
+        def _coverage() -> int:
+            return len(
+                [
+                    f
+                    for f in self.storage.listdir(step_dir) or []
+                    if f.startswith(CheckpointConstant.DONE_FILE_PREFIX)
+                ]
+            )
+
         if self.node_rank != 0:
+            # non-zero ranks normally leave the tracker to rank 0, but
+            # when they observe full coverage they promote it themselves
+            # (idempotent write of the same value). This matters on the
+            # scale-down path: if the rank-0 host is the one leaving, it
+            # persists first and is gone — the survivor must still be
+            # able to commit the jointly-covered step.
+            if _coverage() >= self.num_nodes:
+                self._promote_tracker(step, path)
             return
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            done = [
-                f
-                for f in self.storage.listdir(step_dir)
-                if f.startswith(CheckpointConstant.DONE_FILE_PREFIX)
-            ]
-            if len(done) >= self.num_nodes:
+            done = _coverage()
+            if done >= self.num_nodes:
                 break
             time.sleep(0.1)
         else:
             logger.error(
                 "commit timeout: %d/%d done files for step %d",
-                len(done),
+                done,
                 self.num_nodes,
                 step,
             )
             self.storage.commit(step, False)
             return
-        tracker = os.path.join(path, CheckpointConstant.TRACKER_FILE)
-        self.storage.write(str(step), tracker)
-        self.storage.commit(step, True)
+        self._promote_tracker(step, path)
         if self.master_client is not None:
             try:
                 self.master_client.report_ckpt_saved(step, path)
             except Exception:  # noqa: BLE001
                 logger.warning("ckpt step report failed", exc_info=True)
 
+    def _promote_tracker(self, step: int, path: str):
+        """Advance the tracker to `step` unless it already points past
+        it. The check-then-write runs under _commit_lock so concurrent
+        commits in this process (saver loop + agent persist) cannot
+        regress the tracker; cross-host, done-file coverage gates the
+        write so every committer writes a fully-covered step."""
+        with self._commit_lock:
+            if step > read_tracker_step(self.storage, path):
+                tracker = os.path.join(
+                    path, CheckpointConstant.TRACKER_FILE
+                )
+                self.storage.write(str(step), tracker)
+            self.storage.commit(step, True)
+
     # ---- crash path ------------------------------------------------------
 
-    def save_shm_to_storage(self):
-        """Called by the agent when the trainer dies: persist whatever
+    def save_shm_to_storage(self, commit_timeout: float = 15.0):
+        """Called by the agent when the trainer dies, restarts for a
+        membership change, or leaves on a scale-down: persist whatever
         step is staged in shm if newer than the last persisted one
-        (reference _save_ckpt_to_storage training.py:674)."""
+        (reference _save_ckpt_to_storage training.py:674).
+
+        Uses a SHORT commit-barrier timeout: peers may already be gone
+        (that is often why we are persisting), and a restart must not
+        stall SAVE_TIMEOUT_SECS waiting for their done-files. The
+        tracker only advances on full coverage, so a skewed partial
+        persist leaves the previous committed step authoritative."""
         meta = self.shm_handler.get_meta()
         if meta is None or meta.step < 0 or not meta.save_path:
             return
         if meta.step <= self.last_persisted_step:
             return
         logger.info(
-            "trainer died — persisting staged shm checkpoint step=%d",
+            "trainer gone — persisting staged shm checkpoint step=%d",
             meta.step,
         )
-        self.save_step_checkpoint(meta.step, meta.save_path)
+        self.save_step_checkpoint(
+            meta.step, meta.save_path, commit_timeout=commit_timeout
+        )
 
 
 def read_tracker_step(storage: CheckpointStorage, path: str) -> int:

@@ -305,7 +305,18 @@ class ElasticTrainingAgent:
         )
 
     def _restart_worker(self) -> Tuple[int, CommWorld]:
-        """Reference _restart_workers :713."""
+        """Reference _restart_workers :713.
+
+        EVERY restart flavor persists any staged shm checkpoint first —
+        the reference does the same (training.py:674,713). Membership
+        restarts (scale-down / re-rendezvous) are the path that loses
+        data otherwise: N MEMORY-only saves since the last DISK commit
+        would roll training back to the old disk step. The saver skips
+        stale steps, so this is a no-op when shm already hit storage."""
+        try:
+            self.ckpt_saver.save_shm_to_storage()
+        except Exception:  # noqa: BLE001
+            logger.exception("pre-restart checkpoint persist failed")
         self._stop_worker()
         return self._start_worker()
 
@@ -320,6 +331,15 @@ class ElasticTrainingAgent:
         finally:
             self._stop.set()
             self._stop_worker()
+            # last duty before teardown: any staged-but-uncommitted shm
+            # checkpoint goes to shared storage. This is the leave()/
+            # scale-down path's durability guarantee — this host's final
+            # MEMORY-only step may exist nowhere else (reference
+            # persists shm on every restart flavor, training.py:674,713)
+            try:
+                self.ckpt_saver.save_shm_to_storage()
+            except Exception:  # noqa: BLE001
+                logger.exception("teardown checkpoint persist failed")
             self.ckpt_saver.stop()
             self._ipc.stop()
 
@@ -391,8 +411,12 @@ class ElasticTrainingAgent:
         instead of hanging on our collectives. The TPU analogue of a
         SIGTERM-with-grace pod eviction. Order matters: stop first so
         the monitor loop cannot re-join the rendezvous after the
-        DELETED report cleaned us out of it."""
+        DELETED report cleaned us out of it. The worker stops here so
+        staging is final; run()'s teardown then persists the staged
+        shm (this host's final MEMORY-only step may exist nowhere
+        else) before the saver/IPC go down."""
         self.stop()
+        self._stop_worker()
         try:
             self.client.report_node_status(
                 NodeStatus.DELETED, "preempted"

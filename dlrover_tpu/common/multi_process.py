@@ -91,9 +91,20 @@ class LocalSocketServer:
         with self._meta_lock:
             return self._dicts.setdefault(name, {})
 
+    def _release_dead_owner(self, name: str):
+        lock = self._lock(name)
+        try:
+            lock.release()
+            self._lock_owners.pop(name, None)
+            logger.warning(
+                "released lock %r held by a disconnected client", name
+            )
+        except RuntimeError:
+            pass  # already released through the normal path
+
     # request handling -----------------------------------------------------
 
-    def _handle(self, req: dict) -> Any:
+    def _handle(self, req: dict, conn_held: set = None) -> Any:
         kind, name, op = req["kind"], req["name"], req["op"]
         if kind == "lock":
             lock = self._lock(name)
@@ -104,11 +115,15 @@ class LocalSocketServer:
                 )
                 if ok:
                     self._lock_owners[name] = req.get("owner", "")
+                    if conn_held is not None:
+                        conn_held.add(name)
                 return ok
             if op == "release":
                 try:
                     lock.release()
                     self._lock_owners.pop(name, None)
+                    if conn_held is not None:
+                        conn_held.discard(name)
                     return True
                 except RuntimeError:
                     return False
@@ -148,19 +163,29 @@ class LocalSocketServer:
         if os.path.exists(self.path):
             os.unlink(self.path)
         handle = self._handle
+        release_dead = self._release_dead_owner
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):  # one connection, many requests
-                while True:
-                    try:
-                        req = _recv_msg(self.request)
-                    except (ConnectionError, EOFError):
-                        return
-                    try:
-                        result = handle(req)
-                        _send_msg(self.request, ("ok", result))
-                    except Exception as e:  # noqa: BLE001
-                        _send_msg(self.request, ("err", str(e)))
+                held = set()  # locks acquired through THIS connection
+                try:
+                    while True:
+                        try:
+                            req = _recv_msg(self.request)
+                        except (ConnectionError, EOFError):
+                            return
+                        try:
+                            result = handle(req, held)
+                            _send_msg(self.request, ("ok", result))
+                        except Exception as e:  # noqa: BLE001
+                            _send_msg(self.request, ("err", str(e)))
+                finally:
+                    # dead-owner reaping: a client that dies (e.g. the
+                    # trainer SIGKILLed mid-save) must not leave a
+                    # named lock held forever — the agent's teardown
+                    # persist would deadlock on the shm lock
+                    for name in held:
+                        release_dead(name)
 
         self._server = socketserver.ThreadingUnixStreamServer(
             self.path, Handler

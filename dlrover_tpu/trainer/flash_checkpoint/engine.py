@@ -401,6 +401,10 @@ class CheckpointEngine:
     def save_to_memory(self, step: int, state: Any) -> float:
         """Stage state into shm; returns blocking seconds."""
         t0 = time.monotonic()
+        # an in-flight async staging must land first — otherwise the
+        # older async snapshot could overwrite this newer state in shm
+        # (and a queued DISK persist for this step would be skipped)
+        self.wait_for_staging()
         self._stage_to_shm(step, state)
         return time.monotonic() - t0
 

@@ -67,6 +67,45 @@ class TestIPCPrimitives:
         assert l2.acquire(blocking=False)
         l2.release()
 
+    def test_lock_released_when_holder_dies(self, ipc):
+        # a client killed while holding the lock (trainer SIGKILLed
+        # mid-save) must not deadlock later acquirers: the server reaps
+        # locks held by disconnected clients
+        import subprocess
+        import sys
+        import time as _time
+
+        code = (
+            "from dlrover_tpu.common.multi_process import SharedLock\n"
+            f"l = SharedLock('lk_dead', {JOB!r})\n"
+            "assert l.acquire()\n"
+            "import os, time\n"
+            "print('held', flush=True)\n"
+            "time.sleep(30)\n"
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            env={
+                **__import__("os").environ,
+                "DLROVER_TPU_FORCE_CPU": "1",
+            },
+        )
+        assert proc.stdout.readline().strip() == b"held"
+        other = SharedLock("lk_dead", JOB)
+        assert not other.acquire(blocking=False)
+        proc.kill()
+        proc.wait()
+        deadline = _time.monotonic() + 10
+        got = False
+        while _time.monotonic() < deadline:
+            if other.acquire(blocking=False):
+                got = True
+                break
+            _time.sleep(0.1)
+        assert got, "lock never reaped after holder death"
+        other.release()
+
     def test_segment_survives_creator_close(self, tmp_path):
         seg = SharedMemorySegment("seg_test_x", size=64, create=True)
         seg.buf[:4] = b"abcd"
