@@ -76,6 +76,9 @@ class Node:
         self.critical = critical
         self.is_released = False
         self.relaunchable = True
+        # set once a replacement node has been launched for this one:
+        # later failure reports for the same (retired) node are stale
+        self.relaunched = False
         self.exit_reason = ""
         self.host_addr = ""
         self.create_time: Optional[float] = None

@@ -179,7 +179,9 @@ class DiagnosisManager:
     `diagnose()` from its run loop."""
 
     def __init__(self, hang_timeout: float = 300.0):
-        self.data = DataManager()
+        # the store must retain data well past the hang window or the
+        # hang operator's evidence is GC'd before it can ever conclude
+        self.data = DataManager(ttl=max(600.0, 4 * hang_timeout))
         self._chain = InferenceChain(
             [
                 CheckTrainingHangOperator(self.data, hang_timeout),

@@ -17,6 +17,7 @@ from dlrover_tpu.common.constants import JobConstant, JobStage
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.messages import find_free_port
 from dlrover_tpu.master.servicer import MasterServicer
+from dlrover_tpu.master.status_flow import NodeEventCallback
 
 
 class JobMaster:
@@ -128,11 +129,15 @@ class LocalJobMaster(JobMaster):
 
 
 class DistributedJobMaster(JobMaster):
-    """Multi-host master: adds elastic min/max membership and (when a
-    scheduler is wired) node relaunch through it.
+    """Multi-host master: one process owning the WHOLE control plane —
+    platform watcher → node manager → relaunch policy → scaler, plus
+    the periodic auto-scaler and the diagnosis inference chain
+    (reference dist_master.py:211 runs all of these inside a single
+    JobMaster process; no manual hook assignment is needed).
 
-    The scheduler integration point: assign `servicer.node_manager
-    .on_relaunch = scaler.relaunch` after construction.
+    With `job_args=None` (agent-embedded master, tier-1 tests) no
+    platform is attached: nodes are supervised by their agents and the
+    master is the gRPC service + watch loop only.
     """
 
     def __init__(
@@ -141,6 +146,9 @@ class DistributedJobMaster(JobMaster):
         min_nodes: int = 1,
         max_nodes: int = 1,
         node_unit: int = 1,
+        job_args=None,
+        k8s_client=None,
+        auto_scale_interval: float = 300.0,
         **kw,
     ):
         super().__init__(port=port, **kw)
@@ -151,6 +159,168 @@ class DistributedJobMaster(JobMaster):
                 node_unit=node_unit,
             )
         self.servicer.sync_service.set_expected_workers(min_nodes)
+
+        from dlrover_tpu.master.diagnosis import DiagnosisManager
+
+        self.job_args = job_args
+        self.scaler = None
+        self.watcher = None
+        self.auto_scaler = None
+        self.diagnosis = DiagnosisManager(
+            hang_timeout=self.hang_timeout
+        )
+        self.servicer.diagnosis_sink = self.diagnosis.report
+        self.last_diagnosis = []
+        self._fed_ts = {}  # (data_type, node_id) -> last fed ts
+        nm = self.servicer.node_manager
+        nm.register_callback(_DiagnosisFeedCallback(self.diagnosis))
+        if job_args is not None:
+            from dlrover_tpu.master.auto_scaler import JobAutoScaler
+            from dlrover_tpu.scheduler.job import PlatformFactory
+
+            self.scaler, self.watcher = PlatformFactory.build(
+                job_args, k8s_client=k8s_client
+            )
+            nm.on_relaunch = self._relaunch_node
+            self.auto_scaler = JobAutoScaler(
+                job_args,
+                nm,
+                self.servicer.speed_monitor,
+                self.scaler,
+                interval=auto_scale_interval,
+            )
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def prepare(self):
+        super().prepare()
+        if self.job_args is not None:
+            from dlrover_tpu.master.scaler import ScalePlan
+
+            # materialize the configured node groups (initial launch)
+            self.scaler.scale(
+                ScalePlan(
+                    node_group_resources=dict(
+                        self.job_args.node_groups
+                    )
+                )
+            )
+            self.auto_scaler.start()
+
+    def stop(self):
+        if self.auto_scaler is not None:
+            self.auto_scaler.stop()
+        super().stop()
+
+    # ---- watch loop --------------------------------------------------------
+
+    def _poll_once(self) -> bool:
+        self._sync_platform_events()
+        self._feed_diagnosis()
+        # the inference chain augments the plain step-stall check: a
+        # "hung" conclusion (steps stopped while heartbeats still
+        # arrive) fails the job the same way a stalled speed monitor
+        # does, with the evidence logged for the postmortem
+        self.last_diagnosis = self.diagnosis.diagnose()
+        for inf in self.last_diagnosis:
+            if inf.key() == ("training", "is", "hung"):
+                logger.error(
+                    "diagnosis: training hung — %s", inf.evidence
+                )
+                self.servicer.job_stage = JobStage.FAILED
+                self.exit_code = 1
+                return True
+        return super()._poll_once()
+
+    def _feed_diagnosis(self):
+        """Mirror the step/heartbeat signals the servicer already
+        collects into the diagnosis data store so the inference chain
+        (CheckTrainingHangOperator) runs on live data; only CHANGED
+        timestamps are fed (the store would otherwise accumulate one
+        duplicate row per node per poll). Agent-pushed training-log /
+        chip-metrics collectors land in the same store through the
+        servicer's DiagnosisReport RPC (servicer.diagnosis_sink)."""
+        from dlrover_tpu.master.diagnosis import DiagnosisDataType
+
+        s = self.servicer
+        step, ts = s.speed_monitor.global_step_info()
+        if ts and self._fed_ts.get(("step", -1)) != ts:
+            self._fed_ts[("step", -1)] = ts
+            self.diagnosis.report(
+                DiagnosisDataType.STEP_REPORT, -1, payload=step, ts=ts
+            )
+        for node_type, node_id, ts in s.node_manager.heartbeats():
+            if self._fed_ts.get(("beat", node_id)) == ts:
+                continue
+            self._fed_ts[("beat", node_id)] = ts
+            self.diagnosis.report(
+                DiagnosisDataType.HEARTBEAT,
+                node_id,
+                payload=node_type,
+                ts=ts,
+            )
+
+    def _sync_platform_events(self):
+        """Pump watcher events into the node manager. A pod FAILED event
+        flows: watcher → update_node_status → relaunch policy →
+        _relaunch_node → scaler — all inside this process."""
+        if self.watcher is None:
+            return
+        for ev in self.watcher.poll():
+            node = ev.node
+            self.servicer.node_manager.update_node_status(
+                node.type,
+                node.id,
+                node.status,
+                node.exit_reason or "",
+            )
+
+    def _relaunch_node(self, node):
+        """Relaunch policy approved: launch a replacement through the
+        scaler and retire the failed pod so the watcher converges on the
+        replacement instead of re-reporting the old failure."""
+        nm = self.servicer.node_manager
+        replacement = node.get_relaunch_node_id(
+            nm.next_node_id(node.type)
+        )
+        # _handle_failure already counted this attempt on the failed
+        # node; the replacement carries the same count, not count+1
+        replacement.relaunch_count = node.relaunch_count
+        # nodes learned from watcher events carry no resource config —
+        # fill from the job's group spec or the replacement pod would
+        # be created with empty limits (no chips/memory)
+        res = replacement.config_resource
+        if res is None or not (res.cpu or res.memory_mb or res.chips):
+            group = self.job_args.node_groups.get(node.type)
+            if group is not None:
+                replacement.config_resource = group.node_resource
+        nm.add_node(replacement)
+        from dlrover_tpu.master.scaler import ScalePlan
+
+        self.scaler.scale(
+            ScalePlan(
+                launch_nodes=[replacement], remove_nodes=[node]
+            )
+        )
+
+
+class _DiagnosisFeedCallback(NodeEventCallback):
+    """Feeds node failures into the diagnosis data store as log-type
+    evidence so the failure-node operator sees the exit reason alongside
+    any agent-pushed log windows (reference event_callback → diagnosis
+    data flow)."""
+
+    def __init__(self, diagnosis):
+        self._diagnosis = diagnosis
+
+    def on_node_failed(self, node):
+        from dlrover_tpu.master.diagnosis import DiagnosisDataType
+
+        self._diagnosis.report(
+            DiagnosisDataType.TRAINING_LOG,
+            node.id,
+            payload=f"node exit reason: {node.exit_reason}",
+        )
 
 
 def run_master(

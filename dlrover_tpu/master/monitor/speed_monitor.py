@@ -42,6 +42,11 @@ class SpeedMonitor:
             self._global_step = max(self._global_step, step)
             self._global_step_ts = ts
 
+    def global_step_info(self):
+        """(last global step, its timestamp) — 0/0.0 before any report."""
+        with self._lock:
+            return self._global_step, self._global_step_ts
+
     def collect_worker_step(
         self, node_id: int, step: int, ts: Optional[float] = None
     ):

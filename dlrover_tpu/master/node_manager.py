@@ -114,6 +114,18 @@ class JobNodeManager:
         if node is None:
             node = Node(node_type, node_id)
             self.add_node(node)
+        if status == NodeStatus.FAILED and node.relaunched:
+            # a replacement was already launched for this node — a
+            # late-arriving failure report (heartbeat death first, pod
+            # phase later, or vice versa) must not trigger a second
+            # relaunch
+            logger.info(
+                "ignoring stale failure report for relaunched node "
+                "%s-%d",
+                node_type,
+                node_id,
+            )
+            return node
         old = node.status
         try:
             transition = resolve_transition(old, status)
@@ -145,6 +157,16 @@ class JobNodeManager:
         if status == NodeStatus.FAILED:
             self._handle_failure(node)
         return node
+
+    def heartbeats(self):
+        """Snapshot of (node_type, node_id, last_ts) for every node that
+        has ever heartbeated — diagnosis/monitoring consumers."""
+        with self._lock:
+            return [
+                (ntype, nid, ts)
+                for ntype, beats in self._heartbeats.items()
+                for nid, ts in beats.items()
+            ]
 
     def report_heartbeat(self, node_type: str, node_id: int, ts: float):
         with self._lock:
@@ -185,6 +207,10 @@ class JobNodeManager:
                 node.exit_reason,
             )
             if self.on_relaunch:
+                # platform model: a NEW node replaces this one; mark it
+                # so late duplicate failure reports are dropped (the
+                # agent model reuses the id and keeps relaunched False)
+                node.relaunched = True
                 self.on_relaunch(node)
         else:
             logger.warning(

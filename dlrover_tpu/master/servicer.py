@@ -59,6 +59,9 @@ class MasterServicer(MasterServicerBase):
         self.run_configs = {}
         self._ckpt_steps = {}  # path -> latest committed step
         self.job_stage = "init"
+        # set by the owning master: callable(data_type, node_id,
+        # payload, ts) feeding its DiagnosisManager data store
+        self.diagnosis_sink = None
         # composable node-event observers (reference event_callback.py):
         # data-shard recovery, SPMD world invalidation, sparse cluster
         # versioning and throughput bookkeeping all ride node events
@@ -308,7 +311,15 @@ class MasterServicer(MasterServicerBase):
             self._ckpt_steps[req.path] = max(prev, req.step)
             return ReplyEnvelope()
         if isinstance(req, msg.DiagnosisReport):
-            self.run_configs.setdefault("diagnosis", "")
+            # agent-pushed diagnosis data (log windows, chip metrics)
+            # lands in the owning master's DiagnosisManager store
+            if self.diagnosis_sink is not None:
+                self.diagnosis_sink(
+                    req.data_type,
+                    req.node_id,
+                    req.content,
+                    req.timestamp or None,
+                )
             return ReplyEnvelope()
         if isinstance(req, msg.PsRegister):
             if req.alive:
