@@ -1,0 +1,102 @@
+"""KvEmbedding microbench: rows/sec through each layer of the stack.
+
+VERDICT r2 #5: publish the sparse-lookup numbers — raw C++ table vs the
+jax pure_callback bridge (the device path models can actually use), on
+uniform and zipf-skewed id streams (the dedup'd callback's win case),
+plus the sparse-optimizer update path.
+
+Run: python benchmarks/kv_embedding_bench.py
+Prints one JSON line per measurement. Honors DLROVER_TPU_FORCE_CPU=1.
+Reference bar: tfplus KvVariable's reason to exist is sparse throughput
+(tfplus/kv_variable/kernels/kv_variable_ops.cc:1164).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench(fn, n_iter: int, rows_per_iter: int) -> float:
+    fn()  # warm (compile, insert)
+    t0 = time.monotonic()
+    for _ in range(n_iter):
+        fn()
+    dt = time.monotonic() - t0
+    return rows_per_iter * n_iter / dt
+
+
+def main():
+    from dlrover_tpu.utils.platform import ensure_cpu_if_forced
+
+    ensure_cpu_if_forced()
+
+    import jax
+
+    from dlrover_tpu.embedding.kv_store import KvEmbeddingTable
+    from dlrover_tpu.embedding.layer import KvEmbeddingLayer
+
+    dim = 64
+    batch = 8192
+    n_iter = 30
+    rng = np.random.default_rng(0)
+    ids_uniform = rng.integers(0, 1_000_000, size=batch)
+    # zipf-skewed stream: heavy repetition of hot ids (recsys shape)
+    ids_zipf = np.minimum(
+        rng.zipf(1.3, size=batch).astype(np.int64), 1_000_000
+    )
+    backend = jax.default_backend()
+    results = {}
+
+    # 1. raw C++ table, uniform ids
+    table = KvEmbeddingTable(dim, initializer="normal")
+    results["raw_table_uniform"] = _bench(
+        lambda: table.lookup(ids_uniform), n_iter, batch
+    )
+
+    # 2. raw C++ table, zipf ids (dup probes, no dedup at this level)
+    results["raw_table_zipf"] = _bench(
+        lambda: table.lookup(ids_zipf), n_iter, batch
+    )
+
+    # 3. layer through jit + pure_callback (device path), uniform
+    layer = KvEmbeddingLayer(dim)
+
+    @jax.jit
+    def step(ids):
+        return layer(ids).sum()
+
+    dev_uniform = jax.device_put(ids_uniform)
+    results["callback_uniform"] = _bench(
+        lambda: float(step(dev_uniform)), n_iter, batch
+    )
+
+    # 4. same, zipf (the dedup'd host callback probes ~unique ids only)
+    dev_zipf = jax.device_put(ids_zipf)
+    results["callback_zipf"] = _bench(
+        lambda: float(step(dev_zipf)), n_iter, batch
+    )
+
+    # 5. sparse optimizer update (adam) rows/sec
+    grads = rng.normal(size=(batch, dim)).astype(np.float32)
+    results["apply_adam"] = _bench(
+        lambda: layer.apply_grads(ids_uniform, grads), n_iter, batch
+    )
+
+    for name, rows_s in results.items():
+        print(
+            json.dumps(
+                {
+                    "metric": f"kv_embedding.{name}",
+                    "value": round(rows_s / 1e6, 3),
+                    "unit": "Mrows/s",
+                    "backend": backend,
+                    "batch": batch,
+                    "dim": dim,
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

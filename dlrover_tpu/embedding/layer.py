@@ -8,8 +8,18 @@ optimizer — the device program keeps static shapes (a [batch, dim]
 gather window), the dynamic table stays in host DRAM. This mirrors how
 SparseCore-style embedding APIs split dense TPU compute from host/SC
 lookups.
+
+Hot-path design:
+- lookups dedup inside the host callback (recsys batches are heavily
+  skewed: one hash probe per UNIQUE id, expanded by numpy take);
+- `prefetch(ids)` warms the next batch's rows on a background thread
+  (inserts missing rows, promotes disk-tier rows) so the jit step's
+  callback finds every row hot — the shm-dataloader analogue of the
+  reference's embedding pipelining.
 """
 
+import queue
+import threading
 from functools import partial
 from typing import Optional
 
@@ -48,19 +58,92 @@ class KvEmbeddingLayer:
         self.l1 = l1
         self.l2 = l2
         self._step = 0
+        self._prefetch_q: Optional[queue.Queue] = None
+        self._prefetch_thread: Optional[threading.Thread] = None
 
     # ---- forward (pure_callback keeps jit compatibility) ----
+    def _host_lookup(self, ids_np) -> np.ndarray:
+        """Dedup'd gather: one table probe per UNIQUE id (skewed recsys
+        batches repeat hot ids), expanded back by numpy take. Falls
+        through to the plain path when the batch has no duplicates."""
+        ids = np.asarray(ids_np)
+        flat = ids.ravel()
+        uniq, inv = np.unique(flat, return_inverse=True)
+        if uniq.size == flat.size:
+            rows = self.table.lookup(flat, insert_missing=True)
+        else:
+            rows = np.take(
+                self.table.lookup(uniq, insert_missing=True),
+                inv,
+                axis=0,
+            )
+        return rows.reshape(*ids.shape, self.dim).astype(
+            np.float32, copy=False
+        )
+
     def __call__(self, ids: jax.Array) -> jax.Array:
         out_shape = jax.ShapeDtypeStruct(
             tuple(ids.shape) + (self.dim,), jnp.float32
         )
+        return jax.pure_callback(self._host_lookup, out_shape, ids)
 
-        def host_lookup(ids_np):
-            return self.table.lookup(
-                np.asarray(ids_np), insert_missing=True
-            ).astype(np.float32)
+    # ---- prefetch window -------------------------------------------------
+    def prefetch(self, ids):
+        """Queue the NEXT batch's ids for background warm-up: missing
+        rows are inserted and disk-spilled rows promoted while the
+        current step computes, so the step's host callback never pays
+        an insert or a disk read. Bounded queue (window 2); drops the
+        oldest request under pressure — prefetch is best-effort."""
+        if self._prefetch_thread is None:
+            self._prefetch_q = queue.Queue(maxsize=2)
+            self._prefetch_thread = threading.Thread(
+                target=self._prefetch_loop,
+                name="kv-embedding-prefetch",
+                daemon=True,
+            )
+            self._prefetch_thread.start()
+        ids = np.asarray(ids, np.int64)
+        try:
+            self._prefetch_q.put_nowait(ids)
+        except queue.Full:
+            try:
+                self._prefetch_q.get_nowait()  # drop oldest
+            except queue.Empty:
+                pass
+            try:
+                self._prefetch_q.put_nowait(ids)
+            except queue.Full:
+                pass
 
-        return jax.pure_callback(host_lookup, out_shape, ids)
+    def _prefetch_loop(self):
+        while True:
+            ids = self._prefetch_q.get()
+            if ids is None:
+                return
+            try:
+                uniq = np.unique(ids.ravel())
+                # a lookup IS the warm-up: inserts missing rows and
+                # promotes disk-tier rows (the C++ table is striped and
+                # thread-safe, so this runs concurrently with training)
+                self.table.lookup(uniq, insert_missing=True)
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
+
+    def close(self):
+        """Retire the layer: stop the prefetch thread (it pins this
+        layer and its host-DRAM table otherwise — a leak for long-lived
+        processes that rebuild the model across elastic restarts)."""
+        t = self._prefetch_thread
+        if t is not None:
+            self._prefetch_q.put(None)
+            t.join(timeout=5.0)
+            self._prefetch_thread = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     def lookup_with_grad(
         self, ids: jax.Array, handle: jax.Array

@@ -15,6 +15,7 @@ step reports rather than per-rank NCCL timeouts.
 
 import abc
 import dataclasses
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -60,15 +61,19 @@ class InferenceOperator(abc.ABC):
 
 
 class DataManager:
-    """Rolling store of reported diagnosis data (per node, per type)."""
+    """Rolling store of reported diagnosis data (per node, per type).
+    Locked: gRPC handler threads (agent DiagnosisReport RPCs) and the
+    master poll loop feed it concurrently."""
 
     def __init__(self, ttl: float = 600.0):
         self._ttl = ttl
+        self._lock = threading.Lock()
         self._data: Dict[str, List[DiagnosisData]] = {}
 
     def report(self, data: DiagnosisData):
-        self._data.setdefault(data.data_type, []).append(data)
-        self._gc(data.data_type)
+        with self._lock:
+            self._data.setdefault(data.data_type, []).append(data)
+            self._gc(data.data_type)
 
     def _gc(self, data_type: str):
         cutoff = time.time() - self._ttl
@@ -76,7 +81,8 @@ class DataManager:
         self._data[data_type] = [d for d in rows if d.ts >= cutoff]
 
     def get(self, data_type: str) -> List[DiagnosisData]:
-        return list(self._data.get(data_type, []))
+        with self._lock:
+            return list(self._data.get(data_type, []))
 
 
 class CheckTrainingHangOperator(InferenceOperator):

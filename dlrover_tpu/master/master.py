@@ -250,9 +250,9 @@ class DistributedJobMaster(JobMaster):
                 DiagnosisDataType.STEP_REPORT, -1, payload=step, ts=ts
             )
         for node_type, node_id, ts in s.node_manager.heartbeats():
-            if self._fed_ts.get(("beat", node_id)) == ts:
+            if self._fed_ts.get(("beat", node_type, node_id)) == ts:
                 continue
-            self._fed_ts[("beat", node_id)] = ts
+            self._fed_ts[("beat", node_type, node_id)] = ts
             self.diagnosis.report(
                 DiagnosisDataType.HEARTBEAT,
                 node_id,

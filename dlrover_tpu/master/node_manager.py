@@ -114,18 +114,6 @@ class JobNodeManager:
         if node is None:
             node = Node(node_type, node_id)
             self.add_node(node)
-        if status == NodeStatus.FAILED and node.relaunched:
-            # a replacement was already launched for this node — a
-            # late-arriving failure report (heartbeat death first, pod
-            # phase later, or vice versa) must not trigger a second
-            # relaunch
-            logger.info(
-                "ignoring stale failure report for relaunched node "
-                "%s-%d",
-                node_type,
-                node_id,
-            )
-            return node
         old = node.status
         try:
             transition = resolve_transition(old, status)
@@ -155,7 +143,19 @@ class JobNodeManager:
         )
         self.callbacks.fire(node, status)
         if status == NodeStatus.FAILED:
-            self._handle_failure(node)
+            if node.relaunched:
+                # a replacement was already launched for this node —
+                # apply the status (it may still converge to DELETED)
+                # but never trigger a second relaunch from a
+                # late-arriving duplicate failure report
+                logger.info(
+                    "suppressing relaunch for already-relaunched node "
+                    "%s-%d",
+                    node_type,
+                    node_id,
+                )
+            else:
+                self._handle_failure(node)
         return node
 
     def heartbeats(self):
@@ -261,8 +261,12 @@ class JobNodeManager:
         )
 
     def any_unrecoverable_failure(self) -> bool:
+        # a relaunched node's terminal FAILED is history, not a live
+        # failure — its replacement carries the job now
         return any(
-            n.status == NodeStatus.FAILED and not self._should_relaunch(n)
+            n.status == NodeStatus.FAILED
+            and not n.relaunched
+            and not self._should_relaunch(n)
             for n in self.get_nodes()
         )
 

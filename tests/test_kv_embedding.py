@@ -150,6 +150,46 @@ class TestJaxBridge:
             final[0], [1.0, -1.0], atol=0.05
         )
 
+    def test_deduped_lookup_matches_plain(self):
+        # skewed batch: the host callback probes unique ids only and
+        # expands with take — results must equal per-id direct lookups,
+        # with equal ids mapping to identical rows
+        layer = KvEmbeddingLayer(dim=4, initializer="normal", seed=5)
+        ids = jnp.array([9, 3, 9, 9, 3, 7])
+
+        @jax.jit
+        def fwd(ids):
+            return layer(ids)
+
+        out = np.asarray(fwd(ids))
+        direct = layer.table.lookup(np.asarray(ids))
+        np.testing.assert_allclose(out, direct, rtol=1e-6)
+        np.testing.assert_array_equal(out[0], out[2])
+        np.testing.assert_array_equal(out[0], out[3])
+        assert not np.array_equal(out[0], out[1])
+
+    def test_prefetch_promotes_disk_rows(self, tmp_path):
+        import time
+
+        layer = KvEmbeddingLayer(dim=4, initializer="normal")
+        table = layer.table
+        assert table.set_spill_path(str(tmp_path / "spill.bin"))
+        table.lookup(np.arange(20), insert_missing=True)
+        moved = table.spill(min_freq=100)  # everything is cold
+        assert moved == 20
+        assert table.disk_size() == 20
+        # prefetch warms a window: those rows promote back to DRAM on
+        # the background thread before the next step touches them
+        layer.prefetch(np.arange(8))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if table.disk_size() <= 12:
+                break
+            time.sleep(0.05)
+        assert table.disk_size() == 12
+        layer.close()
+        assert layer._prefetch_thread is None
+
     def test_duplicate_ids_accumulate(self):
         layer = KvEmbeddingLayer(dim=2, optimizer="sgd", lr=1.0,
                                  initializer="zeros")
