@@ -123,6 +123,8 @@ def _lib() -> ctypes.CDLL:
     ]
     lib.kv_evict.restype = i64
     lib.kv_evict.argtypes = [p, u32, ctypes.c_double]
+    lib.kv_delete_keys.restype = i64
+    lib.kv_delete_keys.argtypes = [p, i64p, i64]
     lib.kv_export_count.restype = i64
     lib.kv_export_count.argtypes = [p, u64]
     lib.kv_export_rows.restype = i64
@@ -277,6 +279,16 @@ class KvEmbeddingTable:
         """Rewrite the spill file dropping dead (promoted/evicted)
         records; returns live disk rows."""
         return int(self._lib.kv_compact(self._h))
+
+    def delete(self, keys) -> int:
+        """Targeted row removal (DRAM + disk tier). The shard-move
+        handoff: rows re-owned by another host are deleted here so
+        stale copies never re-enter delta exports. Returns rows
+        removed."""
+        k = self._keys(keys)
+        return int(
+            self._lib.kv_delete_keys(self._h, _i64p(k), k.size)
+        )
 
     def evict(self, min_freq: int = 0, max_idle_sec: float = 0.0) -> int:
         """Drop cold (freq < min_freq) or idle rows; returns count."""

@@ -94,6 +94,8 @@ class KvEmbeddingLayer:
         current step computes, so the step's host callback never pays
         an insert or a disk read. Bounded queue (window 2); drops the
         oldest request under pressure — prefetch is best-effort."""
+        if getattr(self, "_prefetch_closed", False):
+            return
         if self._prefetch_thread is None:
             self._prefetch_q = queue.Queue(maxsize=2)
             self._prefetch_thread = threading.Thread(
@@ -107,9 +109,14 @@ class KvEmbeddingLayer:
             self._prefetch_q.put_nowait(ids)
         except queue.Full:
             try:
-                self._prefetch_q.get_nowait()  # drop oldest
+                dropped = self._prefetch_q.get_nowait()  # drop oldest
             except queue.Empty:
-                pass
+                dropped = False
+            if dropped is None:
+                # that was close()'s shutdown sentinel — put it back
+                # and let the layer wind down instead of racing it
+                self._prefetch_q.put(None)
+                return
             try:
                 self._prefetch_q.put_nowait(ids)
             except queue.Full:
@@ -133,6 +140,7 @@ class KvEmbeddingLayer:
         """Retire the layer: stop the prefetch thread (it pins this
         layer and its host-DRAM table otherwise — a leak for long-lived
         processes that rebuild the model across elastic restarts)."""
+        self._prefetch_closed = True
         t = self._prefetch_thread
         if t is not None:
             self._prefetch_q.put(None)

@@ -1,0 +1,516 @@
+"""Sharded KvEmbedding: the PS role made real for the TPU redesign.
+
+Reference parity: the TF PS stack serves parameters from PS processes
+(dlrover/trainer/tensorflow/executor/estimator_executor.py:52 builds
+sessions against a PS cluster; tfplus KvVariable lives inside those PS
+hosts, kv_variable_ops.cc). In the TPU redesign dense state is SPMD on
+the device mesh and needs no PS — only the DYNAMIC embedding tables
+need a serving tier. Shard hosts own key partitions of each table and
+serve lookup/update over the same 2-RPC pickle transport the control
+plane uses (common/comm.py); the master's ElasticPsService tracks the
+alive-shard set + cluster version.
+
+Failover (reference tensorflow_failover.py:33): trainers checkpoint
+delta exports (kv_store export_full since_version) every interval and
+at failover time; a membership change re-partitions ALL checkpointed
+rows — the dead shard's from its last delta, survivors' from their
+just-taken delta — onto the new topology. Zero row loss up to the
+dead shard's checkpoint interval, none at all for survivors.
+"""
+
+import glob
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.comm import (
+    Envelope,
+    MasterServicerBase,
+    MasterStub,
+    ReplyEnvelope,
+    build_master_server,
+)
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.messages import find_free_port
+from dlrover_tpu.embedding.layer import KvEmbeddingLayer
+
+
+# ---------------------------------------------------------------------------
+# wire messages (pickled inside the comm Envelope)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EmbLookup:
+    name: str
+    keys: np.ndarray = None
+    insert_missing: bool = True
+
+
+@dataclass
+class EmbRows:
+    rows: np.ndarray = None
+
+
+@dataclass
+class EmbApply:
+    name: str
+    keys: np.ndarray = None
+    grads: np.ndarray = None
+
+
+@dataclass
+class EmbExport:
+    name: str
+    since_version: int = 0
+
+
+@dataclass
+class EmbExportResult:
+    keys: np.ndarray = None
+    state: np.ndarray = None
+    freq: np.ndarray = None
+    mult: int = 1
+    version: int = 0
+
+
+@dataclass
+class EmbImport:
+    name: str
+    keys: np.ndarray = None
+    state: np.ndarray = None
+    freq: np.ndarray = None
+    mult: int = 1
+
+
+@dataclass
+class EmbDelete:
+    name: str
+    keys: np.ndarray = None
+
+
+@dataclass
+class EmbPing:
+    pass
+
+
+# ---------------------------------------------------------------------------
+# shard host
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TableSpec:
+    dim: int
+    optimizer: str = "adam"
+    lr: float = 1e-3
+    initializer: str = "zeros"
+    seed: int = 0
+
+
+class EmbeddingShardServer(MasterServicerBase):
+    """One embedding-shard host: owns its key-partition of every named
+    table and serves lookup/update/export/import RPCs."""
+
+    def __init__(
+        self,
+        tables: Dict[str, TableSpec],
+        port: int = 0,
+    ):
+        self.tables: Dict[str, KvEmbeddingLayer] = {
+            name: KvEmbeddingLayer(
+                spec.dim,
+                optimizer=spec.optimizer,
+                lr=spec.lr,
+                initializer=spec.initializer,
+                seed=spec.seed,
+            )
+            for name, spec in tables.items()
+        }
+        self.port = port or find_free_port()
+        self._server = build_master_server(self, self.port)
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self):
+        self._server.start()
+        logger.info("embedding shard serving on %d", self.port)
+
+    def stop(self):
+        self._server.stop(grace=0.5)
+        for layer in self.tables.values():
+            layer.close()
+
+    # ---- dispatch (both RPCs route the same message set) ----
+    def get(self, env: Envelope) -> ReplyEnvelope:
+        return self._dispatch(env.payload)
+
+    def report(self, env: Envelope) -> ReplyEnvelope:
+        return self._dispatch(env.payload)
+
+    def _dispatch(self, req) -> ReplyEnvelope:
+        if isinstance(req, EmbPing):
+            return ReplyEnvelope()
+        if isinstance(req, EmbLookup):
+            rows = self.tables[req.name].table.lookup(
+                req.keys, insert_missing=req.insert_missing
+            )
+            return ReplyEnvelope(payload=EmbRows(rows=rows))
+        if isinstance(req, EmbApply):
+            self.tables[req.name].apply_grads(req.keys, req.grads)
+            return ReplyEnvelope()
+        if isinstance(req, EmbExport):
+            table = self.tables[req.name].table
+            version = table.version
+            keys, state, freq, mult = table.export_full(
+                req.since_version
+            )
+            return ReplyEnvelope(
+                payload=EmbExportResult(
+                    keys=keys,
+                    state=state,
+                    freq=freq,
+                    mult=mult,
+                    version=version,
+                )
+            )
+        if isinstance(req, EmbImport):
+            self.tables[req.name].table.import_full(
+                req.keys, req.state, req.freq, req.mult
+            )
+            return ReplyEnvelope()
+        if isinstance(req, EmbDelete):
+            removed = self.tables[req.name].table.delete(req.keys)
+            return ReplyEnvelope(payload=removed)
+        return ReplyEnvelope(
+            success=False, reason=f"unknown request {type(req)}"
+        )
+
+
+def serve_shard_forever(tables: Dict[str, TableSpec], port: int = 0,
+                        master_addr: str = "", node_id: int = 0):
+    """Entrypoint for a shard-host process: serve, register with the
+    master's elastic-PS service, block until killed."""
+    server = EmbeddingShardServer(tables, port=port)
+    server.start()
+    if master_addr:
+        from dlrover_tpu.agent.master_client import MasterClient
+
+        client = MasterClient(
+            master_addr, node_id=node_id, node_type="ps"
+        )
+        client.register_node()
+        client.register_ps(server.addr)
+    print(f"SHARD_READY {server.addr}", flush=True)
+    threading.Event().wait()
+
+
+# ---------------------------------------------------------------------------
+# trainer-side sharded view
+# ---------------------------------------------------------------------------
+
+
+def _owner_hash(keys: np.ndarray) -> np.ndarray:
+    """Stable 64-bit mix (splitmix64 finalizer) — key placement must not
+    depend on python hash seeds or numpy versions."""
+    k = keys.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        k = (k ^ (k >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        k = (k ^ (k >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        k = k ^ (k >> np.uint64(31))
+    return k
+
+
+class ShardedKvEmbedding:
+    """Client view over the shard set: routes keys by stable hash over
+    the CURRENT sorted shard list; `resolve()` swaps the topology.
+
+    jit use: `__call__` is a pure_callback just like KvEmbeddingLayer —
+    the device program sees a static [batch, dim] gather."""
+
+    def __init__(self, name: str, dim: int):
+        self.name = name
+        self.dim = dim
+        self._addrs: List[str] = []
+        self._stubs: List[MasterStub] = []
+        self._prev_addrs: List[str] = []
+        # per-addr last exported version for delta checkpoints
+        self._export_versions: Dict[str, int] = {}
+        # addrs whose LAST delta export failed (set by checkpoint_delta;
+        # restore_reshard refuses to roll a still-live one of these back)
+        self._failed_exports: set = set()
+        self._ckpt_seq = 0
+
+    # ---- topology ----
+    def resolve(self, addrs: List[str]):
+        """Adopt a (new) shard topology. Sorted for a canonical order —
+        every trainer must agree on shard indices. The previous
+        topology is remembered so restore_reshard can tell moved keys
+        from stationary ones."""
+        addrs = sorted(addrs)
+        if addrs == self._addrs:
+            return
+        for stub in self._stubs:
+            stub.close()
+        self._prev_addrs = self._addrs
+        self._addrs = addrs
+        self._stubs = [MasterStub(a) for a in addrs]
+
+    @property
+    def shard_addrs(self) -> List[str]:
+        return list(self._addrs)
+
+    def _partition(self, keys: np.ndarray) -> np.ndarray:
+        return (
+            _owner_hash(keys) % np.uint64(len(self._addrs))
+        ).astype(np.int64)
+
+    # ---- data path ----
+    def lookup(self, ids, insert_missing: bool = True) -> np.ndarray:
+        ids = np.asarray(ids)
+        flat = ids.ravel().astype(np.int64)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        shard_of = self._partition(uniq)
+        rows = np.empty((uniq.size, self.dim), np.float32)
+        for si, stub in enumerate(self._stubs):
+            mask = shard_of == si
+            if not mask.any():
+                continue
+            reply = stub.get(
+                EmbLookup(
+                    name=self.name,
+                    keys=uniq[mask],
+                    insert_missing=insert_missing,
+                )
+            )
+            if not reply.success:
+                raise RuntimeError(
+                    f"shard {self._addrs[si]} lookup failed: "
+                    f"{reply.reason}"
+                )
+            rows[mask] = reply.payload.rows
+        return np.take(rows, inv, axis=0).reshape(
+            *ids.shape, self.dim
+        )
+
+    def __call__(self, ids):
+        import jax
+        import jax.numpy as jnp
+
+        out_shape = jax.ShapeDtypeStruct(
+            tuple(ids.shape) + (self.dim,), jnp.float32
+        )
+        return jax.pure_callback(
+            lambda x: self.lookup(np.asarray(x)), out_shape, ids
+        )
+
+    def apply_grads(self, ids, grads):
+        ids = np.asarray(ids).ravel().astype(np.int64)
+        grads = np.asarray(grads, np.float32).reshape(
+            ids.size, self.dim
+        )
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        shard_of = self._partition(uniq)
+        for si, stub in enumerate(self._stubs):
+            mask = shard_of == si
+            if not mask.any():
+                continue
+            reply = stub.report(
+                EmbApply(
+                    name=self.name,
+                    keys=uniq[mask],
+                    grads=acc[mask],
+                )
+            )
+            if not reply.success:
+                raise RuntimeError(
+                    f"shard {self._addrs[si]} rejected grads: "
+                    f"{reply.reason}"
+                )
+
+    # ---- checkpoint / reshard -------------------------------------------
+    def _part_glob(self, ckpt_dir: str) -> str:
+        return os.path.join(ckpt_dir, f"{self.name}_part_*.npz")
+
+    def _seed_ckpt_seq(self, ckpt_dir: str):
+        """Continue the global part sequence across client restarts —
+        restarting at 1 would os.replace() existing parts (possibly the
+        dead shard's ONLY copy) and break the later-wins ordering."""
+        if self._ckpt_seq:
+            return
+        for part in glob.glob(self._part_glob(ckpt_dir)):
+            try:
+                seq = int(
+                    os.path.basename(part).rsplit("_", 1)[1][:-4]
+                )
+            except (IndexError, ValueError):
+                continue
+            self._ckpt_seq = max(self._ckpt_seq, seq)
+
+    def checkpoint_delta(self, ckpt_dir: str):
+        """Export each reachable shard's rows CHANGED since its last
+        export into a new part file. Unreachable shards are skipped
+        with a warning (that is exactly the failover case — their last
+        parts already hold everything up to the previous interval) and
+        remembered: restore_reshard refuses to proceed if one of them
+        is still live (importing its older parts would roll it back)."""
+        os.makedirs(ckpt_dir, exist_ok=True)
+        self._seed_ckpt_seq(ckpt_dir)
+        self._failed_exports = set()
+        for addr, stub in zip(self._addrs, self._stubs):
+            since = self._export_versions.get(addr, 0)
+            try:
+                reply = stub.get(
+                    EmbExport(name=self.name, since_version=since),
+                    timeout=10.0,
+                )
+                if not reply.success:
+                    raise RuntimeError(reply.reason)
+            except Exception as e:  # noqa: BLE001 — dead shard
+                logger.warning(
+                    "delta export from shard %s failed: %s", addr, e
+                )
+                self._failed_exports.add(addr)
+                continue
+            res: EmbExportResult = reply.payload
+            if res is None or res.keys is None or not res.keys.size:
+                self._export_versions[addr] = getattr(
+                    res, "version", since
+                )
+                continue
+            self._ckpt_seq += 1
+            part = os.path.join(
+                ckpt_dir,
+                f"{self.name}_part_{self._ckpt_seq:08d}.npz",
+            )
+            # tmp suffix must not match _part_glob (a crash-leftover
+            # would poison every later restore)
+            tmp = part + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(
+                    f,
+                    keys=res.keys,
+                    state=res.state,
+                    freq=res.freq,
+                    mult=np.int64(res.mult),
+                )
+            os.replace(tmp, part)
+            self._export_versions[addr] = res.version
+
+    def restore_reshard(self, ckpt_dir: str):
+        """Merge every part file (global seq order: later wins per key)
+        and import each MOVED row to its owner under the CURRENT
+        topology. Called after resolve() swapped in the post-failover
+        shard set.
+
+        Stationary keys (same owner addr before and after) are never
+        re-imported — the live shard's rows are newer than or equal to
+        any checkpoint. Moved keys are imported to their new owner and
+        deleted from the old one when it is still alive, so stale
+        copies never re-enter later delta exports."""
+        live_failed = self._failed_exports & set(self._addrs)
+        if live_failed:
+            raise RuntimeError(
+                "cannot reshard: the last delta export failed for "
+                f"still-live shard(s) {sorted(live_failed)} — their "
+                "checkpoint state is stale; retry checkpoint_delta "
+                "first or importing would roll them back"
+            )
+        # merge parts, later (higher seq) wins per key — vectorized:
+        # concatenate in seq order, then keep the LAST occurrence
+        all_keys, all_state, all_freq = [], [], []
+        max_mult = 1
+        parts = sorted(glob.glob(self._part_glob(ckpt_dir)))
+        for part in parts:
+            with np.load(part) as z:
+                max_mult = max(max_mult, int(z["mult"]))
+        for part in parts:
+            with np.load(part) as z:
+                keys, state = z["keys"], z["state"]
+                freq, mult = z["freq"], int(z["mult"])
+            if mult < max_mult:
+                wide = np.zeros(
+                    (keys.size, max_mult * self.dim), np.float32
+                )
+                wide[:, : mult * self.dim] = state
+                state = wide
+            all_keys.append(keys.astype(np.int64))
+            all_state.append(state)
+            all_freq.append(freq.astype(np.uint32))
+        if not all_keys:
+            return 0
+        keys = np.concatenate(all_keys)
+        state = np.concatenate(all_state)
+        freq = np.concatenate(all_freq)
+        # last occurrence wins: reverse, take first unique, un-reverse
+        rev_keys = keys[::-1]
+        _, first_idx = np.unique(rev_keys, return_index=True)
+        idx = keys.size - 1 - first_idx
+        keys, state, freq = keys[idx], state[idx], freq[idx]
+
+        new_owner = self._partition(keys)
+        if self._prev_addrs:
+            prev_hash = _owner_hash(keys) % np.uint64(
+                len(self._prev_addrs)
+            )
+            prev_addr = np.array(self._prev_addrs, dtype=object)[
+                prev_hash.astype(np.int64)
+            ]
+            new_addr = np.array(self._addrs, dtype=object)[new_owner]
+            moved = prev_addr != new_addr
+        else:
+            prev_addr = np.full(keys.size, None, dtype=object)
+            moved = np.ones(keys.size, bool)
+        imported = 0
+        addr_to_stub = dict(zip(self._addrs, self._stubs))
+        for si, stub in enumerate(self._stubs):
+            mask = moved & (new_owner == si)
+            if not mask.any():
+                continue
+            reply = stub.report(
+                EmbImport(
+                    name=self.name,
+                    keys=keys[mask],
+                    state=state[mask],
+                    freq=freq[mask],
+                    mult=max_mult,
+                )
+            )
+            if not reply.success:
+                raise RuntimeError(
+                    f"reshard import to {self._addrs[si]} failed: "
+                    f"{reply.reason}"
+                )
+            imported += int(mask.sum())
+        # hand-off: moved keys leave their old (still-live) owner
+        for old in set(prev_addr[moved]) - {None}:
+            stub = addr_to_stub.get(old)
+            if stub is None:
+                continue  # old owner is gone — nothing to clean
+            mask = moved & (prev_addr == old)
+            reply = stub.report(
+                EmbDelete(name=self.name, keys=keys[mask])
+            )
+            if not reply.success:
+                logger.warning(
+                    "stale-copy cleanup on %s failed: %s",
+                    old,
+                    reply.reason,
+                )
+        # fresh topology: full re-export baseline on the next delta
+        self._export_versions = {}
+        return imported
+
+    def close(self):
+        for stub in self._stubs:
+            stub.close()
+        self._stubs = []
+        self._addrs = []

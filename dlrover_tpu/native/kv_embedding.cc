@@ -198,6 +198,30 @@ class KvTable {
   }
 
   // Remove rows with freq < min_freq OR idle longer than max_idle_sec.
+  int64_t delete_keys(const int64_t* keys, int64_t n) {
+    // targeted removal (shard-move handoff: rows re-owned by another
+    // host are deleted here so stale copies never re-enter exports)
+    int64_t removed = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      Shard& sh = shard(keys[i]);
+      std::lock_guard<std::mutex> g(sh.mu);
+      removed += static_cast<int64_t>(sh.map.erase(keys[i]));
+    }
+    {
+      std::lock_guard<std::mutex> g(disk_mu_);
+      for (int64_t i = 0; i < n; ++i) {
+        auto it = disk_index_.find(keys[i]);
+        if (it != disk_index_.end()) {
+          dead_bytes_ += sizeof(float) * it->second.state_mult * dim_;
+          disk_index_.erase(it);
+          ++removed;
+        }
+      }
+    }
+    ++version_;
+    return removed;
+  }
+
   int64_t evict(uint32_t min_freq, double max_idle_sec) {
     const double t = now_sec();
     int64_t removed = 0;
@@ -656,6 +680,10 @@ void kv_apply_adam(void* t, const int64_t* keys, int64_t n,
 
 int64_t kv_evict(void* t, uint32_t min_freq, double max_idle_sec) {
   return static_cast<KvTable*>(t)->evict(min_freq, max_idle_sec);
+}
+
+int64_t kv_delete_keys(void* t, const int64_t* keys, int64_t n) {
+  return static_cast<KvTable*>(t)->delete_keys(keys, n);
 }
 
 int64_t kv_export_count(void* t, uint64_t since_version) {

