@@ -1,0 +1,81 @@
+"""Standalone master CLI — what a master pod/actor runs directly.
+
+Reference parity: dlrover/python/master/main.py:43 (`main(args)` builds
+the master for the platform and blocks in run()). Console script:
+`dlrover-tpu-master` (pyproject.toml).
+"""
+
+import argparse
+import sys
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="dlrover-tpu-master",
+        description="standalone elastic-job master",
+    )
+    p.add_argument("--port", type=int, default=0,
+                   help="gRPC port (0 = pick a free one)")
+    p.add_argument("--job-name", default="dlrover-tpu-job")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--platform", default="local",
+                   choices=["local", "k8s", "ray"])
+    p.add_argument("--min-nodes", type=int, default=1)
+    p.add_argument("--max-nodes", type=int, default=1)
+    p.add_argument("--node-unit", type=int, default=1,
+                   help="world sizes restricted to multiples of this")
+    p.add_argument("--num-workers", type=int, default=0,
+                   help="initial worker group size (0 = min-nodes)")
+    p.add_argument("--worker-cpu", type=float, default=0)
+    p.add_argument("--worker-memory-mb", type=int, default=0)
+    p.add_argument("--worker-chips", type=int, default=0,
+                   help="TPU chips per worker")
+    p.add_argument("--poll-interval", type=float, default=2.0)
+    p.add_argument("--hang-timeout", type=float, default=1800.0)
+    return p.parse_args(argv)
+
+
+def build_master(args: argparse.Namespace):
+    from dlrover_tpu.master.master import DistributedJobMaster
+
+    job_args = None
+    if args.platform != "local":
+        from dlrover_tpu.scheduler.job import JobArgs
+
+        job_args = JobArgs.simple(
+            num_workers=args.num_workers or args.min_nodes,
+            cpu=args.worker_cpu,
+            memory_mb=args.worker_memory_mb,
+            tpu_chips=args.worker_chips,
+            job_name=args.job_name,
+            namespace=args.namespace,
+            platform=args.platform,
+        )
+    return DistributedJobMaster(
+        port=args.port,
+        min_nodes=args.min_nodes,
+        max_nodes=args.max_nodes,
+        node_unit=args.node_unit,
+        job_args=job_args,
+        poll_interval=args.poll_interval,
+        hang_timeout=args.hang_timeout,
+    )
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    master = build_master(args)
+    logger.info(
+        "starting %s master for job %s (nodes %d..%d)",
+        args.platform,
+        args.job_name,
+        args.min_nodes,
+        args.max_nodes,
+    )
+    return master.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,6 +148,7 @@ class DistributedJobMaster(JobMaster):
         node_unit: int = 1,
         job_args=None,
         k8s_client=None,
+        ray_client=None,
         auto_scale_interval: float = 300.0,
         **kw,
     ):
@@ -179,7 +180,7 @@ class DistributedJobMaster(JobMaster):
             from dlrover_tpu.scheduler.job import PlatformFactory
 
             self.scaler, self.watcher = PlatformFactory.build(
-                job_args, k8s_client=k8s_client
+                job_args, k8s_client=k8s_client, ray_client=ray_client
             )
             nm.on_relaunch = self._relaunch_node
             self.auto_scaler = JobAutoScaler(
@@ -197,6 +198,10 @@ class DistributedJobMaster(JobMaster):
         if self.job_args is not None:
             from dlrover_tpu.master.scaler import ScalePlan
 
+            # scalers that build full node entrypoints (Ray actors)
+            # need the just-bound master address for worker env
+            if hasattr(self.scaler, "master_addr"):
+                self.scaler.master_addr = self.addr
             # materialize the configured node groups (initial launch)
             self.scaler.scale(
                 ScalePlan(

@@ -6,7 +6,7 @@ scheduler factory (scheduler/factory.py) picks the platform adapter.
 """
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from dlrover_tpu.common.constants import DistributionStrategy, NodeType
 from dlrover_tpu.common.node import NodeGroupResource, NodeResource
@@ -24,6 +24,10 @@ class JobArgs:
     )
     relaunch_on_worker_failure: int = 3
     cancel_at_first_worker_fail: bool = False
+    # the training command workers run (platforms whose scaler builds
+    # the full node entrypoint itself — Ray actors; k8s carries it in
+    # the pod template instead)
+    worker_command: List[str] = dataclasses.field(default_factory=list)
 
     @classmethod
     def simple(
@@ -52,7 +56,12 @@ class PlatformFactory:
     scheduler/factory.py)."""
 
     @staticmethod
-    def build(job_args: JobArgs, node_manager=None, k8s_client=None):
+    def build(
+        job_args: JobArgs,
+        node_manager=None,
+        k8s_client=None,
+        ray_client=None,
+    ):
         if job_args.platform == "local":
             from dlrover_tpu.master.scaler import LocalScaler
             from dlrover_tpu.master.watcher import LocalWatcher
@@ -68,5 +77,16 @@ class PlatformFactory:
             client = k8s_client or K8sClient.from_env(job_args.namespace)
             scaler = PodScaler(job_args, client)
             watcher = K8sPodWatcher(job_args, client)
+            return scaler, watcher
+        if job_args.platform == "ray":
+            from dlrover_tpu.scheduler.ray import (
+                ActorScaler,
+                RayActorWatcher,
+                RayClient,
+            )
+
+            client = ray_client or RayClient.from_env()
+            scaler = ActorScaler(job_args, client)
+            watcher = RayActorWatcher(job_args, client)
             return scaler, watcher
         raise ValueError(f"unknown platform {job_args.platform}")

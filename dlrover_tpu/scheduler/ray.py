@@ -1,0 +1,332 @@
+"""Ray platform adapter: actor-based scheduling for the elastic job.
+
+Reference parity: dlrover/python/scheduler/ray.py:1 (RayClient actor
+create/delete/list over a state store) and
+dlrover/python/master/scaler/ray_scaler.py:39 (ActorScaler). The TPU
+redesign keeps the same shape as the k8s adapter — a Scaler that
+materializes ScalePlans and a NodeWatcher that diffs live state into
+node events — so the master's control plane is platform-agnostic.
+
+`ray` is not a hard dependency: the real client imports it lazily
+(RayClient.from_env) and everything is injectable, so local-mode tests
+run against FakeRayClient exactly like the k8s tests run against
+FakeK8sClient.
+"""
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from dlrover_tpu.common.constants import NodeEventType, NodeStatus
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeResource
+from dlrover_tpu.master.scaler import ScalePlan, Scaler
+from dlrover_tpu.master.watcher import NodeWatcher, WatchEvent
+
+# ray actor state -> node status (docs: ray.util.state.list_actors)
+_ACTOR_STATE_TO_STATUS = {
+    "PENDING_CREATION": NodeStatus.PENDING,
+    "ALIVE": NodeStatus.RUNNING,
+    "RESTARTING": NodeStatus.PENDING,
+    "DEAD": NodeStatus.FAILED,
+}
+
+
+def actor_name(job_name: str, node_type: str, node_id: int) -> str:
+    return f"{job_name}-{node_type}-{node_id}"
+
+
+def parse_actor_name(name: str) -> Tuple[str, int]:
+    """job-type-id -> (type, id); mirrors the reference's
+    parse_type_id_from_actor_name."""
+    parts = name.rsplit("-", 2)
+    return parts[-2], int(parts[-1])
+
+
+class RayClient:
+    """Thin actor-lifecycle client. Real mode wraps the `ray` module;
+    tests inject FakeRayClient."""
+
+    def __init__(self, ray_module):
+        self._ray = ray_module
+
+    @classmethod
+    def from_env(cls, address: str = "auto") -> "RayClient":
+        import ray  # gated: not installed in TPU-only images
+
+        if not ray.is_initialized():
+            ray.init(address=address, ignore_reinit_error=True)
+        return cls(ray)
+
+    def create_actor(
+        self,
+        name: str,
+        runtime_env: Optional[dict] = None,
+        resources: Optional[dict] = None,
+        entrypoint: Optional[List[str]] = None,
+    ):
+        """Start a detached NodeActor that supervises one elastic agent
+        (the Ray analogue of a worker pod)."""
+        opts = dict(name=name, lifetime="detached")
+        if resources:
+            num_cpus = resources.pop("cpu", None)
+            if num_cpus:
+                opts["num_cpus"] = num_cpus
+            if resources:
+                opts["resources"] = resources
+        if runtime_env:
+            opts["runtime_env"] = runtime_env
+        handle = (
+            self._ray.remote(NodeActor)
+            .options(**opts)
+            .remote(entrypoint or [])
+        )
+        handle.run.remote()
+        return handle
+
+    def kill_actor(self, name: str):
+        try:
+            handle = self._ray.get_actor(name)
+        except Exception:  # noqa: BLE001 — already gone
+            logger.warning("actor %s exited before kill", name)
+            return
+        self._ray.kill(handle, no_restart=True)
+
+    def list_actors(self, prefix: str) -> List[Tuple[str, str]]:
+        """[(actor_name, ray_state)] for actors of this job."""
+        from ray.util import state as ray_state
+
+        out = []
+        for a in ray_state.list_actors():
+            if isinstance(a, dict):
+                name, state = a.get("name") or "", a.get("state", "DEAD")
+            else:  # ray >= 2.4 returns ActorState dataclasses
+                name = getattr(a, "name", "") or ""
+                state = getattr(a, "state", "DEAD")
+            if name.startswith(prefix):
+                out.append((name, state))
+        return out
+
+
+class NodeActor:
+    """Runs one elastic agent inside a Ray actor (real-ray mode only).
+    Defined unconditionally so the class is importable without ray;
+    only RayClient.create_actor ever schedules it."""
+
+    def __init__(self, entrypoint: List[str]):
+        self._entrypoint = entrypoint
+        self._proc = None
+
+    def run(self):
+        """Blocks until the supervised process exits, then exits the
+        actor itself — the actor's DEAD state IS the failure signal the
+        watcher turns into a node event (pod-phase equivalent)."""
+        import subprocess
+
+        self._proc = subprocess.Popen(self._entrypoint)
+        code = self._proc.wait()
+        raise SystemExit(code)
+
+    def health_check(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def exit_code(self) -> Optional[int]:
+        return self._proc.poll() if self._proc else None
+
+
+class FakeRayClient:
+    """In-memory actor registry for local-mode tests (reference tests
+    mock ray the same way)."""
+
+    def __init__(self):
+        self.actors: Dict[str, str] = {}  # name -> state
+        self.created: List[str] = []
+        self.killed: List[str] = []
+        self._lock = threading.Lock()
+
+    def create_actor(self, name, runtime_env=None, resources=None,
+                     entrypoint=None):
+        with self._lock:
+            self.actors[name] = "ALIVE"
+            self.created.append(name)
+
+    def kill_actor(self, name: str):
+        with self._lock:
+            self.actors.pop(name, None)
+            self.killed.append(name)
+
+    def list_actors(self, prefix: str):
+        with self._lock:
+            return [
+                (n, s)
+                for n, s in self.actors.items()
+                if n.startswith(prefix)
+            ]
+
+    def set_actor_state(self, name: str, state: str):
+        with self._lock:
+            self.actors[name] = state
+
+
+def job_actors(client, job_name: str) -> List[Tuple[str, str, int, str]]:
+    """[(name, type, id, state)] for actors belonging EXACTLY to this
+    job — a raw prefix would also match job 'train-2' when watching
+    'train'."""
+    out = []
+    for name, state in client.list_actors(f"{job_name}-"):
+        parts = name.rsplit("-", 2)
+        if len(parts) != 3 or parts[0] != job_name:
+            continue
+        try:
+            out.append((name, parts[1], int(parts[2]), state))
+        except ValueError:
+            continue
+    return out
+
+
+class ActorScaler(Scaler):
+    """Materialize ScalePlans as Ray actors (reference ray_scaler.py:39
+    ActorScaler).
+
+    The actor supervises `dlrover-tpu-start --role worker -- <cmd>`
+    where <cmd> is job_args.worker_command; the master address is
+    injected into the actor's runtime env once the owning master knows
+    it (DistributedJobMaster.prepare sets `master_addr`)."""
+
+    def __init__(self, job_args, ray_client):
+        super().__init__(job_args)
+        self._client = ray_client
+        self.master_addr = ""
+
+    def _name(self, node: Node) -> str:
+        return actor_name(self._job_args.job_name, node.type, node.id)
+
+    def _entrypoint(self, node: Node) -> List[str]:
+        import sys
+
+        cmd = [
+            sys.executable,
+            "-m",
+            "dlrover_tpu.trainer.starter",
+            "--role",
+            "worker",
+            "--node-id",
+            str(node.id),
+        ]
+        if self.master_addr:
+            cmd += ["--master-addr", self.master_addr]
+        worker_command = getattr(
+            self._job_args, "worker_command", None
+        )
+        if worker_command:
+            cmd += ["--", *worker_command]
+        return cmd
+
+    def _runtime_env(self, node: Node) -> dict:
+        from dlrover_tpu.common.constants import NodeEnv
+
+        env_vars = {
+            NodeEnv.JOB_NAME: self._job_args.job_name,
+            NodeEnv.NODE_ID: str(node.id),
+        }
+        if self.master_addr:
+            env_vars[NodeEnv.MASTER_ADDR] = self.master_addr
+        return {"env_vars": env_vars}
+
+    @staticmethod
+    def _resources(res: Optional[NodeResource]) -> dict:
+        res = res or NodeResource()
+        resources = {}
+        if res.cpu:
+            resources["cpu"] = res.cpu
+        if res.chips:
+            resources["TPU"] = res.chips
+        return resources
+
+    def _create(self, node: Node):
+        logger.info("ActorScaler: create actor %s", self._name(node))
+        self._client.create_actor(
+            self._name(node),
+            runtime_env=self._runtime_env(node),
+            resources=self._resources(node.config_resource),
+            entrypoint=self._entrypoint(node),
+        )
+
+    def scale(self, plan: ScalePlan) -> None:
+        with self._lock:
+            for node in plan.launch_nodes:
+                self._create(node)
+            for node in plan.remove_nodes:
+                logger.info(
+                    "ActorScaler: kill actor %s", self._name(node)
+                )
+                self._client.kill_actor(self._name(node))
+            for role, group in plan.node_group_resources.items():
+                existing = [
+                    a
+                    for a in job_actors(
+                        self._client, self._job_args.job_name
+                    )
+                    if a[1] == role
+                ]
+                for i in range(len(existing), group.count):
+                    self._create(
+                        Node(
+                            node_type=role,
+                            node_id=i,
+                            rank_index=i,
+                            config_resource=group.node_resource,
+                        )
+                    )
+
+
+class RayActorWatcher(NodeWatcher):
+    """Diff the live actor set into node events, like K8sPodWatcher
+    diffs pod listings."""
+
+    def __init__(self, job_args, ray_client):
+        self._job_args = job_args
+        self._client = ray_client
+        self._last: Dict[str, Node] = {}
+
+    def _list(self) -> Dict[str, Node]:
+        current: Dict[str, Node] = {}
+        for name, node_type, node_id, state in job_actors(
+            self._client, self._job_args.job_name
+        ):
+            current[name] = Node(
+                node_type=node_type,
+                node_id=node_id,
+                rank_index=node_id,
+                name=name,
+                status=_ACTOR_STATE_TO_STATUS.get(
+                    state, NodeStatus.UNKNOWN
+                ),
+            )
+        return current
+
+    def poll(self) -> List[WatchEvent]:
+        events: List[WatchEvent] = []
+        try:
+            current = self._list()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("actor list failed: %s", e)
+            return events
+        for name, node in current.items():
+            prev = self._last.get(name)
+            if prev is None:
+                events.append(WatchEvent(NodeEventType.ADDED, node))
+            elif prev.status != node.status:
+                events.append(
+                    WatchEvent(NodeEventType.MODIFIED, node)
+                )
+        for name, node in self._last.items():
+            if name not in current:
+                node.status = NodeStatus.DELETED
+                events.append(
+                    WatchEvent(NodeEventType.DELETED, node)
+                )
+        self._last = current
+        return events
+
+    def list(self) -> List[Node]:
+        return list(self._list().values())

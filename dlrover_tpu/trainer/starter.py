@@ -1,0 +1,112 @@
+"""Platform starter: the single entrypoint a pod / Ray actor runs.
+
+Reference parity: dlrover/trainer/platform/starter.py:94 (`main` picks
+the execution role from args/env and launches it). A k8s pod template
+or a Ray NodeActor points its command here:
+
+    dlrover-tpu-start --role master -- --min-nodes 2 --max-nodes 4
+    dlrover-tpu-start --role worker -- python train.py --steps 1000
+
+Worker mode wraps the user command in the elastic agent (rendezvous,
+supervision, flash-checkpoint plumbing), reading the master address and
+node identity from the NodeEnv environment the scheduler injected.
+Master mode defers to the standalone master CLI.
+"""
+
+import argparse
+import os
+import sys
+from typing import List
+
+from dlrover_tpu.common.constants import NodeEnv
+from dlrover_tpu.common.log import default_logger as logger
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="dlrover-tpu-start",
+        description="platform entrypoint (pod / ray actor)",
+    )
+    p.add_argument(
+        "--role",
+        default=os.environ.get("DLROVER_TPU_ROLE", "worker"),
+        choices=["master", "worker"],
+    )
+    p.add_argument("--master-addr", default="",
+                   help="override NodeEnv.MASTER_ADDR")
+    p.add_argument("--node-id", type=int, default=-1,
+                   help="override NodeEnv.NODE_ID")
+    p.add_argument("--min-nodes", type=int, default=1)
+    p.add_argument("--max-nodes", type=int, default=0,
+                   help="0 = same as --min-nodes")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--network-check", action="store_true")
+    p.add_argument(
+        "cmd",
+        nargs=argparse.REMAINDER,
+        help="worker role: the training command (after --)",
+    )
+    return p.parse_args(argv)
+
+
+def _strip_separator(cmd: List[str]) -> List[str]:
+    return cmd[1:] if cmd and cmd[0] == "--" else cmd
+
+
+def _worker_cmd(cmd: List[str]) -> List[str]:
+    cmd = _strip_separator(cmd)
+    if not cmd:
+        raise SystemExit(
+            "worker role needs a training command: "
+            "dlrover-tpu-start --role worker -- python train.py"
+        )
+    return cmd
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.role == "master":
+        from dlrover_tpu.master.main import main as master_main
+
+        # remaining args (after --) pass through to the master CLI;
+        # a bare separator means defaults, not an error
+        return master_main(_strip_separator(args.cmd))
+
+    master_addr = args.master_addr or os.environ.get(
+        NodeEnv.MASTER_ADDR, ""
+    )
+    if not master_addr:
+        raise SystemExit(
+            f"worker role needs the master address "
+            f"(--master-addr or ${NodeEnv.MASTER_ADDR})"
+        )
+    node_id = (
+        args.node_id
+        if args.node_id >= 0
+        else int(os.environ.get(NodeEnv.NODE_ID, "0"))
+    )
+    from dlrover_tpu.agent.training import (
+        ElasticLaunchConfig,
+        launch_agent,
+    )
+
+    config = ElasticLaunchConfig(
+        min_nodes=args.min_nodes,
+        max_nodes=args.max_nodes or args.min_nodes,
+        max_restarts=args.max_restarts,
+        network_check=args.network_check,
+        job_name=os.environ.get(NodeEnv.JOB_NAME, "default"),
+    )
+    logger.info(
+        "starter: worker node %d -> master %s", node_id, master_addr
+    )
+    return launch_agent(
+        config,
+        _worker_cmd(args.cmd),
+        master_addr,
+        node_id=node_id,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

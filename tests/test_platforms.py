@@ -1,0 +1,158 @@
+"""Platform edges (VERDICT r2 #7): Ray actor scheduler/scaler in local
+mode, the standalone master CLI, and the pod/actor starter entrypoint.
+
+Reference: dlrover/python/scheduler/ray.py:1, master/scaler/
+ray_scaler.py:39, master/main.py:43, trainer/platform/starter.py:94.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from dlrover_tpu.common.constants import NodeEnv, NodeStatus, NodeType
+from dlrover_tpu.master.main import build_master, parse_args
+from dlrover_tpu.master.master import DistributedJobMaster
+from dlrover_tpu.scheduler.job import JobArgs, PlatformFactory
+from dlrover_tpu.scheduler.ray import (
+    ActorScaler,
+    FakeRayClient,
+    RayActorWatcher,
+    actor_name,
+    parse_actor_name,
+)
+
+
+class TestRayAdapter:
+    def _job_args(self):
+        return JobArgs.simple(
+            num_workers=2, cpu=2, tpu_chips=4, platform="ray"
+        )
+
+    def test_actor_names_roundtrip(self):
+        name = actor_name("jobx", "worker", 3)
+        assert parse_actor_name(name) == ("worker", 3)
+
+    def test_factory_builds_ray_pair(self):
+        fake = FakeRayClient()
+        scaler, watcher = PlatformFactory.build(
+            self._job_args(), ray_client=fake
+        )
+        assert isinstance(scaler, ActorScaler)
+        assert isinstance(watcher, RayActorWatcher)
+
+    def test_dead_actor_flows_to_relaunch(self):
+        """The same control-plane flow as the k8s test, on Ray: a DEAD
+        actor event -> node manager -> relaunch policy -> ActorScaler
+        creates a replacement actor and retires the dead one."""
+        job_args = self._job_args()
+        fake = FakeRayClient()
+        master = DistributedJobMaster(
+            min_nodes=1,
+            max_nodes=2,
+            job_args=job_args,
+            ray_client=fake,
+            poll_interval=0.1,
+        )
+        master.prepare()
+        nm = master.servicer.node_manager
+        try:
+            assert len(fake.actors) == 2  # initial group materialized
+            master._poll_once()
+            assert len(nm.get_nodes(NodeType.WORKER)) == 2
+
+            name0 = actor_name(job_args.job_name, "worker", 0)
+            fake.set_actor_state(name0, "DEAD")
+            master._poll_once()
+            # replacement actor exists; dead one was killed
+            name2 = actor_name(job_args.job_name, "worker", 2)
+            assert name2 in fake.actors
+            assert name0 in fake.killed
+            assert nm.get_node("worker", 2) is not None
+        finally:
+            master.stop()
+
+
+class TestMasterCLI:
+    def test_parse_and_build(self):
+        args = parse_args(
+            [
+                "--platform", "ray", "--min-nodes", "2",
+                "--max-nodes", "4", "--num-workers", "3",
+                "--worker-chips", "8", "--job-name", "cli-job",
+            ]
+        )
+        assert args.platform == "ray"
+        # building a ray master without ray installed must fail loudly,
+        # not silently fall back — prove the platform wiring is reached
+        import pytest
+
+        with pytest.raises((ImportError, ModuleNotFoundError)):
+            build_master(args)
+
+    def test_local_master_runs_and_stops(self):
+        args = parse_args(["--min-nodes", "1", "--poll-interval",
+                           "0.1"])
+        master = build_master(args)
+        codes = []
+        t = threading.Thread(
+            target=lambda: codes.append(master.run()), daemon=True
+        )
+        t.start()
+        time.sleep(0.5)
+        assert t.is_alive()  # serving + polling
+        master.stop()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert codes == [0]
+
+
+class TestStarter:
+    def test_worker_role_runs_command_to_completion(self, tmp_path):
+        """A pod-shaped launch: env carries master addr + node id, the
+        starter wraps the command in the elastic agent, trains to
+        completion, exits 0."""
+        master = DistributedJobMaster(
+            min_nodes=1, max_nodes=1, poll_interval=0.2
+        )
+        rdzv = master.servicer.rdzv_managers["training"]
+        rdzv.update_rdzv_params(min_nodes=1, max_nodes=1)
+        master.start()
+        try:
+            pkg_root = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )
+            env = {
+                **os.environ,
+                "DLROVER_TPU_FORCE_CPU": "1",
+                NodeEnv.MASTER_ADDR: master.addr,
+                NodeEnv.NODE_ID: "0",
+                "PYTHONPATH": pkg_root
+                + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+            }
+            out = tmp_path / "out.txt"
+            proc = subprocess.run(
+                [
+                    sys.executable, "-m",
+                    "dlrover_tpu.trainer.starter",
+                    "--role", "worker", "--max-restarts", "1",
+                    "--",
+                    sys.executable, "-c",
+                    f"open({str(out)!r}, 'w').write('trained')",
+                ],
+                env=env,
+                timeout=120,
+                capture_output=True,
+                text=True,
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            assert out.read_text() == "trained"
+            nm = master.servicer.node_manager
+            assert (
+                nm.get_node("worker", 0).status
+                == NodeStatus.SUCCEEDED
+            )
+        finally:
+            master.stop()
