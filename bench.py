@@ -88,6 +88,12 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
         out["save_block_ms"] = round(min(blocks) * 1e3, 1)
         # staging (D2H + shm write) is byte-proportional: extrapolate
         out["stage_full_est_s"] = round(stage_probe * scale, 2)
+        # the D2H link bound for context: under the axon tunnel this is
+        # ~0.03-0.04 GB/s (network-tunneled PCIe); on directly-attached
+        # v5e it is ~16 GB/s, scaling stage/restore times accordingly
+        out["d2h_gbps"] = round(
+            (probe_bytes / 1e9) / max(stage_probe, 1e-9), 3
+        )
         # restore stall: shm read + H2D onto the training shardings
         from dlrover_tpu.trainer.flash_checkpoint.engine import (
             restore_to_shardings,

@@ -1,0 +1,237 @@
+"""Agent-side diagnosis data collectors.
+
+Reference parity: dlrover/python/elastic_agent/datacollector/
+data_collector.py:38 (`DataCollector` ABC + CollectorType),
+log_collector.py (`LogCollector`), metrics_collector.py
+(`MetricsCollector`). The reference collectors are skeletal; here they
+actually collect: the log collector tails the worker's log file and
+ships a window when fatal markers appear (or periodically as context),
+and the chip collector samples TPU HBM via
+`jax.local_devices()[i].memory_stats()` with a psutil host fallback.
+Both push through the DiagnosisReport RPC into the master's
+DiagnosisManager store (master/diagnosis.py DataManager), feeding
+CheckFailureNodeOperator / the hang chain.
+"""
+
+import abc
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from dlrover_tpu.common.constants import ConfigPath, DiagnosisDataType
+from dlrover_tpu.common.log import default_logger as logger
+
+# markers worth shipping immediately (superset of the master's
+# CheckFailureNodeOperator.FATAL_MARKERS so evidence always arrives
+# before the conclusion is drawn)
+LOG_ALERT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Hbm OOM",
+    "device halted",
+    "XLA compilation failure",
+    "Fatal Python error",
+    "core dumped",
+    "Traceback (most recent call last)",
+    "DEADLINE_EXCEEDED",
+)
+
+
+class DataCollector(abc.ABC):
+    """One collectable diagnosis signal (reference data_collector.py:38)."""
+
+    data_type: str = ""
+
+    @abc.abstractmethod
+    def collect_data(self) -> Optional[str]:
+        """Return a payload to ship, or None for nothing new."""
+
+    def to_collect_data(self) -> bool:
+        return True
+
+
+class TrainingLogCollector(DataCollector):
+    """Tail the worker's newest log file; ship the trailing window when
+    a fatal marker shows up (and at most once per `context_interval`
+    otherwise, so the master has recent context for postmortems)."""
+
+    data_type = DiagnosisDataType.TRAINING_LOG
+
+    def __init__(
+        self,
+        log_dir: Optional[str],
+        window_lines: int = 100,
+        context_interval: float = 300.0,
+    ):
+        self.log_dir = log_dir
+        self.window_lines = window_lines
+        self.context_interval = context_interval
+        self._offset = 0
+        self._current_path: Optional[str] = None
+        self._window: List[str] = []
+        # lines seen since the last ship — periodic context ships send
+        # only these, so an old fatal marker in the rolling window is
+        # not re-reported forever (the master stores every shipped
+        # window and would re-conclude "node failed" on each)
+        self._since_ship: List[str] = []
+        self._last_context_ship = 0.0
+
+    def to_collect_data(self) -> bool:
+        return bool(self.log_dir) and os.path.isdir(self.log_dir)
+
+    def _newest_log(self) -> Optional[str]:
+        try:
+            paths = [
+                os.path.join(self.log_dir, f)
+                for f in os.listdir(self.log_dir)
+                if f.endswith(".log")
+            ]
+            return max(paths, key=os.path.getmtime) if paths else None
+        except OSError:
+            return None
+
+    def _read_new_lines(self) -> List[str]:
+        path = self._newest_log()
+        if path is None:
+            return []
+        if path != self._current_path:
+            # worker restarted into a new log file — start from its head
+            self._current_path = path
+            self._offset = 0
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+                self._offset = f.tell()
+        except OSError:
+            return []
+        if not chunk:
+            return []
+        return chunk.decode("utf-8", errors="replace").splitlines()
+
+    def collect_data(self) -> Optional[str]:
+        new_lines = self._read_new_lines()
+        if new_lines:
+            self._window.extend(new_lines)
+            self._window = self._window[-self.window_lines:]
+            self._since_ship.extend(new_lines)
+            self._since_ship = self._since_ship[-self.window_lines:]
+        alert = any(
+            m in line for line in new_lines for m in LOG_ALERT_MARKERS
+        )
+        now = time.time()
+        if alert:
+            # fatal signal: ship the FULL window so the master gets the
+            # lead-up context, not just the crash line
+            self._last_context_ship = now
+            self._since_ship = []
+            return "\n".join(self._window)
+        if (
+            self._since_ship
+            and now - self._last_context_ship > self.context_interval
+        ):
+            # periodic context: only what's new since the last ship —
+            # never re-reports an already-shipped fatal marker
+            self._last_context_ship = now
+            out = "\n".join(self._since_ship)
+            self._since_ship = []
+            return out
+        return None
+
+
+class ChipMetricsCollector(DataCollector):
+    """Relay worker-published accelerator stats. libtpu is EXCLUSIVE to
+    the worker process — the agent must never `import jax` or it steals
+    the TPU from the training process it supervises. So the worker
+    publishes `{ts, chips:[{device, platform, hbm_*}]}` to a JSON file
+    (trainer-side `publish_chip_metrics`, the same pattern as the step
+    relay in agent/monitor.py) and the agent ships only fresh snapshots,
+    falling back to host RSS when the worker publishes nothing."""
+
+    data_type = DiagnosisDataType.CHIP_METRICS
+
+    def __init__(self, metrics_path: Optional[str] = None):
+        self.metrics_path = metrics_path or os.environ.get(
+            ConfigPath.ENV_CHIP_METRICS,
+            ConfigPath.DEFAULT_CHIP_METRICS,
+        )
+        self._last_ts = 0.0
+
+    def collect_data(self) -> Optional[str]:
+        try:
+            with open(self.metrics_path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            payload = None
+        if payload is not None:
+            ts = float(payload.get("ts", 0.0))
+            if ts <= self._last_ts:
+                return None  # stale snapshot — already shipped
+            self._last_ts = ts
+            return json.dumps(payload)
+        # no worker-published stats: degrade to host memory pressure
+        try:
+            import psutil
+
+            return json.dumps(
+                {
+                    "ts": time.time(),
+                    "chips": [],
+                    "host_rss_mb": int(
+                        psutil.Process().memory_info().rss
+                        / (1024 * 1024)
+                    ),
+                }
+            )
+        except Exception:  # noqa: BLE001
+            return None
+
+
+class CollectorRunner:
+    """Background thread driving a set of collectors and pushing their
+    payloads to the master (reference: the agent-side diagnosis agent
+    elastic_agent/diagnosis/diagnosis_agent.py periodic loop)."""
+
+    def __init__(
+        self,
+        client,
+        collectors: List[DataCollector],
+        interval: float = 30.0,
+    ):
+        self.client = client
+        self.collectors = collectors
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="diagnosis-collectors", daemon=True
+        )
+        self._thread.start()
+
+    def collect_once(self):
+        for col in self.collectors:
+            try:
+                if not col.to_collect_data():
+                    continue
+                payload = col.collect_data()
+                if payload:
+                    self.client.report_diagnosis(
+                        col.data_type, payload
+                    )
+            except Exception:  # noqa: BLE001 — diagnosis must not kill the agent
+                logger.debug(
+                    "collector %s failed", col.data_type, exc_info=True
+                )
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.collect_once()
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)

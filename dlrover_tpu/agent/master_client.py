@@ -292,6 +292,21 @@ class MasterClient:
             msg.CkptSaveStep(node_id=self.node_id, step=step, path=path)
         )
 
+    def report_diagnosis(
+        self, data_type: str, content: str, ts: float = 0.0
+    ):
+        """Push collector payloads (log windows, chip metrics) into the
+        master's diagnosis store (reference datacollector → master
+        DiagnosisManager flow)."""
+        return self.report(
+            msg.DiagnosisReport(
+                node_id=self.node_id,
+                data_type=data_type,
+                content=content,
+                timestamp=ts,
+            )
+        )
+
     def get_ckpt_latest_step(self, path: str) -> int:
         resp = self.get(msg.CkptLatestStepQuery(path=path))
         return resp.step if resp else -1

@@ -120,3 +120,39 @@ def write_step_metrics(step: int, path: Optional[str] = None, **extra):
     with open(tmp, "w") as f:
         json.dump(payload, f)
     os.replace(tmp, path)
+
+
+def publish_chip_metrics(path: Optional[str] = None):
+    """Trainer-side helper: publish local accelerator memory stats for
+    the agent's ChipMetricsCollector. Runs in the WORKER process (the
+    sole owner of the TPU runtime); the agent only relays the file —
+    see agent/collector.py ChipMetricsCollector."""
+    import jax
+
+    path = path or os.environ.get(
+        ConfigPath.ENV_CHIP_METRICS, ConfigPath.DEFAULT_CHIP_METRICS
+    )
+    chips = []
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — cpu backend has none
+            stats = {}
+        in_use = int(stats.get("bytes_in_use", 0))
+        limit = int(stats.get("bytes_limit", 0))
+        chips.append(
+            {
+                "device": str(dev.id),
+                "platform": dev.platform,
+                "hbm_bytes_in_use": in_use,
+                "hbm_bytes_limit": limit,
+                "hbm_utilization": (
+                    round(in_use / limit, 4) if limit else 0.0
+                ),
+            }
+        )
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"ts": time.time(), "chips": chips}, f)
+    os.replace(tmp, path)

@@ -178,6 +178,22 @@ class ElasticTrainingAgent:
             master_client=self.client,
         )
         self.ckpt_saver.start()
+        # diagnosis data collectors: log windows + chip metrics pushed
+        # into the master's inference chain (reference
+        # elastic_agent/datacollector/*)
+        from dlrover_tpu.agent.collector import (
+            ChipMetricsCollector,
+            CollectorRunner,
+            TrainingLogCollector,
+        )
+
+        self.collectors = CollectorRunner(
+            self.client,
+            [
+                TrainingLogCollector(config.log_dir),
+                ChipMetricsCollector(),
+            ],
+        )
 
     # ---- heartbeats ------------------------------------------------------
 
@@ -324,12 +340,14 @@ class ElasticTrainingAgent:
 
     def run(self) -> int:
         self._start_heartbeats()
+        self.collectors.start()
         self.client.register_node()
         rnd, world = self._start_worker()
         try:
             return self._monitor_loop()
         finally:
             self._stop.set()
+            self.collectors.stop()
             self._stop_worker()
             # last duty before teardown: any staged-but-uncommitted shm
             # checkpoint goes to shared storage. This is the leave()/

@@ -49,6 +49,17 @@ class NodeExitReason:
     UNKNOWN_ERROR = "unknown_error"
 
 
+class DiagnosisDataType:
+    """Payload kinds flowing agent → master over DiagnosisReport
+    (reference common/constants.py DiagnosisDataType + datacollector
+    CollectorType)."""
+
+    TRAINING_LOG = "training_log"
+    CHIP_METRICS = "chip_metrics"
+    STEP_REPORT = "step_report"
+    HEARTBEAT = "heartbeat"
+
+
 class JobStage:
     INIT = "init"
     RUNNING = "running"
@@ -111,6 +122,10 @@ class ConfigPath:
     DEFAULT_PARAL_CONFIG = "/tmp/dlrover_tpu/paral_config.json"
     ENV_RUNTIME_METRICS = "DLROVER_TPU_RUNTIME_METRICS_PATH"
     DEFAULT_RUNTIME_METRICS = "/tmp/dlrover_tpu/runtime_metrics.json"
+    # worker-published accelerator stats (the agent process must never
+    # initialize JAX itself — libtpu is exclusive to the worker)
+    ENV_CHIP_METRICS = "DLROVER_TPU_CHIP_METRICS_PATH"
+    DEFAULT_CHIP_METRICS = "/tmp/dlrover_tpu/chip_metrics.json"
 
 
 class NodeEnv:

@@ -19,14 +19,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from dlrover_tpu.common.constants import DiagnosisDataType  # noqa: F401
 from dlrover_tpu.common.log import default_logger as logger
-
-
-class DiagnosisDataType:
-    TRAINING_LOG = "training_log"
-    CHIP_METRICS = "chip_metrics"
-    STEP_REPORT = "step_report"
-    HEARTBEAT = "heartbeat"
 
 
 @dataclasses.dataclass
@@ -159,6 +153,55 @@ class CheckFailureNodeOperator(InferenceOperator):
         return out or [Inference("node", "is", "healthy")]
 
 
+class CheckChipMetricsOperator(InferenceOperator):
+    """HBM pressure check over agent-pushed chip metrics: sustained
+    utilization above the threshold predicts the next allocation OOM —
+    the resource optimizer can act before the job dies (reference
+    metrics_collector → diagnosis flow; TPU spin: HBM headroom instead
+    of CUDA memory)."""
+
+    def __init__(self, data_mgr: DataManager, threshold: float = 0.95):
+        self._data = data_mgr
+        self._threshold = threshold
+
+    def is_compatible(self, problem: Inference) -> bool:
+        return problem.key() == ("chip", "is", "pressured?")
+
+    def infer(self, problem: Inference) -> List[Inference]:
+        import json as _json
+
+        out = []
+        latest: Dict[int, DiagnosisData] = {}
+        for d in self._data.get(DiagnosisDataType.CHIP_METRICS):
+            cur = latest.get(d.node_id)
+            if cur is None or d.ts > cur.ts:
+                latest[d.node_id] = d
+        for node_id, d in sorted(latest.items()):
+            try:
+                payload = _json.loads(str(d.payload or "{}"))
+            except ValueError:
+                continue
+            hot = [
+                c
+                for c in payload.get("chips", [])
+                if c.get("hbm_utilization", 0.0) >= self._threshold
+            ]
+            if hot:
+                out.append(
+                    Inference(
+                        "chip", "is", "pressured",
+                        evidence={
+                            "node_id": node_id,
+                            "chips": [c.get("device") for c in hot],
+                            "max_utilization": max(
+                                c["hbm_utilization"] for c in hot
+                            ),
+                        },
+                    )
+                )
+        return out or [Inference("chip", "is", "healthy")]
+
+
 class InferenceChain:
     """Walk operators compatible with the problem; first non-empty
     conclusion wins (reference inference_chain.py:38)."""
@@ -192,6 +235,7 @@ class DiagnosisManager:
             [
                 CheckTrainingHangOperator(self.data, hang_timeout),
                 CheckFailureNodeOperator(self.data),
+                CheckChipMetricsOperator(self.data),
             ]
         )
 
@@ -213,6 +257,7 @@ class DiagnosisManager:
         for problem in (
             Inference("training", "is", "hung?"),
             Inference("node", "is", "failed?"),
+            Inference("chip", "is", "pressured?"),
         ):
             results.extend(self._chain.infer(problem))
         return results

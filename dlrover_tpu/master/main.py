@@ -34,7 +34,24 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="TPU chips per worker")
     p.add_argument("--poll-interval", type=float, default=2.0)
     p.add_argument("--hang-timeout", type=float, default=1800.0)
-    return p.parse_args(argv)
+    p.add_argument(
+        "worker_command",
+        nargs=argparse.REMAINDER,
+        metavar="-- CMD [ARG...]",
+        help="training command the platform starter runs on each "
+        "worker (everything after --); required for platforms that "
+        "build full worker entrypoints (ray)",
+    )
+    args = p.parse_args(argv)
+    # argparse.REMAINDER keeps the leading "--" separator
+    if args.worker_command and args.worker_command[0] == "--":
+        args.worker_command = args.worker_command[1:]
+    if args.platform == "ray" and not args.worker_command:
+        p.error(
+            "--platform ray needs a worker command: "
+            "dlrover-tpu-master --platform ray ... -- python train.py"
+        )
+    return args
 
 
 def build_master(args: argparse.Namespace):
@@ -52,6 +69,7 @@ def build_master(args: argparse.Namespace):
             job_name=args.job_name,
             namespace=args.namespace,
             platform=args.platform,
+            worker_command=list(args.worker_command or []),
         )
     return DistributedJobMaster(
         port=args.port,

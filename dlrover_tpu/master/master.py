@@ -283,11 +283,17 @@ class DistributedJobMaster(JobMaster):
     def _relaunch_node(self, node):
         """Relaunch policy approved: launch a replacement through the
         scaler and retire the failed pod so the watcher converges on the
-        replacement instead of re-reporting the old failure."""
+        replacement instead of re-reporting the old failure. Routed
+        through the role pool so role policy fires — a PS relaunch flips
+        the PS cluster version (PSPool), and sparse trainers re-resolve
+        their shard maps (reference per-role managers, node/ps.py:82)."""
+        from dlrover_tpu.common.constants import NodeType
+
         nm = self.servicer.node_manager
-        replacement = node.get_relaunch_node_id(
-            nm.next_node_id(node.type)
-        )
+        pool_plan = nm.pool(node.type).relaunch_node(node)
+        replacement = pool_plan.launch_nodes[0]
+        if node.type == NodeType.PS:
+            self.servicer.elastic_ps.inc_global_version()
         # _handle_failure already counted this attempt on the failed
         # node; the replacement carries the same count, not count+1
         replacement.relaunch_count = node.relaunch_count

@@ -1,0 +1,11 @@
+"""Per-role node pools (reference dlrover/python/master/node/)."""
+
+from dlrover_tpu.master.node.pools import (  # noqa: F401
+    ALIVE_STATUS,
+    ChiefPool,
+    EvaluatorPool,
+    PSPool,
+    RolePool,
+    WorkerPool,
+    make_pool,
+)

@@ -1,0 +1,562 @@
+"""Per-role node pools: role-specific lifecycle policy on top of the
+shared node table.
+
+Reference parity: dlrover/python/master/node/training_node.py:153
+(`TrainingNodeManager` — relaunch_node :189, reduce_pending_node_resource
+:212), node/worker.py:32,66,102 (`ChiefManager`, `EvaluatorManager`,
+`WorkerManager` — adjust_worker :127, migrate_workers :227,
+remove_not_joined_rdzv_workers :253), node/ps.py:31
+(`ParameterServerManager` — training-cluster versioning :199, PS
+migration :317, pre-drop of migrated/dropped PS :246).
+
+Design: `JobNodeManager` keeps the single source of truth
+(`Dict[role, Dict[id, Node]]`); each pool is a live *view* over one
+role's dict plus the role-specific policy state (PS cluster version,
+migration bookkeeping). Pools emit `ScalePlan`s; the scaler executes
+them. Nothing here touches jax — this is pure control plane.
+"""
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.scaler import ScalePlan
+
+ALIVE_STATUS = (NodeStatus.INITIAL, NodeStatus.PENDING, NodeStatus.RUNNING)
+
+# pending longer than this ⇒ the cluster can't fit the ask; shrink it
+# (reference seconds_to_wait_pending_pod, global_context.py)
+PENDING_TIMEOUT_SECS = 900.0
+# divide cpu/memory by this when a pending node times out
+PENDING_CUT_FACTOR = 2.0
+MIN_CPU = 1.0
+MIN_MEMORY_MB = 1024
+
+
+class RolePool:
+    """Base pool: shared bookkeeping + relaunch/remove/pending policy
+    for one role (reference TrainingNodeManager)."""
+
+    role: str = NodeType.WORKER
+
+    def __init__(
+        self,
+        nodes: Dict[int, Node],
+        group: Optional[NodeGroupResource] = None,
+        next_id_fn: Optional[Callable[[], int]] = None,
+        max_relaunch: int = 3,
+    ):
+        self._nodes = nodes
+        self._group = group or NodeGroupResource()
+        self._lock = threading.Lock()
+        self._max_relaunch = max_relaunch
+        self._next_id_fn = next_id_fn or self._fallback_next_id
+
+    def _fallback_next_id(self) -> int:
+        return (max(self._nodes) + 1) if self._nodes else 0
+
+    # ---- views -----------------------------------------------------------
+
+    def nodes(self) -> List[Node]:
+        return list(self._nodes.values())
+
+    def alive_nodes(self) -> List[Node]:
+        return [
+            n
+            for n in self._nodes.values()
+            if n.status in ALIVE_STATUS and not n.is_released
+        ]
+
+    def running_nodes(self) -> List[Node]:
+        return [
+            n
+            for n in self._nodes.values()
+            if n.status == NodeStatus.RUNNING and not n.is_released
+        ]
+
+    def is_all_running(self) -> bool:
+        return len(self.running_nodes()) >= self._group.count > 0
+
+    def all_exited(self) -> bool:
+        alive = self.alive_nodes()
+        return not alive and bool(self._nodes)
+
+    # ---- mutation --------------------------------------------------------
+
+    def add_node(self, node: Node):
+        self._nodes[node.id] = node
+
+    def remove_node(self, node_id: int) -> ScalePlan:
+        plan = ScalePlan()
+        node = self._nodes.get(node_id)
+        if node is None:
+            return plan
+        with self._lock:
+            node.is_released = True
+            node.relaunchable = False
+        plan.remove_nodes.append(node)
+        return plan
+
+    def relaunch_node(self, node: Node, remove_exited: bool = False) -> ScalePlan:
+        """Retire `node`, allocate a fresh id carrying the same rank —
+        the replacement takes the failed host's place in the mesh
+        (reference training_node.py:189)."""
+        plan = ScalePlan()
+        with self._lock:
+            node.is_released = True
+            node.relaunched = True
+            new_id = self._next_id_fn()
+            replacement = node.get_relaunch_node_id(new_id)
+            self._nodes[new_id] = replacement
+        logger.info(
+            "pool[%s]: relaunch %s -> %s-%d", self.role, node.name,
+            self.role, new_id,
+        )
+        plan.launch_nodes.append(replacement)
+        if remove_exited and NodeStatus.is_terminal(node.status):
+            plan.remove_nodes.append(node)
+        return plan
+
+    def pending_timeout_nodes(self, timeout: float = PENDING_TIMEOUT_SECS) -> List[Node]:
+        now = time.time()
+        out = []
+        for node in list(self._nodes.values()):
+            if node.is_released or node.status != NodeStatus.PENDING:
+                continue
+            created = node.create_time or 0.0
+            if created and now - created > timeout:
+                out.append(node)
+        return out
+
+    def reduce_pending_node_resource(
+        self, timeout: float = PENDING_TIMEOUT_SECS
+    ) -> ScalePlan:
+        """A node pending past the timeout is asking for more than the
+        cluster has: halve its cpu/memory ask and relaunch it
+        (reference training_node.py:212 + :108). Chip counts are never
+        cut — a TPU host either has its chips or is useless."""
+        plan = ScalePlan()
+        for node in self.pending_timeout_nodes(timeout):
+            res = node.config_resource
+            new_cpu = max(res.cpu / PENDING_CUT_FACTOR, MIN_CPU)
+            new_mem = int(max(res.memory_mb / PENDING_CUT_FACTOR, MIN_MEMORY_MB))
+            if new_cpu == res.cpu and new_mem == res.memory_mb:
+                continue
+            res.cpu, res.memory_mb = new_cpu, new_mem
+            logger.info(
+                "pool[%s]: pending timeout on %s -> cut to cpu=%s mem=%sMi",
+                self.role, node.name, new_cpu, new_mem,
+            )
+            node.relaunchable = False
+            node_plan = self.relaunch_node(node)
+            plan.remove_nodes.append(node)
+            plan.merge(node_plan)
+        return plan
+
+
+class ChiefPool(RolePool):
+    """Reference worker.py:32 ChiefManager."""
+
+    role = NodeType.CHIEF
+
+    def is_chief_running(self) -> bool:
+        return any(
+            n.status == NodeStatus.RUNNING for n in self._nodes.values()
+        )
+
+
+class EvaluatorPool(RolePool):
+    """Reference worker.py:66 EvaluatorManager."""
+
+    role = NodeType.EVALUATOR
+
+    def is_evaluator_running(self) -> bool:
+        return any(
+            n.status == NodeStatus.RUNNING for n in self._nodes.values()
+        )
+
+
+class WorkerPool(RolePool):
+    """Reference worker.py:102 WorkerManager."""
+
+    role = NodeType.WORKER
+
+    def adjust(self, target: NodeGroupResource) -> ScalePlan:
+        """Scale the alive worker set to `target.count`
+        (reference adjust_worker :127)."""
+        plan = ScalePlan()
+        alive = self.alive_nodes()
+        with self._lock:
+            self._group = target
+        if target.count > len(alive):
+            plan.merge(self._scale_up(target.count - len(alive), target))
+        elif target.count < len(alive):
+            plan.merge(self._scale_down(len(alive) - target.count, alive))
+        return plan
+
+    def _scale_up(self, up_num: int, target: NodeGroupResource) -> ScalePlan:
+        plan = ScalePlan()
+        ranks = {n.rank_index for n in self.alive_nodes()}
+        next_rank = 0
+        for _ in range(up_num):
+            while next_rank in ranks:
+                next_rank += 1
+            ranks.add(next_rank)
+            node = Node(
+                self.role,
+                self._next_id_fn(),
+                rank_index=next_rank,
+                config_resource=NodeResource(
+                    cpu=target.node_resource.cpu,
+                    memory_mb=target.node_resource.memory_mb,
+                    chips=target.node_resource.chips,
+                    chip_type=target.node_resource.chip_type,
+                ),
+                max_relaunch_count=self._max_relaunch,
+            )
+            self.add_node(node)
+            plan.launch_nodes.append(node)
+        return plan
+
+    def _scale_down(self, down_num: int, alive: List[Node]) -> ScalePlan:
+        # drop highest ranks first so the surviving mesh is contiguous
+        plan = ScalePlan()
+        for node in sorted(alive, key=lambda n: -n.rank_index):
+            if down_num <= 0:
+                break
+            if node.critical:
+                continue
+            node.relaunchable = False
+            node.is_released = True
+            down_num -= 1
+            plan.remove_nodes.append(node)
+        return plan
+
+    def delete_exited_workers(self) -> ScalePlan:
+        plan = ScalePlan()
+        with self._lock:
+            for node in self._nodes.values():
+                if NodeStatus.is_terminal(node.status) and not node.is_released:
+                    node.is_released = True
+                    plan.remove_nodes.append(node)
+        return plan
+
+    def delete_running_workers(self) -> ScalePlan:
+        """After the chief completes, the remaining workers are idle
+        (reference delete_running_workers :204)."""
+        plan = ScalePlan()
+        for node in self._nodes.values():
+            if not node.critical and node.status in ALIVE_STATUS:
+                node.relaunchable = False
+                node.is_released = True
+                plan.remove_nodes.append(node)
+        return plan
+
+    def migrate_workers(self, workers: Dict[str, NodeResource]) -> ScalePlan:
+        """Replace named workers with new nodes of the given resource,
+        keeping their ranks (reference migrate_workers :227)."""
+        plan = ScalePlan()
+        for name, resource in workers.items():
+            old = next(
+                (n for n in self._nodes.values() if n.name == name), None
+            )
+            if old is None or old.critical:
+                continue
+            old.relaunchable = False
+            old.is_released = True
+            new_node = Node(
+                self.role,
+                self._next_id_fn(),
+                rank_index=old.rank_index,
+                config_resource=resource,
+                max_relaunch_count=self._max_relaunch,
+            )
+            self.add_node(new_node)
+            plan.launch_nodes.append(new_node)
+            plan.remove_nodes.append(old)
+        return plan
+
+    def remove_not_joined_rdzv_workers(self, ranks: List[int]) -> ScalePlan:
+        """Workers that never joined rendezvous are stragglers off the
+        mesh — remove, don't relaunch (reference :253)."""
+        plan = ScalePlan()
+        for node in list(self._nodes.values()):
+            if node.rank_index in ranks and not node.is_released:
+                node.relaunchable = False
+                plan.merge(self.remove_node(node.id))
+        return plan
+
+    def has_exited_worker(self) -> bool:
+        return any(
+            n.status == NodeStatus.SUCCEEDED
+            or (n.status == NodeStatus.FAILED and not n.relaunchable)
+            for n in self._nodes.values()
+        )
+
+    def wait_worker_restart(self) -> bool:
+        """Any failed worker that still has relaunch budget?"""
+        return any(
+            n.status == NodeStatus.FAILED
+            and n.relaunch_count < n.max_relaunch_count
+            for n in self._nodes.values()
+        )
+
+
+class PSPool(RolePool):
+    """Parameter-server pool with cluster versioning
+    (reference ps.py:31 ParameterServerManager).
+
+    The *training cluster* is the PS set the workers are currently
+    connected to. Any membership change (scale, migration, relaunch)
+    flips `_cluster_changed`; the next cluster only becomes current when
+    every incoming PS is RUNNING and `process_after_cluster_ready()`
+    commits it — at which point pre-dropped PS (migrated-away or
+    scaled-down) are actually removed. This is what lets the sparse
+    executor (trainer/sparse_executor.py) hand off rows without a gap.
+    """
+
+    role = NodeType.PS
+
+    def __init__(self, nodes, group=None, next_id_fn=None, max_relaunch=3):
+        super().__init__(nodes, group, next_id_fn, max_relaunch)
+        self._cluster_changed = True
+        self._pre_dropped: List[Node] = []
+        # old_id -> replacement node for in-flight migrations
+        self._migrated: Dict[int, Node] = {}
+        self._training_cluster: List[Node] = []
+
+    # ---- cluster views ---------------------------------------------------
+
+    def _alive_non_migrated(self) -> List[Node]:
+        """RUNNING PS, minus pre-dropped, minus old halves of migrations,
+        ordered by rank."""
+        self._pre_drop_migrated()
+        out = {}
+        for node in self.running_nodes():
+            if node in self._pre_dropped:
+                continue
+            out[node.rank_index] = node
+        return [out[r] for r in sorted(out)]
+
+    def training_cluster(self) -> List[Node]:
+        if not self._training_cluster:
+            self._training_cluster = [
+                n for n in self.alive_nodes() if n.id not in
+                {m.id for m in self._migrated.values()}
+            ]
+        return [
+            n
+            for n in self._training_cluster
+            if not n.is_released and n.status != NodeStatus.FAILED
+        ]
+
+    def next_training_cluster(self) -> List[Node]:
+        """The PS set workers should (re)connect to. Sticks to the old
+        set until every incoming PS is RUNNING (reference
+        get_next_training_ps_cluster :199)."""
+        if not self._cluster_changed:
+            return self._training_cluster or self.training_cluster()
+        for node in self._nodes.values():
+            if (
+                not node.is_released
+                and node.status in (NodeStatus.INITIAL, NodeStatus.PENDING)
+            ):
+                # still waiting on a launching PS — keep the old set
+                return self.training_cluster()
+        return self._alive_non_migrated()
+
+    def cluster_ready(self) -> bool:
+        return not self._cluster_changed
+
+    def ps_addrs(self) -> List[str]:
+        """Address list of the (about-to-be-)current PS cluster, rank
+        order (reference get_ps_addrs :282)."""
+        addrs = {}
+        replacement_ids = {m.id for m in self._migrated.values()}
+        # old rank holders first, so a live migration replacement
+        # overwrites its rank slot
+        ordered = sorted(
+            (n for n in self._nodes.values()
+             if not n.is_released and n.status in ALIVE_STATUS),
+            key=lambda n: n.id in replacement_ids,
+        )
+        for node in ordered:
+            addrs[node.rank_index] = node.host_addr or node.name
+        return [addrs[r] for r in sorted(addrs)]
+
+    # ---- membership changes ---------------------------------------------
+
+    def relaunch_node(self, node: Node, remove_exited: bool = False) -> ScalePlan:
+        plan = super().relaunch_node(node, remove_exited)
+        with self._lock:
+            self._cluster_changed = True
+            if node in self._training_cluster:
+                i = self._training_cluster.index(node)
+                self._training_cluster[i] = plan.launch_nodes[0]
+        return plan
+
+    def adjust(self, target: NodeGroupResource) -> ScalePlan:
+        """Scale the PS set (reference adjust_ps :108). Scale-down is
+        deferred: victims go to `_pre_dropped` and are removed only after
+        the new cluster is committed."""
+        plan = ScalePlan()
+        alive = self.training_cluster()
+        with self._lock:
+            self._group = target
+        if target.count > len(alive):
+            plan.merge(self._scale_up(target.count - len(alive), target))
+        elif target.count < len(alive):
+            self._scale_down(len(alive) - target.count)
+        return plan
+
+    def _scale_up(self, up_num: int, target: NodeGroupResource) -> ScalePlan:
+        plan = ScalePlan()
+        with self._lock:
+            self._cluster_changed = True
+            ranks = {n.rank_index for n in self.alive_nodes()}
+            next_rank = 0
+            for _ in range(up_num):
+                while next_rank in ranks:
+                    next_rank += 1
+                ranks.add(next_rank)
+                node = Node(
+                    self.role,
+                    self._next_id_fn(),
+                    rank_index=next_rank,
+                    config_resource=NodeResource(
+                        cpu=target.node_resource.cpu,
+                        memory_mb=target.node_resource.memory_mb,
+                    ),
+                    max_relaunch_count=self._max_relaunch,
+                    critical=True,
+                )
+                self.add_node(node)
+                plan.launch_nodes.append(node)
+        return plan
+
+    def _scale_down(self, down_num: int):
+        with self._lock:
+            self._cluster_changed = True
+            self._pre_dropped = []
+            running = self.running_nodes()
+            for node in sorted(running, key=lambda n: -n.rank_index):
+                if down_num <= 0:
+                    break
+                self._pre_dropped.append(node)
+                down_num -= 1
+        logger.info(
+            "pool[ps]: pre-drop %s", [n.name for n in self._pre_dropped]
+        )
+
+    def migrate(self, ps_nodes: Dict[str, NodeResource]) -> ScalePlan:
+        """Launch resized replacements for named PS; the old ones keep
+        serving until the new cluster commits (reference
+        migrate_parameter_servers :317)."""
+        plan = ScalePlan()
+        for name, resource in ps_nodes.items():
+            old = next(
+                (n for n in self._nodes.values() if n.name == name), None
+            )
+            if old is None or old.id in self._migrated:
+                continue
+            with self._lock:
+                self._cluster_changed = True
+                new_node = Node(
+                    self.role,
+                    self._next_id_fn(),
+                    rank_index=old.rank_index,
+                    config_resource=resource,
+                    max_relaunch_count=self._max_relaunch,
+                    critical=True,
+                )
+                self.add_node(new_node)
+                self._migrated[old.id] = new_node
+            logger.info(
+                "pool[ps]: migrating %s -> %s", old.name, new_node.name
+            )
+            plan.launch_nodes.append(new_node)
+        return plan
+
+    def _pre_drop_migrated(self):
+        """Once every migration replacement is RUNNING, the old halves
+        can be pre-dropped (reference _pre_drop_migrated_ps :246)."""
+        for new in self._migrated.values():
+            if new.status != NodeStatus.RUNNING:
+                return
+        for old_id in list(self._migrated):
+            old = self._nodes.get(old_id)
+            if (
+                old is not None
+                and old.status == NodeStatus.RUNNING
+                and old not in self._pre_dropped
+            ):
+                self._pre_dropped.append(old)
+
+    def process_after_cluster_ready(self) -> ScalePlan:
+        """Commit the next cluster: workers have reconnected, so the
+        pre-dropped PS can really be removed (reference
+        process_after_ps_cluster_ready :171)."""
+        self._cluster_changed = False
+        self._training_cluster = self._alive_non_migrated()
+        plan = ScalePlan()
+        with self._lock:
+            while self._pre_dropped:
+                node = self._pre_dropped.pop()
+                node.critical = False
+                node.relaunchable = False
+                node.is_released = True
+                self._migrated.pop(node.id, None)
+                plan.remove_nodes.append(node)
+        return plan
+
+    def has_ps_failure(self, timeout: float = PENDING_TIMEOUT_SECS) -> bool:
+        """A PS stuck un-RUNNING past the timeout (reference
+        has_ps_failure :224)."""
+        now = time.time()
+        for node in self._nodes.values():
+            if node.is_released or node.status == NodeStatus.RUNNING:
+                continue
+            created = node.create_time or 0.0
+            if created and now - created > timeout:
+                return True
+        return False
+
+    def delete_running_ps(self) -> ScalePlan:
+        """Tear down all PS after worker-0 completes (reference
+        delete_running_ps :297)."""
+        plan = ScalePlan()
+        for node in self._nodes.values():
+            if node.status in ALIVE_STATUS and not node.is_released:
+                node.critical = False
+                node.relaunchable = False
+                node.is_released = True
+                node.update_status(NodeStatus.DELETED)
+                plan.remove_nodes.append(node)
+        return plan
+
+    def exist_migrated_ps(self) -> bool:
+        return bool(self._migrated)
+
+
+POOL_CLASSES = {
+    NodeType.WORKER: WorkerPool,
+    NodeType.CHIEF: ChiefPool,
+    NodeType.EVALUATOR: EvaluatorPool,
+    NodeType.PS: PSPool,
+}
+
+
+def make_pool(
+    role: str,
+    nodes: Dict[int, Node],
+    group: Optional[NodeGroupResource] = None,
+    next_id_fn: Optional[Callable[[], int]] = None,
+    max_relaunch: int = 3,
+) -> RolePool:
+    cls = POOL_CLASSES.get(role, RolePool)
+    pool = cls(nodes, group, next_id_fn, max_relaunch)
+    pool.role = role
+    return pool

@@ -59,6 +59,9 @@ class JobNodeManager:
         self._next_ids: Dict[str, int] = {}
         # composable observers (reference NodeEventCallback framework)
         self.callbacks = CallbackRegistry()
+        # per-role policy pools, created lazily over the shared dicts
+        # (reference per-role managers, node/ps.py:31, node/worker.py:32)
+        self._pools: Dict[str, object] = {}
 
     def register_callback(self, cb: NodeEventCallback):
         self.callbacks.register(cb)
@@ -94,6 +97,24 @@ class JobNodeManager:
             nxt = self._next_ids.get(node_type, 0)
             self._next_ids[node_type] = nxt + 1
             return nxt
+
+    def pool(self, node_type: str):
+        """Role-specific policy pool (WorkerPool/PSPool/ChiefPool/
+        EvaluatorPool) sharing this manager's node table. Mutations made
+        through the pool (scale, migrate, relaunch) are visible here and
+        vice versa."""
+        if node_type not in self._pools:
+            from dlrover_tpu.master.node.pools import make_pool
+
+            with self._lock:
+                nodes = self._nodes.setdefault(node_type, {})
+            self._pools[node_type] = make_pool(
+                node_type,
+                nodes,
+                next_id_fn=lambda: self.next_node_id(node_type),
+                max_relaunch=self.max_relaunch_count,
+            )
+        return self._pools[node_type]
 
     # ---- status / heartbeat ingestion -----------------------------------
 

@@ -169,5 +169,12 @@ def moe_mlp(
     out = constrain(
         out, mesh, "expert", ("data", "fsdp"), None, None
     )
-    y = jnp.einsum("bsec,ebcd->bsd", combine.astype(compute_dtype), out)
+    # combine in f32 (GShard formulation): the contraction over the
+    # expert axis is where GSPMD inserts the cross-expert all-reduce, so
+    # f32 here buys reduction accuracy at negligible cost — and keeps the
+    # collective f32, which XLA CPU's AllReducePromotion pass requires
+    # (it crashes cloning bf16 all-reduces inside scan bodies)
+    y = jnp.einsum(
+        "bsec,ebcd->bsd", combine, out.astype(jnp.float32)
+    )
     return y.astype(x.dtype), metrics

@@ -1,0 +1,30 @@
+"""Operator entrypoint: `python -m dlrover_tpu.operator`.
+
+Reference parity: dlrover/go/operator/main.go — construct the client
+from in-cluster credentials, run the reconcile loop until terminated.
+"""
+
+import signal
+import threading
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.operator.controller import OperatorController
+from dlrover_tpu.scheduler.kubernetes import K8sClient
+
+
+def main():
+    client = K8sClient.from_env()
+    controller = OperatorController(client)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    logger.info(
+        "operator running (namespace=%s)", client.namespace
+    )
+    controller.start()
+    stop.wait()
+    controller.stop()
+
+
+if __name__ == "__main__":
+    main()

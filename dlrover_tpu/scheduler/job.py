@@ -86,7 +86,15 @@ class PlatformFactory:
             )
 
             client = ray_client or RayClient.from_env()
-            scaler = ActorScaler(job_args, client)
-            watcher = RayActorWatcher(job_args, client)
+            # shared deliberate-kill set: ray lists killed detached
+            # actors as DEAD; the watcher reports the ones the scaler
+            # released as DELETED instead of FAILED
+            released = set()
+            scaler = ActorScaler(
+                job_args, client, released_names=released
+            )
+            watcher = RayActorWatcher(
+                job_args, client, released_names=released
+            )
             return scaler, watcher
         raise ValueError(f"unknown platform {job_args.platform}")

@@ -35,13 +35,6 @@ def actor_name(job_name: str, node_type: str, node_id: int) -> str:
     return f"{job_name}-{node_type}-{node_id}"
 
 
-def parse_actor_name(name: str) -> Tuple[str, int]:
-    """job-type-id -> (type, id); mirrors the reference's
-    parse_type_id_from_actor_name."""
-    parts = name.rsplit("-", 2)
-    return parts[-2], int(parts[-1])
-
-
 class RayClient:
     """Thin actor-lifecycle client. Real mode wraps the `ray` module;
     tests inject FakeRayClient."""
@@ -150,8 +143,12 @@ class FakeRayClient:
             self.created.append(name)
 
     def kill_actor(self, name: str):
+        # real ray keeps killed detached actors listed as DEAD (the
+        # watcher must map them to DELETED via released_names) — the
+        # fake mirrors that instead of hiding the entry
         with self._lock:
-            self.actors.pop(name, None)
+            if name in self.actors:
+                self.actors[name] = "DEAD"
             self.killed.append(name)
 
     def list_actors(self, prefix: str):
@@ -192,10 +189,16 @@ class ActorScaler(Scaler):
     injected into the actor's runtime env once the owning master knows
     it (DistributedJobMaster.prepare sets `master_addr`)."""
 
-    def __init__(self, job_args, ray_client):
+    def __init__(self, job_args, ray_client, released_names=None):
         super().__init__(job_args)
         self._client = ray_client
         self.master_addr = ""
+        # names we killed on purpose (scale-down / relaunch removals).
+        # Real ray keeps killed detached actors listed as DEAD; the
+        # watcher consults this set to report them DELETED, not FAILED
+        self.released_names = (
+            released_names if released_names is not None else set()
+        )
 
     def _name(self, node: Node) -> str:
         return actor_name(self._job_args.job_name, node.type, node.id)
@@ -256,10 +259,10 @@ class ActorScaler(Scaler):
             for node in plan.launch_nodes:
                 self._create(node)
             for node in plan.remove_nodes:
-                logger.info(
-                    "ActorScaler: kill actor %s", self._name(node)
-                )
-                self._client.kill_actor(self._name(node))
+                name = self._name(node)
+                logger.info("ActorScaler: kill actor %s", name)
+                self.released_names.add(name)
+                self._client.kill_actor(name)
             for role, group in plan.node_group_resources.items():
                 existing = [
                     a
@@ -268,39 +271,58 @@ class ActorScaler(Scaler):
                     )
                     if a[1] == role
                 ]
-                for i in range(len(existing), group.count):
+                # real ray keeps DEAD actors listed: only live ones
+                # count toward the target, and new ids come from the
+                # max over ALL of them (the id space has holes after
+                # relaunches — reusing a live name raises in ray)
+                alive = [a for a in existing if a[3] != "DEAD"]
+                next_id = max(
+                    (a[2] for a in existing), default=-1
+                ) + 1
+                next_rank = len(alive)
+                for _ in range(len(alive), group.count):
                     self._create(
                         Node(
                             node_type=role,
-                            node_id=i,
-                            rank_index=i,
+                            node_id=next_id,
+                            rank_index=next_rank,
                             config_resource=group.node_resource,
                         )
                     )
+                    next_id += 1
+                    next_rank += 1
 
 
 class RayActorWatcher(NodeWatcher):
     """Diff the live actor set into node events, like K8sPodWatcher
     diffs pod listings."""
 
-    def __init__(self, job_args, ray_client):
+    def __init__(self, job_args, ray_client, released_names=None):
         self._job_args = job_args
         self._client = ray_client
         self._last: Dict[str, Node] = {}
+        # shared with the ActorScaler: actors killed on purpose show up
+        # DEAD in ray listings and must surface as DELETED, not FAILED
+        self.released_names = (
+            released_names if released_names is not None else set()
+        )
 
     def _list(self) -> Dict[str, Node]:
         current: Dict[str, Node] = {}
         for name, node_type, node_id, state in job_actors(
             self._client, self._job_args.job_name
         ):
+            status = _ACTOR_STATE_TO_STATUS.get(
+                state, NodeStatus.UNKNOWN
+            )
+            if state == "DEAD" and name in self.released_names:
+                status = NodeStatus.DELETED
             current[name] = Node(
                 node_type=node_type,
                 node_id=node_id,
                 rank_index=node_id,
                 name=name,
-                status=_ACTOR_STATE_TO_STATUS.get(
-                    state, NodeStatus.UNKNOWN
-                ),
+                status=status,
             )
         return current
 

@@ -24,7 +24,10 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 import jax
 import numpy as np
 
-from dlrover_tpu.agent.monitor import write_step_metrics
+from dlrover_tpu.agent.monitor import (
+    publish_chip_metrics,
+    write_step_metrics,
+)
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.trainer.flash_checkpoint.engine import (
     Checkpointer,
@@ -234,6 +237,13 @@ class Trainer:
                                     "loss": logs.get("loss", 0.0)
                                 }
                             )
+                            # accelerator stats for the agent's chip
+                            # collector (the agent itself never
+                            # initializes JAX — libtpu is ours)
+                            try:
+                                publish_chip_metrics()
+                            except Exception:  # noqa: BLE001
+                                pass
                         if self._mc is not None:
                             try:
                                 self._mc.report_global_step(

@@ -18,9 +18,16 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
+    _flags = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# the CPU backend's AllReducePromotion pass crashes cloning bf16
+# all-reduces inside scan bodies (pipeline/MoE programs); TPU has no
+# such pass. Disabling it lets tests compile + run the SAME bf16
+# programs that run on hardware.
+if "xla_disable_hlo_passes" not in _flags:
+    _flags = (_flags + " --xla_disable_hlo_passes=all-reduce-promotion").strip()
+os.environ["XLA_FLAGS"] = _flags
 # Subprocesses spawned by tests (agent workers) read this to apply the
 # same override — see dlrover_tpu.utils.platform.ensure_cpu_if_forced().
 os.environ["DLROVER_TPU_FORCE_CPU"] = "1"

@@ -1,0 +1,184 @@
+"""In-process fake Kubernetes API server (HTTP).
+
+Speaks the subset of the k8s REST API that dlrover_tpu's K8sClient
+(scheduler/kubernetes.py) uses — pods/services CRUD, namespaced custom
+resources CRUD + /status subresource — with k8s-shaped status codes
+(404 NotFound, 409 AlreadyExists). Unlike FakeK8sClient (which bypasses
+the transport), this exercises the REAL client: URL construction, JSON
+serialization, params, and error mapping, the way the Go operator's
+envtest runs controllers against a real apiserver binary.
+"""
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Tuple
+from urllib.parse import parse_qs, urlparse
+
+POD_RE = re.compile(r"^/api/v1/namespaces/(?P<ns>[^/]+)/pods(?:/(?P<name>[^/]+))?$")
+SVC_RE = re.compile(r"^/api/v1/namespaces/(?P<ns>[^/]+)/services(?:/(?P<name>[^/]+))?$")
+CR_RE = re.compile(
+    r"^/apis/(?P<group>[^/]+)/(?P<version>[^/]+)/namespaces/(?P<ns>[^/]+)/"
+    r"(?P<plural>[^/]+)(?:/(?P<name>[^/]+))?(?P<status>/status)?$"
+)
+
+
+def _match_selector(labels: Dict[str, str], selector: str) -> bool:
+    for clause in (selector or "").split(","):
+        if not clause:
+            continue
+        if "=" in clause:
+            k, v = clause.split("=", 1)
+            if labels.get(k) != v:
+                return False
+    return True
+
+
+class FakeApiServerState:
+    """Namespaced object store shared by handler threads."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # (kind_key, ns, name) -> manifest;  kind_key is "pods",
+        # "services", or "group/version/plural"
+        self.objects: Dict[Tuple[str, str, str], Dict] = {}
+        self.requests = []  # (method, path) audit log
+
+    # test helpers ---------------------------------------------------------
+
+    def set_pod_phase(self, ns: str, name: str, phase: str, reason=""):
+        with self.lock:
+            pod = self.objects[("pods", ns, name)]
+            pod.setdefault("status", {})["phase"] = phase
+            if reason:
+                pod["status"]["reason"] = reason
+
+    def pods(self, ns: str = "default"):
+        with self.lock:
+            return [
+                m for (k, n, _), m in self.objects.items()
+                if k == "pods" and n == ns
+            ]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: FakeApiServerState = None  # set by serve()
+
+    def log_message(self, *args):  # silence
+        pass
+
+    def _send(self, code: int, body: Dict):
+        payload = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _body(self) -> Dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def _route(self):
+        parsed = urlparse(self.path)
+        path, query = parsed.path, parse_qs(parsed.query)
+        m = POD_RE.match(path)
+        if m:
+            return "pods", m.group("ns"), m.group("name"), False, query
+        m = SVC_RE.match(path)
+        if m:
+            return "services", m.group("ns"), m.group("name"), False, query
+        m = CR_RE.match(path)
+        if m:
+            key = f"{m.group('group')}/{m.group('version')}/{m.group('plural')}"
+            return key, m.group("ns"), m.group("name"), bool(
+                m.group("status")
+            ), query
+        return None, None, None, False, query
+
+    def _handle(self):
+        self.state.requests.append((self.command, self.path))
+        kind, ns, name, is_status, query = self._route()
+        if kind is None:
+            return self._send(404, {"kind": "Status", "code": 404,
+                                    "reason": "NotFound"})
+        st = self.state
+        if self.command == "GET":
+            with st.lock:
+                if name:
+                    obj = st.objects.get((kind, ns, name))
+                    if obj is None:
+                        return self._send(
+                            404, {"kind": "Status", "code": 404,
+                                  "reason": "NotFound"})
+                    return self._send(200, obj)
+                sel = (query.get("labelSelector") or [""])[0]
+                items = [
+                    m for (k, n, _), m in st.objects.items()
+                    if k == kind and n == ns and _match_selector(
+                        m.get("metadata", {}).get("labels", {}), sel
+                    )
+                ]
+            return self._send(200, {"kind": "List", "items": items})
+        if self.command == "POST":
+            manifest = self._body()
+            obj_name = manifest.get("metadata", {}).get("name", "")
+            if not obj_name:
+                return self._send(
+                    422, {"kind": "Status", "code": 422,
+                          "reason": "Invalid", "message": "name required"})
+            with st.lock:
+                if (kind, ns, obj_name) in st.objects:
+                    return self._send(
+                        409, {"kind": "Status", "code": 409,
+                              "reason": "AlreadyExists"})
+                manifest.setdefault("metadata", {})["namespace"] = ns
+                st.objects[(kind, ns, obj_name)] = manifest
+            return self._send(201, manifest)
+        if self.command == "DELETE":
+            with st.lock:
+                obj = st.objects.pop((kind, ns, name), None)
+            if obj is None:
+                return self._send(404, {"kind": "Status", "code": 404,
+                                        "reason": "NotFound"})
+            return self._send(200, {"kind": "Status", "status": "Success"})
+        if self.command == "PATCH":
+            patch = self._body()
+            with st.lock:
+                obj = st.objects.get((kind, ns, name))
+                if obj is None:
+                    return self._send(
+                        404, {"kind": "Status", "code": 404,
+                              "reason": "NotFound"})
+                if is_status:
+                    obj.setdefault("status", {}).update(
+                        patch.get("status", {})
+                    )
+                else:
+                    obj.update(patch)
+            return self._send(200, obj)
+        return self._send(405, {"kind": "Status", "code": 405})
+
+    do_GET = do_POST = do_DELETE = do_PATCH = _handle
+
+
+class FakeApiServer:
+    """`with FakeApiServer() as srv:` → srv.url, srv.state."""
+
+    def __init__(self):
+        self.state = FakeApiServerState()
+        handler = type("Handler", (_Handler,), {"state": self.state})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._httpd.shutdown()
+        self._httpd.server_close()

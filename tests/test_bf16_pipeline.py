@@ -1,0 +1,79 @@
+"""bf16 + GPipe compile coverage.
+
+The combination that runs on TPU hardware — bf16 params/activations
+through the shard_map GPipe schedule with MoE expert parallelism — must
+have compile coverage off-hardware. Two layers of proof:
+
+1. AOT-lower the bf16 train step over a pp×ep×dp mesh and check the
+   lowered module really contains the bf16 pipeline (collective-permute
+   ring + bf16 tensors) — this validates tracing + partitioning specs.
+2. Compile AND execute one step on the 8-device CPU mesh. The only CPU
+   accommodation is disabling XLA's CPU-only AllReducePromotion pass
+   (conftest.py), which crashes cloning bf16 all-reduces inside scan
+   bodies; TPU's compiler has no such pass. Every other pass runs
+   against the exact program hardware gets.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.parallel.accelerate import Strategy, accelerate
+from dlrover_tpu.parallel.mesh import MeshSpec
+
+
+@pytest.fixture(scope="module")
+def bf16_pipeline_acc():
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = llama.LlamaConfig.tiny(
+        n_experts=2, pipeline_microbatches=2, dtype=jnp.bfloat16
+    )
+    acc = accelerate(
+        init_params=lambda k: llama.init_params(cfg, k),
+        loss_fn=lambda p, b, m: llama.loss_fn(cfg, p, b, mesh=m),
+        rules=llama.partition_rules(cfg),
+        optimizer=optax.adamw(1e-3),
+        strategy=Strategy(
+            mesh=MeshSpec(data=2, fsdp=1, expert=2, pipe=2)
+        ),
+        devices=devices[:8],
+    )
+    return cfg, acc
+
+
+def test_bf16_gpipe_lowers(bf16_pipeline_acc):
+    """AOT lowering of the bf16 GPipe program (VERDICT r2 #9)."""
+    cfg, acc = bf16_pipeline_acc
+    state = jax.eval_shape(acc.init, jax.random.PRNGKey(0))
+    tokens = jax.ShapeDtypeStruct((4, 33), jnp.int32)
+    lowered = acc.train_step.lower(state, {"tokens": tokens})
+    text = lowered.as_text()
+    # the pipeline ring must be in the lowered module, in bf16,
+    # partitioned over the 8-device mesh
+    assert "collective_permute" in text
+    assert "bf16" in text
+    assert "num_partitions = 8" in text
+
+
+def test_bf16_gpipe_compiles_and_runs(bf16_pipeline_acc):
+    """One real step: compile through the full (CPU) pass pipeline and
+    execute — loss finite, params updated, all in bf16 compute."""
+    cfg, acc = bf16_pipeline_acc
+    state = acc.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (4, 33), 0, cfg.vocab_size
+    )
+    batch = acc.shard_batch({"tokens": tokens})
+    import numpy as np
+
+    # train_step donates the state — snapshot a leaf before it runs
+    old = np.asarray(jax.tree_util.tree_leaves(state["params"])[0])
+    new_state, metrics = acc.train_step(state, batch)
+    loss = float(metrics["loss"])
+    assert loss == loss and 0 < loss < 20, f"bad loss {loss}"
+    new = np.asarray(jax.tree_util.tree_leaves(new_state["params"])[0])
+    assert not np.allclose(old, new)

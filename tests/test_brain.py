@@ -178,3 +178,48 @@ class TestBrainService:
         )
         assert resp.memory_mb == 1500
         assert opt.suggest("worker", "bogus-stage") is None
+
+
+class TestPersistence:
+    """File-backed sqlite survives process-style reopen (the documented
+    MySQL deviation — docs/DEVIATIONS.md §2)."""
+
+    def test_store_survives_reopen(self, tmp_path):
+        db = str(tmp_path / "brain.db")
+        store = JobMetricsStore(db)
+        _seed_history(store, n_jobs=2)
+        store.close()
+
+        reopened = JobMetricsStore(db)
+        try:
+            meta = reopened.get_job("hist-0")
+            assert meta is not None and meta.user == "alice"
+            assert len(reopened.samples("hist-1", role="ps")) == 5
+            similar = reopened.similar_jobs("train-job", user="alice")
+            assert len(similar) >= 2
+        finally:
+            reopened.close()
+
+    def test_brain_service_on_file_store(self, tmp_path):
+        db = str(tmp_path / "brain.db")
+        svc = BrainService(store=JobMetricsStore(db))
+        svc.start()
+        client = BrainClient(svc.addr)
+        client.persist_job("jp", job_name="durable", user="carol")
+        client.persist_sample(
+            "jp", "worker", num_nodes=4, samples_per_sec=55.0
+        )
+        client.close()
+        svc.stop()
+
+        # a new service over the same file sees the history
+        svc2 = BrainService(store=JobMetricsStore(db))
+        svc2.start()
+        client2 = BrainClient(svc2.addr)
+        try:
+            samples = client2.get_job_metrics("jp", role="worker")
+            assert len(samples) == 1
+            assert samples[0]["samples_per_sec"] == 55.0
+        finally:
+            client2.close()
+            svc2.stop()

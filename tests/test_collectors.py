@@ -1,0 +1,170 @@
+"""Agent diagnosis collectors → master inference chain.
+
+End-to-end of the reference datacollector flow
+(elastic_agent/datacollector/* → master DiagnosisManager): the log
+collector tails a worker log and ships windows on fatal markers; the
+chip collector samples device memory; both land in the master's data
+store where CheckFailureNodeOperator / CheckChipMetricsOperator draw
+conclusions.
+"""
+
+import json
+import time
+
+from dlrover_tpu.agent.collector import (
+    ChipMetricsCollector,
+    CollectorRunner,
+    DataCollector,
+    TrainingLogCollector,
+)
+from dlrover_tpu.common.constants import DiagnosisDataType
+from dlrover_tpu.master.diagnosis import DiagnosisManager
+
+
+class FakeClient:
+    def __init__(self):
+        self.reports = []
+
+    def report_diagnosis(self, data_type, content, ts=0.0):
+        self.reports.append((data_type, content))
+
+
+class TestTrainingLogCollector:
+    def _write(self, path, lines):
+        with open(path, "a") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def test_ships_window_on_fatal_marker(self, tmp_path):
+        log = tmp_path / "worker_0_r0.log"
+        self._write(log, [f"step {i} ok" for i in range(5)])
+        col = TrainingLogCollector(str(tmp_path), window_lines=10)
+        # first pass: healthy lines -> periodic context ship
+        payload = col.collect_data()
+        assert payload is not None and "step 4 ok" in payload
+        # healthy lines soon after -> nothing new to ship
+        self._write(log, ["step 5 ok"])
+        assert col.collect_data() is None
+        # a fatal marker ships immediately, window includes context
+        self._write(log, ["E0000 RESOURCE_EXHAUSTED: Hbm OOM on chip 0"])
+        payload = col.collect_data()
+        assert payload is not None
+        assert "RESOURCE_EXHAUSTED" in payload
+        assert "step 5 ok" in payload  # rolling window keeps context
+
+    def test_follows_newest_log_after_restart(self, tmp_path):
+        old = tmp_path / "worker_0_r0.log"
+        self._write(old, ["old run line"])
+        col = TrainingLogCollector(str(tmp_path), window_lines=10)
+        col.collect_data()
+        time.sleep(0.05)
+        new = tmp_path / "worker_0_r1.log"
+        self._write(new, ["Fatal Python error: Aborted"])
+        payload = col.collect_data()
+        assert payload is not None and "Fatal Python error" in payload
+
+    def test_no_log_dir_disables(self):
+        col = TrainingLogCollector(None)
+        assert not col.to_collect_data()
+
+
+class TestChipMetricsCollector:
+    def test_relays_worker_published_stats(self, tmp_path):
+        """The WORKER publishes (it owns libtpu); the agent only relays
+        the file — the agent process must never initialize JAX."""
+        from dlrover_tpu.agent.monitor import publish_chip_metrics
+
+        path = str(tmp_path / "chip_metrics.json")
+        publish_chip_metrics(path)  # test process plays the worker
+        col = ChipMetricsCollector(path)
+        payload = json.loads(col.collect_data())
+        assert "chips" in payload
+        for chip in payload["chips"]:
+            assert {"device", "platform", "hbm_utilization"} <= set(chip)
+        # unchanged snapshot is not re-shipped
+        assert col.collect_data() is None
+        # fresh publish ships again
+        publish_chip_metrics(path)
+        assert col.collect_data() is not None
+
+    def test_falls_back_to_host_rss(self, tmp_path):
+        col = ChipMetricsCollector(str(tmp_path / "missing.json"))
+        payload = json.loads(col.collect_data())
+        assert payload["chips"] == []
+        assert payload["host_rss_mb"] > 0
+
+    def test_agent_collector_module_does_not_import_jax(self):
+        """Importing the collector must not drag jax into the agent
+        process (libtpu exclusivity)."""
+        import subprocess
+        import sys
+
+        code = (
+            "import sys; import dlrover_tpu.agent.collector; "
+            "sys.exit(1 if 'jax' in sys.modules else 0)"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env={"PATH": "/usr/bin:/bin", "PYTHONPATH": ".",
+                 "HOME": "/root"},
+            cwd="/root/repo",
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr[-1000:]
+
+
+class TestCollectorToDiagnosisFlow:
+    def test_fatal_log_reaches_failure_operator(self, tmp_path):
+        log = tmp_path / "worker_0_r0.log"
+        with open(log, "w") as f:
+            f.write("XLA compilation failure: something broke\n")
+        client = FakeClient()
+        runner = CollectorRunner(
+            client, [TrainingLogCollector(str(tmp_path))]
+        )
+        runner.collect_once()
+        assert client.reports, "collector shipped nothing"
+
+        # feed what the servicer would forward into the manager
+        mgr = DiagnosisManager()
+        for data_type, content in client.reports:
+            mgr.report(data_type, node_id=3, payload=content)
+        conclusions = {i.key(): i for i in mgr.diagnose()}
+        failed = conclusions[("node", "is", "failed")]
+        assert failed.evidence["node_id"] == 3
+        assert "XLA compilation failure" in failed.evidence["markers"]
+
+    def test_hbm_pressure_conclusion(self):
+        mgr = DiagnosisManager()
+        payload = json.dumps(
+            {
+                "ts": time.time(),
+                "chips": [
+                    {
+                        "device": "0",
+                        "platform": "tpu",
+                        "hbm_bytes_in_use": 31_000_000_000,
+                        "hbm_bytes_limit": 32_000_000_000,
+                        "hbm_utilization": 0.969,
+                    }
+                ],
+            }
+        )
+        mgr.report(
+            DiagnosisDataType.CHIP_METRICS, node_id=1, payload=payload
+        )
+        conclusions = {i.key(): i for i in mgr.diagnose()}
+        hot = conclusions[("chip", "is", "pressured")]
+        assert hot.evidence["node_id"] == 1
+        assert hot.evidence["chips"] == ["0"]
+
+    def test_collector_errors_do_not_propagate(self):
+        class Exploding(DataCollector):
+            data_type = "boom"
+
+            def collect_data(self):
+                raise RuntimeError("collector bug")
+
+        runner = CollectorRunner(FakeClient(), [Exploding()])
+        runner.collect_once()  # must not raise

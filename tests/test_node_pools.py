@@ -1,0 +1,247 @@
+"""Per-role node pool behaviors.
+
+Mirrors reference tests for dlrover/python/master/node/{ps,worker}.py:
+PS cluster versioning across scale/migration, deferred pre-drop,
+worker scale up/down/migrate, pending-timeout resource cuts, and
+pool-specific relaunch keeping rank while rotating node id.
+"""
+
+import time
+
+from dlrover_tpu.common.constants import NodeStatus, NodeType
+from dlrover_tpu.common.node import Node, NodeGroupResource, NodeResource
+from dlrover_tpu.master.node import PSPool, WorkerPool, make_pool
+from dlrover_tpu.master.node_manager import JobNodeManager
+
+
+def _group(count, cpu=4.0, mem=8192):
+    return NodeGroupResource(
+        count=count,
+        node_resource=NodeResource(cpu=cpu, memory_mb=mem),
+    )
+
+
+def _running(pool, node):
+    node.update_status(NodeStatus.RUNNING)
+    return node
+
+
+class TestWorkerPool:
+    def _pool(self, n=2):
+        nodes = {}
+        pool = WorkerPool(nodes, _group(n))
+        for i in range(n):
+            node = Node(NodeType.WORKER, i, rank_index=i)
+            node.update_status(NodeStatus.RUNNING)
+            pool.add_node(node)
+        return pool
+
+    def test_scale_up_assigns_fresh_ranks(self):
+        pool = self._pool(2)
+        plan = pool.adjust(_group(4))
+        assert len(plan.launch_nodes) == 2
+        assert sorted(n.rank_index for n in plan.launch_nodes) == [2, 3]
+        assert len(pool.alive_nodes()) == 4
+
+    def test_scale_down_drops_highest_ranks_first(self):
+        pool = self._pool(4)
+        plan = pool.adjust(_group(2))
+        removed = sorted(n.rank_index for n in plan.remove_nodes)
+        assert removed == [2, 3]
+        alive = sorted(n.rank_index for n in pool.alive_nodes())
+        assert alive == [0, 1]
+
+    def test_scale_down_skips_critical(self):
+        pool = self._pool(3)
+        # highest-rank worker is critical -> survives
+        pool.nodes()[2].critical = True
+        plan = pool.adjust(_group(2))
+        assert [n.rank_index for n in plan.remove_nodes] == [1]
+
+    def test_relaunch_keeps_rank_rotates_id(self):
+        pool = self._pool(2)
+        victim = pool.nodes()[1]
+        plan = pool.relaunch_node(victim)
+        assert victim.is_released
+        new = plan.launch_nodes[0]
+        assert new.rank_index == victim.rank_index
+        assert new.id != victim.id
+
+    def test_migrate_workers_keeps_rank(self):
+        pool = self._pool(2)
+        old = pool.nodes()[1]
+        plan = pool.migrate_workers(
+            {old.name: NodeResource(cpu=16.0, memory_mb=32768)}
+        )
+        assert old.is_released and not old.relaunchable
+        new = plan.launch_nodes[0]
+        assert new.rank_index == old.rank_index
+        assert new.config_resource.cpu == 16.0
+        assert plan.remove_nodes == [old]
+
+    def test_remove_not_joined_rdzv(self):
+        pool = self._pool(3)
+        plan = pool.remove_not_joined_rdzv_workers([2])
+        assert [n.rank_index for n in plan.remove_nodes] == [2]
+        assert not pool.nodes()[2].relaunchable
+
+    def test_pending_timeout_cuts_resources(self):
+        pool = self._pool(0)
+        node = Node(
+            NodeType.WORKER,
+            0,
+            config_resource=NodeResource(cpu=8.0, memory_mb=16384),
+        )
+        node.update_status(NodeStatus.PENDING)
+        node.create_time = time.time() - 10_000
+        pool.add_node(node)
+        plan = pool.reduce_pending_node_resource(timeout=900)
+        assert node in plan.remove_nodes
+        assert len(plan.launch_nodes) == 1
+        assert node.config_resource.cpu == 4.0
+        assert node.config_resource.memory_mb == 8192
+
+    def test_wait_worker_restart(self):
+        pool = self._pool(2)
+        node = pool.nodes()[0]
+        node.update_status(NodeStatus.FAILED)
+        assert pool.wait_worker_restart()
+        node.relaunch_count = node.max_relaunch_count
+        assert not pool.wait_worker_restart()
+
+
+class TestPSPool:
+    def _pool(self, n=2):
+        nodes = {}
+        pool = PSPool(nodes, _group(n))
+        for i in range(n):
+            node = Node(NodeType.PS, i, rank_index=i, critical=True)
+            node.host_addr = f"ps{i}.svc:2222"
+            node.update_status(NodeStatus.RUNNING)
+            pool.add_node(node)
+        pool.process_after_cluster_ready()
+        return pool
+
+    def test_initial_cluster_ready(self):
+        pool = self._pool(2)
+        assert pool.cluster_ready()
+        assert len(pool.training_cluster()) == 2
+        assert pool.ps_addrs() == ["ps0.svc:2222", "ps1.svc:2222"]
+
+    def test_scale_up_holds_old_cluster_until_new_ps_runs(self):
+        pool = self._pool(2)
+        plan = pool.adjust(_group(3))
+        assert len(plan.launch_nodes) == 1
+        new_ps = plan.launch_nodes[0]
+        # new PS still INITIAL -> next cluster == old cluster
+        assert not pool.cluster_ready()
+        assert len(pool.next_training_cluster()) == 2
+        # new PS comes up -> next cluster includes it
+        new_ps.update_status(NodeStatus.RUNNING)
+        new_ps.host_addr = "ps2.svc:2222"
+        nxt = pool.next_training_cluster()
+        assert len(nxt) == 3
+        pool.process_after_cluster_ready()
+        assert pool.cluster_ready()
+        assert len(pool.training_cluster()) == 3
+
+    def test_scale_down_defers_removal_until_commit(self):
+        pool = self._pool(3)
+        plan = pool.adjust(_group(2))
+        # nothing removed yet — victims pre-dropped only
+        assert plan.remove_nodes == []
+        assert len(pool.next_training_cluster()) == 2
+        # the pre-dropped PS is still RUNNING (serving old cluster)
+        assert len(pool.running_nodes()) == 3
+        commit = pool.process_after_cluster_ready()
+        assert len(commit.remove_nodes) == 1
+        assert commit.remove_nodes[0].rank_index == 2
+        assert commit.remove_nodes[0].is_released
+
+    def test_migration_keeps_old_ps_serving_until_commit(self):
+        pool = self._pool(2)
+        old = pool.nodes()[0]
+        plan = pool.migrate({old.name: NodeResource(cpu=8.0, memory_mb=16384)})
+        assert len(plan.launch_nodes) == 1
+        new = plan.launch_nodes[0]
+        assert new.rank_index == old.rank_index
+        assert pool.exist_migrated_ps()
+        # replacement not RUNNING yet -> old still in next cluster
+        assert old in pool.next_training_cluster()
+        # replacement runs -> old is pre-dropped, new takes the rank
+        new.update_status(NodeStatus.RUNNING)
+        new.host_addr = "ps9.svc:2222"
+        nxt = pool.next_training_cluster()
+        assert new in nxt and old not in nxt
+        assert pool.ps_addrs()[old.rank_index] == "ps9.svc:2222"
+        commit = pool.process_after_cluster_ready()
+        assert old in commit.remove_nodes
+        assert not pool.exist_migrated_ps()
+
+    def test_relaunch_flips_cluster_version(self):
+        pool = self._pool(2)
+        victim = pool.training_cluster()[1]
+        victim.update_status(NodeStatus.FAILED)
+        plan = pool.relaunch_node(victim)
+        assert not pool.cluster_ready()
+        replacement = plan.launch_nodes[0]
+        # replacement still INITIAL -> old (now 1-member) cluster serves
+        assert victim not in pool.training_cluster()
+        replacement.update_status(NodeStatus.RUNNING)
+        nxt = pool.next_training_cluster()
+        assert replacement in nxt
+        assert len(nxt) == 2
+
+    def test_has_ps_failure_on_stuck_pending(self):
+        pool = self._pool(1)
+        stuck = Node(NodeType.PS, 99, rank_index=1)
+        stuck.update_status(NodeStatus.PENDING)
+        stuck.create_time = time.time() - 10_000
+        pool.add_node(stuck)
+        assert pool.has_ps_failure(timeout=900)
+
+    def test_delete_running_ps_after_job_done(self):
+        pool = self._pool(2)
+        plan = pool.delete_running_ps()
+        assert len(plan.remove_nodes) == 2
+        assert all(n.status == NodeStatus.DELETED for n in plan.remove_nodes)
+
+
+class TestManagerPoolIntegration:
+    def test_pool_shares_node_table(self):
+        mgr = JobNodeManager()
+        node = Node(NodeType.WORKER, 0, rank_index=0)
+        mgr.add_node(node)
+        pool = mgr.pool(NodeType.WORKER)
+        assert pool.nodes() == [node]
+        # scale through the pool -> visible in the manager
+        node.update_status(NodeStatus.RUNNING)
+        plan = pool.adjust(_group(2))
+        assert len(plan.launch_nodes) == 1
+        assert len(mgr.get_nodes(NodeType.WORKER)) == 2
+        # id allocation goes through the manager counter
+        assert plan.launch_nodes[0].id == 1
+        mgr.add_node(Node(NodeType.WORKER, 5))
+        plan2 = pool.adjust(_group(4))
+        new_ids = {n.id for n in plan2.launch_nodes}
+        assert 5 not in new_ids and min(new_ids) >= 6
+
+    def test_chief_evaluator_pools(self):
+        mgr = JobNodeManager()
+        chief = Node(NodeType.CHIEF, 0)
+        mgr.add_node(chief)
+        assert not mgr.pool(NodeType.CHIEF).is_chief_running()
+        chief.update_status(NodeStatus.RUNNING)
+        assert mgr.pool(NodeType.CHIEF).is_chief_running()
+        ev = Node(NodeType.EVALUATOR, 0)
+        ev.update_status(NodeStatus.RUNNING)
+        mgr.add_node(ev)
+        assert mgr.pool(NodeType.EVALUATOR).is_evaluator_running()
+
+    def test_make_pool_unknown_role_gets_base(self):
+        pool = make_pool("custom", {}, _group(1))
+        assert pool.role == "custom"
+        node = Node("custom", 0)
+        pool.add_node(node)
+        node.update_status(NodeStatus.RUNNING)
+        assert pool.running_nodes() == [node]

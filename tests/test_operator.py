@@ -220,3 +220,34 @@ class TestJobCleanup:
         assert master_pod_name("gone") in k8s.pods
         ctl.reconcile_once()
         assert master_pod_name("gone") not in k8s.pods
+
+
+class TestDeployManifests:
+    """deploy/k8s/ YAML stays in sync with the in-code CRDs
+    (docs/DEVIATIONS.md §1 equivalence evidence)."""
+
+    def test_crd_yaml_matches_code(self):
+        import os
+
+        import yaml
+
+        root = os.path.join(os.path.dirname(__file__), "..", "deploy", "k8s")
+        with open(os.path.join(root, "elasticjob-crd.yaml")) as f:
+            assert yaml.safe_load(f) == elastic_job_crd()
+        with open(os.path.join(root, "scaleplan-crd.yaml")) as f:
+            assert yaml.safe_load(f) == scale_plan_crd()
+
+    def test_operator_deployment_well_formed(self):
+        import os
+
+        import yaml
+
+        root = os.path.join(os.path.dirname(__file__), "..", "deploy", "k8s")
+        with open(os.path.join(root, "operator.yaml")) as f:
+            docs = list(yaml.safe_load_all(f))
+        kinds = {d["kind"] for d in docs}
+        assert {"ServiceAccount", "ClusterRole", "ClusterRoleBinding",
+                "Deployment"} <= kinds
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+        assert cmd[:3] == ["python", "-m", "dlrover_tpu.operator"]

@@ -20,7 +20,7 @@ from dlrover_tpu.scheduler.ray import (
     FakeRayClient,
     RayActorWatcher,
     actor_name,
-    parse_actor_name,
+    job_actors,
 )
 
 
@@ -31,8 +31,12 @@ class TestRayAdapter:
         )
 
     def test_actor_names_roundtrip(self):
-        name = actor_name("jobx", "worker", 3)
-        assert parse_actor_name(name) == ("worker", 3)
+        fake = FakeRayClient()
+        fake.create_actor(actor_name("jobx", "worker", 3))
+        fake.create_actor(actor_name("jobx-2", "worker", 0))  # other job
+        assert job_actors(fake, "jobx") == [
+            ("jobx-worker-3", "worker", 3, "ALIVE")
+        ]
 
     def test_factory_builds_ray_pair(self):
         fake = FakeRayClient()
@@ -74,6 +78,71 @@ class TestRayAdapter:
             master.stop()
 
 
+class TestRayDeadActorSemantics:
+    """Real ray keeps killed detached actors listed as DEAD — the
+    adapter must not misread them (review findings r3)."""
+
+    def _pair(self, fake):
+        return PlatformFactory.build(
+            JobArgs.simple(
+                num_workers=2, cpu=2, platform="ray", job_name="j"
+            ),
+            ray_client=fake,
+        )
+
+    def test_deliberate_kill_reports_deleted_not_failed(self):
+        from dlrover_tpu.common.node import Node
+        from dlrover_tpu.master.scaler import ScalePlan
+
+        fake = FakeRayClient()
+        scaler, watcher = self._pair(fake)
+        scaler.scale(
+            ScalePlan(launch_nodes=[Node("worker", 0), Node("worker", 1)])
+        )
+        watcher.poll()  # baseline
+        # scale-down: deliberate removal of worker-1
+        scaler.scale(ScalePlan(remove_nodes=[Node("worker", 1)]))
+        events = watcher.poll()
+        statuses = {e.node.name: e.node.status for e in events}
+        assert statuses.get("j-worker-1") == NodeStatus.DELETED
+        # a crash (not released) still reports FAILED
+        fake.set_actor_state("j-worker-0", "DEAD")
+        events = watcher.poll()
+        statuses = {e.node.name: e.node.status for e in events}
+        assert statuses.get("j-worker-0") == NodeStatus.FAILED
+
+    def test_group_scale_up_skips_dead_ids(self):
+        from dlrover_tpu.common.node import (
+            Node,
+            NodeGroupResource,
+            NodeResource,
+        )
+        from dlrover_tpu.master.scaler import ScalePlan
+
+        fake = FakeRayClient()
+        scaler, _ = self._pair(fake)
+        scaler.scale(
+            ScalePlan(launch_nodes=[Node("worker", 0), Node("worker", 1)])
+        )
+        # worker-0 crashed; its DEAD entry stays listed
+        fake.set_actor_state("j-worker-0", "DEAD")
+        plan = ScalePlan(
+            node_group_resources={
+                "worker": NodeGroupResource(
+                    count=3, node_resource=NodeResource(cpu=1)
+                )
+            }
+        )
+        scaler.scale(plan)
+        alive = [
+            n for n, s in fake.actors.items() if s == "ALIVE"
+        ]
+        # 3 live workers, ids allocated past the DEAD hole (no reuse)
+        assert len(alive) == 3
+        assert "j-worker-0" not in alive
+        assert {"j-worker-2", "j-worker-3"} <= set(alive)
+
+
 class TestMasterCLI:
     def test_parse_and_build(self):
         args = parse_args(
@@ -81,15 +150,25 @@ class TestMasterCLI:
                 "--platform", "ray", "--min-nodes", "2",
                 "--max-nodes", "4", "--num-workers", "3",
                 "--worker-chips", "8", "--job-name", "cli-job",
+                "--", "python", "train.py", "--epochs", "3",
             ]
         )
         assert args.platform == "ray"
+        assert args.worker_command == [
+            "python", "train.py", "--epochs", "3"
+        ]
         # building a ray master without ray installed must fail loudly,
         # not silently fall back — prove the platform wiring is reached
         import pytest
 
         with pytest.raises((ImportError, ModuleNotFoundError)):
             build_master(args)
+
+    def test_ray_without_worker_command_rejected(self):
+        import pytest
+
+        with pytest.raises(SystemExit):
+            parse_args(["--platform", "ray"])
 
     def test_local_master_runs_and_stops(self):
         args = parse_args(["--min-nodes", "1", "--poll-interval",
