@@ -292,6 +292,28 @@ class MasterClient:
             msg.CkptSaveStep(node_id=self.node_id, step=step, path=path)
         )
 
+    def report_model_info(
+        self,
+        num_params: int = 0,
+        flops_per_step: float = 0.0,
+        batch_size_per_host: int = 0,
+        seq_len: int = 0,
+        program_stats: str = "",
+    ):
+        """Model + compiled-program stats for the master's metric
+        collector / resource optimizer (reference report_model_info;
+        program_stats JSON comes from utils/program_stats.py)."""
+        return self.report(
+            msg.ModelInfo(
+                node_id=self.node_id,
+                num_params=num_params,
+                flops_per_step=flops_per_step,
+                batch_size_per_host=batch_size_per_host,
+                seq_len=seq_len,
+                program_stats=program_stats,
+            )
+        )
+
     def report_diagnosis(
         self, data_type: str, content: str, ts: float = 0.0
     ):

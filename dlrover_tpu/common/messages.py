@@ -117,6 +117,10 @@ class ModelInfo(BaseRequest):
     flops_per_step: float = 0.0
     batch_size_per_host: int = 0
     seq_len: int = 0
+    # JSON of utils/program_stats.ProgramStats for the compiled train
+    # step (XLA cost/memory analysis — the reference's TF graph profile
+    # extractor equivalent); empty when the trainer didn't profile
+    program_stats: str = ""
 
 
 @dataclass

@@ -79,6 +79,7 @@ def build_master(args: argparse.Namespace):
         job_args=job_args,
         poll_interval=args.poll_interval,
         hang_timeout=args.hang_timeout,
+        job_name=args.job_name,
     )
 
 

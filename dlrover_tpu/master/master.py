@@ -29,8 +29,9 @@ class JobMaster:
         servicer: Optional[MasterServicer] = None,
         poll_interval: float = 2.0,
         hang_timeout: float = 1800.0,
+        job_name: str = "job",
     ):
-        self.servicer = servicer or MasterServicer()
+        self.servicer = servicer or MasterServicer(job_name=job_name)
         self.port = port or find_free_port()
         self._server = build_master_server(self.servicer, self.port)
         self.poll_interval = poll_interval
@@ -340,6 +341,8 @@ def run_master(
     job_name: str = "local",
 ) -> LocalJobMaster:
     """Convenience: start a LocalJobMaster thread and return it."""
-    master = LocalJobMaster(port=port, num_nodes=num_nodes)
+    master = LocalJobMaster(
+        port=port, num_nodes=num_nodes, job_name=job_name
+    )
     master.start()
     return master

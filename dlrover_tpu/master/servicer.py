@@ -42,6 +42,7 @@ class MasterServicer(MasterServicerBase):
         kv_store: Optional[KVStoreService] = None,
         sync_service: Optional[SyncService] = None,
         rdzv_managers: Optional[dict] = None,
+        job_name: str = "job",
     ):
         self.task_manager = task_manager or TaskManager()
         self.node_manager = node_manager or JobNodeManager()
@@ -56,6 +57,9 @@ class MasterServicer(MasterServicerBase):
             "network-check": NetworkCheckRendezvousManager(),
         }
         self.paral_config = msg.ParallelConfig()
+        from dlrover_tpu.master.stats import JobMetricCollector
+
+        self.metric_collector = JobMetricCollector(job_name=job_name)
         self.run_configs = {}
         self._ckpt_steps = {}  # path -> latest committed step
         self.job_stage = "init"
@@ -283,6 +287,26 @@ class MasterServicer(MasterServicerBase):
             return ReplyEnvelope()
         if isinstance(req, msg.ModelInfo):
             self.run_configs["model_info"] = str(req)
+            # feed the stats pipeline (reference JobMetricCollector
+            # :84 — model info flows to the local/brain reporters and
+            # sizes the resource optimizer's estimates)
+            import json as _json
+
+            program = {}
+            if req.program_stats:
+                try:
+                    program = _json.loads(req.program_stats)
+                except ValueError:
+                    pass
+            seq = max(req.seq_len, 1)
+            self.metric_collector.collect_model_info(
+                num_params=req.num_params,
+                flops_per_token=req.flops_per_step
+                / max(req.batch_size_per_host * seq, 1),
+                batch_size=req.batch_size_per_host,
+                seq_len=req.seq_len,
+                program=program,
+            )
             return ReplyEnvelope()
         if isinstance(req, msg.TrainingExceptionReport):
             handled = self.error_monitor.process_error(

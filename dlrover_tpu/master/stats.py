@@ -30,6 +30,10 @@ class ModelMetrics:
     flops_per_token: float = 0.0
     batch_size: int = 0
     seq_len: int = 0
+    # parsed utils/program_stats.ProgramStats of the compiled train
+    # step (flops, peak HBM, op histogram) — the XLA stand-in for the
+    # reference's TF graph OperationStats/TensorStats
+    program: Dict = field(default_factory=dict)
 
 
 @dataclass
@@ -117,8 +121,12 @@ class JobMetricCollector:
         flops_per_token: float = 0.0,
         batch_size: int = 0,
         seq_len: int = 0,
+        program: Optional[Dict] = None,
     ):
-        m = ModelMetrics(num_params, flops_per_token, batch_size, seq_len)
+        m = ModelMetrics(
+            num_params, flops_per_token, batch_size, seq_len,
+            program or {},
+        )
         with self._lock:
             if self._model == m:
                 return

@@ -1,0 +1,125 @@
+"""Normalization layers, mesh-aware by construction.
+
+Reference parity: atorch/atorch/normalization/ (~263 LoC: SyncBatchNorm
+process-group plumbing + LayerNorm modules). The TPU story is shorter
+by design: under GSPMD a reduction over the batch axis of a
+data-sharded array IS a global reduction — XLA inserts the cross-chip
+collectives — so "synchronized" batch norm is just batch norm inside
+jit. There is no process-group bookkeeping to port; the functions below
+plus the test that proves the sync property
+(tests/test_normalization.py) replace the reference module.
+
+All stats math runs in f32 regardless of input dtype (bf16 inputs lose
+too much in the variance accumulation), matching _rms_norm in
+models/llama.py.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_batch_norm(dim: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
+    return {
+        "scale": jnp.ones((dim,), dtype),
+        "bias": jnp.zeros((dim,), dtype),
+        "mean": jnp.zeros((dim,), jnp.float32),   # running, f32 always
+        "var": jnp.ones((dim,), jnp.float32),
+    }
+
+
+def batch_norm(
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    training: bool = True,
+    momentum: float = 0.9,
+    eps: float = 1e-5,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """BatchNorm over all leading axes of [..., C].
+
+    Inside jit over a mesh with the batch dim sharded on a data axis,
+    the mean/var reductions are GLOBAL (GSPMD inserts the all-reduce):
+    this is the reference's SyncBatchNorm with zero extra code. Returns
+    (y, new_params) — new running stats when training, unchanged
+    otherwise."""
+    x32 = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    if training:
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.var(x32, axis=axes)
+        new_params = dict(params)
+        new_params["mean"] = (
+            momentum * params["mean"] + (1 - momentum) * mean
+        )
+        new_params["var"] = (
+            momentum * params["var"] + (1 - momentum) * var
+        )
+    else:
+        mean, var = params["mean"], params["var"]
+        new_params = params
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * params["scale"].astype(jnp.float32) + params[
+        "bias"
+    ].astype(jnp.float32)
+    return y.astype(x.dtype), new_params
+
+
+def init_layer_norm(dim: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
+    return {
+        "scale": jnp.ones((dim,), dtype),
+        "bias": jnp.zeros((dim,), dtype),
+    }
+
+
+def layer_norm(
+    params: Dict[str, jax.Array], x: jax.Array, eps: float = 1e-5
+) -> jax.Array:
+    """LayerNorm over the trailing axis, f32 stats."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * params["scale"].astype(jnp.float32) + params[
+        "bias"
+    ].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def init_rms_norm(dim: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
+    return {"scale": jnp.ones((dim,), dtype)}
+
+
+def rms_norm(
+    params: Dict[str, jax.Array], x: jax.Array, eps: float = 1e-6
+) -> jax.Array:
+    """RMSNorm (the decoder stack's norm, exported standalone)."""
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps
+    )
+    return (x32 * rms).astype(x.dtype) * params["scale"].astype(x.dtype)
+
+
+def group_norm(
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    num_groups: int,
+    eps: float = 1e-5,
+) -> jax.Array:
+    """GroupNorm over [..., C]: channels split into groups, stats per
+    group — batch-size independent (no sync question at all)."""
+    *lead, c = x.shape
+    if c % num_groups:
+        raise ValueError(f"channels {c} not divisible by {num_groups}")
+    x32 = x.astype(jnp.float32).reshape(
+        *lead, num_groups, c // num_groups
+    )
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(*lead, c)
+    y = y * params["scale"].astype(jnp.float32) + params[
+        "bias"
+    ].astype(jnp.float32)
+    return y.astype(x.dtype)

@@ -67,24 +67,57 @@ class Accelerated:
     eval_step: Optional[Callable] = None
     state_shardings: Any = None
 
-    def shard_batch(self, batch, with_accum: bool = True) -> Any:
-        """Place a host batch on the mesh. `with_accum=False` for
-        unfolded batches (eval) when the train strategy accumulates."""
+    def batch_sharding(
+        self, x, with_accum: bool = True
+    ) -> NamedSharding:
+        """The NamedSharding one batch leaf gets on this mesh."""
         spec = P(*self.strategy.batch_spec)
         if self.strategy.grad_accum > 1 and with_accum:
             spec = P(None, *self.strategy.batch_spec)
+        nd = getattr(x, "ndim", 0)
+        entries = list(spec)[:nd]
+        filtered = _filter_spec(
+            P(*entries), self.mesh, getattr(x, "shape", ())
+        )
+        return NamedSharding(self.mesh, filtered)
 
-        def _put(x):
-            nd = getattr(x, "ndim", 0)
-            entries = list(spec)[:nd]
-            filtered = _filter_spec(
-                P(*entries), self.mesh, getattr(x, "shape", ())
-            )
-            return jax.device_put(
-                x, NamedSharding(self.mesh, filtered)
-            )
+    def shard_batch(self, batch, with_accum: bool = True) -> Any:
+        """Place a host batch on the mesh. `with_accum=False` for
+        unfolded batches (eval) when the train strategy accumulates."""
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                x, self.batch_sharding(x, with_accum)
+            ),
+            batch,
+        )
 
-        return jax.tree_util.tree_map(_put, batch)
+    def abstract_batch(self, batch, with_accum: bool = True) -> Any:
+        """Avals of shard_batch's result with NO device transfer —
+        for AOT lowering (profile_program)."""
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape,
+                x.dtype,
+                sharding=self.batch_sharding(x, with_accum),
+            )
+            if hasattr(x, "shape")
+            else x,
+            batch,
+        )
+
+    def profile_program(self, state, batch):
+        """Cost/memory stats of the compiled train step (reference TF
+        graph profile extractor → brain; utils/program_stats.py). Uses
+        AOT lower+compile on abstract avals — hits the compilation
+        cache when the step already ran, so this is cheap after the
+        first step. `batch` may be real arrays or avals (abstract_batch)."""
+        from dlrover_tpu.utils.program_stats import (
+            abstractify,
+            extract_program_stats,
+        )
+
+        lowered = self.train_step.lower(*abstractify((state, batch)))
+        return extract_program_stats(lowered.compile())
 
 
 def accelerate(

@@ -137,6 +137,13 @@ class ElasticTrainer:
         batch = self.acc.shard_batch(self._fold_microbatches(batch))
         return self.acc.train_step(state, batch)
 
+    def profile_program(self, state, batch):
+        """Compiled-step stats with the SAME fold/shard the step path
+        uses — on avals only, no device transfer
+        (accelerate.Accelerated.profile_program)."""
+        folded = self.acc.abstract_batch(self._fold_microbatches(batch))
+        return self.acc.profile_program(state, folded)
+
     def eval_step(self, state: Any, batch: Any) -> Dict:
         sharded = self.acc.shard_batch(batch, with_accum=False)
         return self.acc.eval_step(state, sharded)

@@ -111,6 +111,7 @@ def _resolve_master(
         min_nodes=min_nodes,
         max_nodes=max_nodes,
         node_unit=args.node_unit,
+        job_name=args.job_name,
     )
     master.start()
     logger.info("started local job master at %s", master.addr)

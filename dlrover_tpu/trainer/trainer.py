@@ -51,6 +51,9 @@ class TrainingArguments:
     resume: bool = True
     hang_timeout: float = 1800.0
     publish_step_metrics: bool = True
+    # after the first step, send model size + compiled-program stats
+    # (utils/program_stats) to the master's metric collector
+    report_model_info: bool = True
 
 
 class TrainerCallback:
@@ -108,9 +111,50 @@ class Trainer:
             )
         self.global_step = 0
         self.last_logs: Dict = {}
+        # once per PROCESS, not per job: a restarted/resumed worker
+        # re-reports (the master's collector is in-memory and the
+        # recompiled program may differ after an elastic resize)
+        self._model_info_reported = False
         self._hang = HangingDetector(
             timeout=self.args.hang_timeout, master_client=master_client
         )
+
+    def _report_model_info(self, state, batch):
+        """One-shot after the first step: model size + compiled-program
+        stats to the master (reference report_model_info → brain; the
+        AOT lower+compile hits the compilation cache, so this costs
+        tracing only)."""
+        if self._mc is None or not self.args.report_model_info:
+            return
+        try:
+            params = (
+                state.get("params") if isinstance(state, dict) else state
+            )
+            leaves = jax.tree_util.tree_leaves(params)
+            num_params = int(
+                sum(int(np.prod(x.shape)) for x in leaves if hasattr(x, "shape"))
+            )
+            stats = None
+            if hasattr(self.et, "profile_program"):
+                stats = self.et.profile_program(state, batch)
+            bsz = 0
+            seq = 0
+            tok = batch.get("tokens") if isinstance(batch, dict) else None
+            if tok is not None and getattr(tok, "ndim", 0) >= 2:
+                # train_data yields GLOBAL batches (class docstring);
+                # the per-host share is what the master's resource
+                # estimates need
+                bsz = int(tok.shape[0]) // max(jax.process_count(), 1)
+                seq = int(tok.shape[1])
+            self._mc.report_model_info(
+                num_params=num_params,
+                flops_per_step=stats.flops if stats else 0.0,
+                batch_size_per_host=bsz,
+                seq_len=seq,
+                program_stats=stats.to_json() if stats else "",
+            )
+        except Exception:  # noqa: BLE001 — stats must never kill training
+            logger.debug("model info report failed", exc_info=True)
 
     # -- checkpoint --------------------------------------------------------
 
@@ -207,6 +251,9 @@ class Trainer:
                     jax.block_until_ready(
                         metrics.get("loss", metrics)
                     )
+                    if not self._model_info_reported:
+                        self._model_info_reported = True
+                        self._report_model_info(state, batch)
                     self.global_step += 1
                     window_steps += 1
                     self._hang.record_step(self.global_step)

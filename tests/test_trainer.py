@@ -255,3 +255,45 @@ class TestHangingDetector:
             time.sleep(0.05)
         hd.stop()
         assert not hangs
+
+
+class TestModelInfoReport:
+    def test_first_step_reports_program_stats(self, tmp_path):
+        """After step 1 the trainer ships model size + compiled-program
+        stats to the master (reference report_model_info → brain)."""
+        import json
+
+        class FakeMC:
+            def __init__(self):
+                self.model_infos = []
+
+            def report_model_info(self, **kw):
+                self.model_infos.append(kw)
+
+            def report_global_step(self, step):
+                pass
+
+        mc = FakeMC()
+        et = _make_et()
+        args = TrainingArguments(
+            output_dir=str(tmp_path),
+            max_steps=3,
+            logging_steps=0,
+            resume=False,
+            save_steps=0,
+            publish_step_metrics=False,
+            hang_timeout=0,
+        )
+        tr = Trainer(
+            et, args,
+            train_data=_loader(6, _make_batch(16)),
+            checkpointer=None,
+            master_client=mc,
+        )
+        tr.train()
+        assert len(mc.model_infos) == 1  # one-shot, not per step
+        info = mc.model_infos[0]
+        assert info["num_params"] > 0
+        stats = json.loads(info["program_stats"])
+        assert stats["flops"] > 0
+        assert stats["op_count"] > 0

@@ -153,3 +153,86 @@ class TestStrategyGenerator:
         cfg = g.suggest_dataloader(sample_bytes=4096, global_batch_size=64)
         assert 1 <= cfg.num_workers <= 8
         assert cfg.prefetch >= 1
+
+
+class TestProgramStats:
+    """utils/program_stats.py — the XLA equivalent of the reference's
+    TF graph profile extractor (elastic_agent/tensorflow/
+    profile_extractor.py) — and its flow into the master's metric
+    collector over the ModelInfo RPC."""
+
+    def _stats(self):
+        import jax
+        import jax.numpy as jnp
+
+        from dlrover_tpu.utils.program_stats import profile_step_fn
+
+        def f(w, x):
+            return jnp.tanh(x @ w).sum()
+
+        w = jnp.ones((128, 128))
+        x = jnp.ones((32, 128))
+        return profile_step_fn(jax.grad(f), w, x)
+
+    def test_extracts_flops_and_ops(self):
+        s = self._stats()
+        # grad of x@w: forward 2*32*128*128 + backward 2x
+        assert s.flops > 1e6
+        assert s.op_count > 5
+        assert "dot" in s.op_histogram or s.fusion_count > 0
+        assert s.arithmetic_intensity > 0
+
+    def test_params_stats(self):
+        import jax.numpy as jnp
+
+        from dlrover_tpu.utils.program_stats import params_stats
+
+        out = params_stats({"a": jnp.ones((10, 10)),
+                            "b": jnp.ones((5,))})
+        assert out["variable_count"] == 2
+        assert out["total_variable_bytes"] == 400 + 20
+        assert out["max_variable_bytes"] == 400
+
+    def test_model_info_rpc_feeds_collector(self):
+        from dlrover_tpu.common import messages as msg
+        from dlrover_tpu.common.comm import Envelope
+        from dlrover_tpu.master.servicer import MasterServicer
+
+        s = self._stats()
+        servicer = MasterServicer()
+        servicer.report(
+            Envelope(payload=msg.ModelInfo(
+                node_id=0,
+                num_params=1234,
+                flops_per_step=1e12,
+                batch_size_per_host=8,
+                seq_len=2048,
+                program_stats=s.to_json(),
+            ))
+        )
+        model = servicer.metric_collector._model
+        assert model is not None
+        assert model.num_params == 1234
+        assert model.program["flops"] == s.flops
+        assert model.program["op_count"] == s.op_count
+
+    def test_op_histogram_tuple_ops(self):
+        """Multi-output fusions and tuple collectives — the type itself
+        is parenthesized; the op must still be counted (r3 review)."""
+        from dlrover_tpu.utils.program_stats import _op_histogram
+
+        hlo = "\n".join([
+            "  %p0 = f32[128,128]{1,0} parameter(0)",
+            "  %fusion = (f32[128,128]{1,0}, f32[128]{0}) fusion(%p0),"
+            " kind=kLoop, calls=%fused_computation",
+            "  %ar = (bf16[64]{0}, bf16[64]{0}) all-reduce(%a, %b),"
+            " replica_groups={{0,1}}, to_apply=%add",
+            "  ROOT %t = (f32[2]{0}) tuple(%x)",
+            "  %cp = f32[8]{0} collective-permute(%p0),"
+            " source_target_pairs={{0,1}}",
+        ])
+        hist = _op_histogram(hlo)
+        assert hist["fusion"] == 1
+        assert hist["all-reduce"] == 1
+        assert hist["collective-permute"] == 1
+        assert hist["parameter"] == 1
