@@ -298,11 +298,18 @@ class MasterServicer(MasterServicerBase):
                     program = _json.loads(req.program_stats)
                 except ValueError:
                     pass
-            seq = max(req.seq_len, 1)
+            # flops_per_step and batch_size_per_host are both per-host
+            # (trainer scales cost_analysis by local_device_count);
+            # without token counts there is no per-token figure — report
+            # 0 rather than a step total masquerading as per-token
+            tokens_host = req.batch_size_per_host * req.seq_len
             self.metric_collector.collect_model_info(
                 num_params=req.num_params,
-                flops_per_token=req.flops_per_step
-                / max(req.batch_size_per_host * seq, 1),
+                flops_per_token=(
+                    req.flops_per_step / tokens_host
+                    if tokens_host > 0
+                    else 0.0
+                ),
                 batch_size=req.batch_size_per_host,
                 seq_len=req.seq_len,
                 program=program,

@@ -93,12 +93,11 @@ def init_rms_norm(dim: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
 def rms_norm(
     params: Dict[str, jax.Array], x: jax.Array, eps: float = 1e-6
 ) -> jax.Array:
-    """RMSNorm (the decoder stack's norm, exported standalone)."""
-    x32 = x.astype(jnp.float32)
-    rms = jax.lax.rsqrt(
-        jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps
-    )
-    return (x32 * rms).astype(x.dtype) * params["scale"].astype(x.dtype)
+    """RMSNorm — the decoder stack's norm (models/llama.py _rms_norm),
+    exported standalone behind the params-dict convention."""
+    from dlrover_tpu.models.llama import _rms_norm
+
+    return _rms_norm(x, params["scale"], eps)
 
 
 def group_norm(

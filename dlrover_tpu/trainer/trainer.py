@@ -121,40 +121,76 @@ class Trainer:
 
     def _report_model_info(self, state, batch):
         """One-shot after the first step: model size + compiled-program
-        stats to the master (reference report_model_info → brain; the
-        AOT lower+compile hits the compilation cache, so this costs
-        tracing only)."""
+        stats to the master (reference report_model_info → brain).
+
+        Runs the AOT lower+compile in a daemon thread: without a
+        persistent compilation cache, `lower().compile()` does NOT hit
+        the in-memory jit executable cache, so on a real model it is a
+        second full XLA compile — off the training critical path it
+        costs idle host CPU only. Shape/sharding metadata stays valid
+        even after later steps donate the state buffers."""
         if self._mc is None or not self.args.report_model_info:
             return
-        try:
-            params = (
-                state.get("params") if isinstance(state, dict) else state
-            )
-            leaves = jax.tree_util.tree_leaves(params)
-            num_params = int(
-                sum(int(np.prod(x.shape)) for x in leaves if hasattr(x, "shape"))
-            )
-            stats = None
-            if hasattr(self.et, "profile_program"):
-                stats = self.et.profile_program(state, batch)
-            bsz = 0
-            seq = 0
-            tok = batch.get("tokens") if isinstance(batch, dict) else None
-            if tok is not None and getattr(tok, "ndim", 0) >= 2:
-                # train_data yields GLOBAL batches (class docstring);
-                # the per-host share is what the master's resource
-                # estimates need
-                bsz = int(tok.shape[0]) // max(jax.process_count(), 1)
-                seq = int(tok.shape[1])
-            self._mc.report_model_info(
-                num_params=num_params,
-                flops_per_step=stats.flops if stats else 0.0,
-                batch_size_per_host=bsz,
-                seq_len=seq,
-                program_stats=stats.to_json() if stats else "",
-            )
-        except Exception:  # noqa: BLE001 — stats must never kill training
-            logger.debug("model info report failed", exc_info=True)
+
+        def _profile_and_report():
+            try:
+                params = (
+                    state.get("params")
+                    if isinstance(state, dict)
+                    else state
+                )
+                leaves = jax.tree_util.tree_leaves(params)
+                num_params = int(
+                    sum(
+                        int(np.prod(x.shape))
+                        for x in leaves
+                        if hasattr(x, "shape")
+                    )
+                )
+                stats = None
+                if hasattr(self.et, "profile_program"):
+                    stats = self.et.profile_program(state, batch)
+                bsz = 0
+                seq = 0
+                tok = (
+                    batch.get("tokens")
+                    if isinstance(batch, dict)
+                    else None
+                )
+                if tok is not None and getattr(tok, "ndim", 0) >= 2:
+                    # train_data yields GLOBAL batches (class
+                    # docstring); the per-host share is what the
+                    # master's resource estimates need
+                    bsz = int(tok.shape[0]) // max(
+                        jax.process_count(), 1
+                    )
+                    seq = int(tok.shape[1])
+                # cost_analysis reports the PER-DEVICE partitioned
+                # program; scale to per-host to match
+                # batch_size_per_host (the servicer derives
+                # flops_per_token from the pair)
+                flops_host = (
+                    stats.flops * jax.local_device_count()
+                    if stats
+                    else 0.0
+                )
+                self._mc.report_model_info(
+                    num_params=num_params,
+                    flops_per_step=flops_host,
+                    batch_size_per_host=bsz,
+                    seq_len=seq,
+                    program_stats=stats.to_json() if stats else "",
+                )
+            except Exception:  # noqa: BLE001 — never kill training
+                logger.debug("model info report failed", exc_info=True)
+
+        import threading
+
+        threading.Thread(
+            target=_profile_and_report,
+            name="model-info-report",
+            daemon=True,
+        ).start()
 
     # -- checkpoint --------------------------------------------------------
 

@@ -291,6 +291,13 @@ class TestModelInfoReport:
             master_client=mc,
         )
         tr.train()
+        # the profile+report runs on a daemon thread (a second XLA
+        # compile must not stall training) — wait for it
+        import time as _time
+
+        deadline = _time.monotonic() + 60
+        while not mc.model_infos and _time.monotonic() < deadline:
+            _time.sleep(0.05)
         assert len(mc.model_infos) == 1  # one-shot, not per step
         info = mc.model_infos[0]
         assert info["num_params"] > 0
