@@ -48,6 +48,15 @@ class LlamaConfig:
     remat_policy: str = "full"
     attn_impl: str = "auto"            # auto | flash | reference
     seq_parallel: str = "none"         # none | ring | ulysses
+    # chunked fused cross-entropy: never materializes [B,S,V] logits
+    # (ops/fused_ce.py). Auto-disabled under sequence parallelism
+    # (chunking the seq dim conflicts with a sharded seq axis).
+    # Default OFF pending real-TPU timing: r3's measurement attempts
+    # hit tunnel outages, so the compile/step cost on hardware is
+    # unproven; numerics + memory behavior are covered by
+    # test_fused_ce.py. Flip on per-config where HBM is the binding
+    # constraint.
+    fused_ce: bool = False
     tie_embeddings: bool = False
     # MoE (0 experts = dense MLP). Experts shard on the "expert" mesh axis.
     n_experts: int = 0
@@ -290,9 +299,12 @@ def apply(
     mesh=None,
     positions: Optional[jax.Array] = None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Forward pass: tokens [B, S] int32 → logits [B, S, vocab] f32.
-    With return_aux, also returns the summed per-layer MoE aux loss."""
+    With return_aux, also returns the summed per-layer MoE aux loss.
+    With return_hidden, returns post-final-norm hidden states [B,S,D]
+    instead of logits (fused-CE path)."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
@@ -344,15 +356,24 @@ def apply(
         x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
 
     x = _rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
-    if cfg.tie_embeddings:
-        head = params["embed"]["weight"].astype(cfg.dtype).T
-    else:
-        head = params["lm_head"]["weight"].astype(cfg.dtype)
+    if return_hidden:
+        # pre-head hidden states for the fused-CE loss path (the
+        # [B,S,V] logits are never formed there)
+        if return_aux:
+            return x, jnp.sum(aux_per_layer)
+        return x
+    head = _head_matrix(cfg, params)
     logits = (x @ head).astype(jnp.float32)
     logits = constrain(logits, mesh, ("data", "fsdp"), "seq", "tensor")
     if return_aux:
         return logits, jnp.sum(aux_per_layer)
     return logits
+
+
+def _head_matrix(cfg: LlamaConfig, params: Params) -> jax.Array:
+    if cfg.tie_embeddings:
+        return params["embed"]["weight"].astype(cfg.dtype).T
+    return params["lm_head"]["weight"].astype(cfg.dtype)
 
 
 def loss_fn(
@@ -363,23 +384,39 @@ def loss_fn(
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Next-token cross entropy. batch: tokens [B,S], optional loss_mask."""
     tokens = batch["tokens"]
-    logits, aux = apply(
-        cfg, params, tokens[:, :-1], mesh=mesh, return_aux=True
-    )
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(
-        logp, targets[..., None], axis=-1
-    ).squeeze(-1)
     mask = batch.get("loss_mask")
-    if mask is not None:
-        mask = mask[:, 1:].astype(nll.dtype)
-        total = jnp.maximum(mask.sum(), 1.0)
-        loss = (nll * mask).sum() / total
-        weight = total
+    use_fused = cfg.fused_ce and cfg.seq_parallel == "none"
+    if use_fused:
+        from dlrover_tpu.ops.fused_ce import fused_cross_entropy
+
+        hidden, aux = apply(
+            cfg, params, tokens[:, :-1], mesh=mesh,
+            return_aux=True, return_hidden=True,
+        )
+        head = _head_matrix(cfg, params)
+        m = mask[:, 1:] if mask is not None else None
+        loss_sum, weight = fused_cross_entropy(
+            hidden, head, targets, m
+        )
+        weight = jnp.maximum(weight, 1.0)
+        loss = loss_sum / weight
     else:
-        loss = nll.mean()
-        weight = jnp.asarray(nll.size, jnp.float32)
+        logits, aux = apply(
+            cfg, params, tokens[:, :-1], mesh=mesh, return_aux=True
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets[..., None], axis=-1
+        ).squeeze(-1)
+        if mask is not None:
+            m = mask[:, 1:].astype(nll.dtype)
+            total = jnp.maximum(m.sum(), 1.0)
+            loss = (nll * m).sum() / total
+            weight = total
+        else:
+            loss = nll.mean()
+            weight = jnp.asarray(nll.size, jnp.float32)
     metrics = {"loss": loss, "loss_weight": weight}
     if cfg.n_experts > 0:
         loss = loss + aux

@@ -1,0 +1,192 @@
+"""Memory-efficient fused cross-entropy over a large vocabulary.
+
+Reference parity: atorch/atorch/modules/transformer/cross_entropy.py:338
+(fused CE CUDA kernel imported from flash-attn). TPU redesign: no kernel
+needed — the win is a *schedule*, chunking the sequence dim so the
+[B, S, V] logits tensor is never materialized. Per chunk we compute
+logits on the MXU, reduce them to (logsumexp, target-logit) — O(B*S)
+residuals instead of O(B*S*V) — and the custom VJP recomputes each
+chunk's logits in the backward to form (softmax - onehot) locally.
+
+Cost model vs the naive path on the bench config (B8 S2048 V32k D1024):
+naive materializes ~2.1 GB of f32 logits and reads them twice more
+(log_softmax + gather, then backward); fused keeps peak activation at
+2.1/GB/nc per chunk and trades that traffic for one extra head matmul
+in the backward (the same trade remat makes). HBM freed also unlocks
+larger per-chip batches.
+
+Sharding: chunking splits the SEQ dim with static shapes, which
+composes with data/fsdp/tensor sharding under GSPMD. It conflicts with
+a SHARDED seq axis (sequence parallelism) — callers gate on that
+(models/llama.py loss_fn uses it only when seq_parallel == "none").
+"""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_count(s: int, target: int = 256) -> int:
+    """Number of `target`-sized chunks covering `s` (the tail chunk of
+    `s % target` tokens is processed separately — next-token training
+    always sees S-1 lengths like 2047, which no equal split covers)."""
+    return max(s // target, 1) if s > target else 1
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_cross_entropy(
+    x: jax.Array,        # [B, S, D] final hidden states (pre-head)
+    head: jax.Array,     # [D, V]
+    targets: jax.Array,  # [B, S] int32
+    mask: Optional[jax.Array],  # [B, S] float or None
+    num_chunks: int = 0,  # 0 = auto
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (sum of masked token NLLs, sum of mask weights).
+
+    Callers divide for the mean so the masked/unmasked paths share one
+    formula (mask=None means all ones)."""
+    loss, weight, _, _, _, _ = _forward(
+        x, head, targets, mask, num_chunks
+    )
+    return loss, weight
+
+
+def _layout(s, num_chunks):
+    """(nc, cs, tail): `nc` scan chunks of `cs` tokens + a `tail`-token
+    remainder processed once — covers ANY length (next-token training
+    always sees S-1, e.g. 2047, which no equal split divides)."""
+    if num_chunks:
+        cs = max(s // num_chunks, 1)
+        nc = s // cs
+    else:
+        nc = _chunk_count(s)
+        cs = s // nc
+    return nc, cs, s - nc * cs
+
+
+def _split(x, nc, cs):
+    b = x.shape[0]
+    main = x[:, : nc * cs]
+    return main.reshape(b, nc, cs, *x.shape[2:]).swapaxes(0, 1)
+
+
+def _chunk_fwd(x_c, head, t_c, m_c):
+    """(nll sums, weight, lse) of one chunk; logits live only here."""
+    logits = jnp.dot(
+        x_c, head, preferred_element_type=jnp.float32
+    )  # [B, sc, V]
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(
+        logits, t_c[..., None].astype(jnp.int32), axis=-1
+    ).squeeze(-1)
+    nll = lse - tgt
+    if m_c is not None:
+        m32 = m_c.astype(jnp.float32)
+        return jnp.sum(nll * m32), jnp.sum(m32), lse
+    return jnp.sum(nll), jnp.asarray(nll.size, jnp.float32), lse
+
+
+def _forward(x, head, targets, mask, num_chunks):
+    b, s, d = x.shape
+    nc, cs, tail = _layout(s, num_chunks)
+    xc = _split(x, nc, cs)            # [nc, B, cs, D]
+    tc = _split(targets, nc, cs)      # [nc, B, cs]
+    mc = _split(mask, nc, cs) if mask is not None else None
+
+    def chunk(carry, inp):
+        loss_acc, w_acc = carry
+        if mc is not None:
+            x_c, t_c, m_c = inp
+        else:
+            (x_c, t_c), m_c = inp, None
+        dl, dw, lse = _chunk_fwd(x_c, head, t_c, m_c)
+        return (loss_acc + dl, w_acc + dw), lse
+
+    ins = (xc, tc, mc) if mc is not None else (xc, tc)
+    (loss, weight), lses = jax.lax.scan(
+        chunk, (jnp.float32(0.0), jnp.float32(0.0)), ins
+    )
+    tail_lse = None
+    if tail:
+        dl, dw, tail_lse = _chunk_fwd(
+            x[:, nc * cs:], head, targets[:, nc * cs:],
+            mask[:, nc * cs:] if mask is not None else None,
+        )
+        loss, weight = loss + dl, weight + dw
+    return loss, weight, lses, tail_lse, nc, cs
+
+
+def _fwd(x, head, targets, mask, num_chunks):
+    loss, weight, lses, tail_lse, nc, cs = _forward(
+        x, head, targets, mask, num_chunks
+    )
+    return (loss, weight), (
+        x, head, targets, mask, lses, tail_lse, nc, cs,
+    )
+
+
+def _chunk_bwd(x_c, head, t_c, lse_c, m_c, g_loss):
+    """Recompute one chunk's logits, form (softmax - onehot) locally."""
+    logits = jnp.dot(
+        x_c, head, preferred_element_type=jnp.float32
+    )
+    p = jnp.exp(logits - lse_c[..., None])  # softmax [B, sc, V]
+    onehot = jax.nn.one_hot(
+        t_c, logits.shape[-1], dtype=jnp.float32
+    )
+    dlogits = p - onehot
+    if m_c is not None:
+        dlogits = dlogits * m_c.astype(jnp.float32)[..., None]
+    dlogits = dlogits * g_loss
+    dx_c = jnp.dot(
+        dlogits.astype(x_c.dtype),
+        head.T,
+        preferred_element_type=jnp.float32,
+    ).astype(x_c.dtype)
+    dhead = jnp.einsum(
+        "bsd,bsv->dv", x_c.astype(jnp.float32), dlogits
+    )
+    return dx_c, dhead
+
+
+def _bwd(num_chunks, res, g):
+    x, head, targets, mask, lses, tail_lse, nc, cs = res
+    g_loss, _ = g  # weight is a count — no useful cotangent
+    b, s, d = x.shape
+    xc = _split(x, nc, cs)
+    tc = _split(targets, nc, cs)
+    mc = _split(mask, nc, cs) if mask is not None else None
+
+    def chunk(dhead_acc, inp):
+        if mc is not None:
+            x_c, t_c, lse_c, m_c = inp
+        else:
+            (x_c, t_c, lse_c), m_c = inp, None
+        dx_c, dh = _chunk_bwd(x_c, head, t_c, lse_c, m_c, g_loss)
+        return dhead_acc + dh, dx_c
+
+    ins = (xc, tc, lses, mc) if mc is not None else (xc, tc, lses)
+    dhead, dxc = jax.lax.scan(
+        chunk, jnp.zeros(head.shape, jnp.float32), ins
+    )
+    dx_main = dxc.swapaxes(0, 1).reshape(b, nc * cs, d)
+    if tail_lse is not None:
+        dx_tail, dh_tail = _chunk_bwd(
+            x[:, nc * cs:], head, targets[:, nc * cs:], tail_lse,
+            mask[:, nc * cs:] if mask is not None else None, g_loss,
+        )
+        dhead = dhead + dh_tail
+        dx = jnp.concatenate([dx_main, dx_tail], axis=1)
+    else:
+        dx = dx_main
+    return (
+        dx,
+        dhead.astype(head.dtype),
+        None,
+        None,
+    )
+
+
+fused_cross_entropy.defvjp(_fwd, _bwd)
