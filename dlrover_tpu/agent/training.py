@@ -198,15 +198,47 @@ class ElasticTrainingAgent:
     # ---- heartbeats ------------------------------------------------------
 
     def _heartbeat_loop(self):
+        master_session = ""
         while not self._stop.is_set():
             try:
                 resp = self.client.report_heart_beat()
                 if resp.action == "stop":
                     logger.info("master requested stop")
                     self._stop.set()
+                session = getattr(resp, "master_session", "")
+                if session and session != master_session:
+                    if master_session:
+                        # a DIFFERENT master answered: the old one died
+                        # and the platform relaunched it with empty
+                        # state — put this node back on its books
+                        logger.warning(
+                            "master restarted (session %s -> %s); "
+                            "re-registering",
+                            master_session,
+                            session,
+                        )
+                    # re-register on the FIRST observed session too:
+                    # the master may have restarted between our
+                    # register_node() and this heartbeat (registration
+                    # is idempotent, so the common case costs one RPC)
+                    self._on_master_restart()
+                    master_session = session
             except Exception:  # noqa: BLE001
                 logger.warning("heartbeat failed", exc_info=True)
             self._stop.wait(JobConstant.HEARTBEAT_INTERVAL_SECS)
+
+    def _on_master_restart(self):
+        """Re-establish this agent's state on a fresh master: node
+        registration + live status. Worker-held state re-flows on its
+        own (sharding clients re-register datasets on unknown-dataset
+        replies; rendezvous re-forms on the next membership change)."""
+        try:
+            self.client.register_node()
+            if self.worker is not None and self.worker.poll() is None:
+                self.client.report_node_status(NodeStatus.RUNNING)
+        except Exception:  # noqa: BLE001
+            logger.warning("master-restart re-register failed",
+                           exc_info=True)
 
     def _start_heartbeats(self):
         if self._heartbeat_thread is None:

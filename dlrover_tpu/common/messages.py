@@ -91,6 +91,10 @@ class HeartbeatResponse:
 
     action: str = ""
     action_args: Dict = field(default_factory=dict)
+    # identity of the serving master process: a changed value between
+    # heartbeats means the master restarted (empty in-memory state) and
+    # the agent must re-register itself + its state
+    master_session: str = ""
 
 
 @dataclass
@@ -276,6 +280,11 @@ class DatasetTask:
     shard_end: int = 0
     task_type: str = "train"
     epoch: int = 0
+    # False when the master does not know the dataset at all — a
+    # restarted master with empty state, NOT an exhausted dataset.
+    # Clients re-register the dataset + restore their shard checkpoint
+    # instead of treating it as end-of-data.
+    dataset_known: bool = True
 
     @property
     def exists(self) -> bool:

@@ -57,6 +57,12 @@ class MasterServicer(MasterServicerBase):
             "network-check": NetworkCheckRendezvousManager(),
         }
         self.paral_config = msg.ParallelConfig()
+        # identity of this master process, piggybacked on heartbeat
+        # replies: agents detect master restarts (state loss) by the
+        # session change and re-register (agent/training.py)
+        import uuid
+
+        self.session_id = uuid.uuid4().hex[:12]
         from dlrover_tpu.master.stats import JobMetricCollector
 
         self.metric_collector = JobMetricCollector(job_name=job_name)
@@ -99,6 +105,12 @@ class MasterServicer(MasterServicerBase):
     def get(self, env: Envelope) -> ReplyEnvelope:
         req = env.payload
         if isinstance(req, msg.GetDatasetTask):
+            if self.task_manager.get_dataset(req.dataset_name) is None:
+                # unknown ≠ exhausted: a restarted master has no
+                # datasets — the client must re-register, not stop
+                return ReplyEnvelope(
+                    payload=msg.DatasetTask(dataset_known=False)
+                )
             task = self.task_manager.get_task(
                 req.node_id, req.dataset_name
             )
@@ -271,7 +283,11 @@ class MasterServicer(MasterServicerBase):
             self.node_manager.report_heartbeat(
                 req.node_type, req.node_id, req.timestamp
             )
-            return ReplyEnvelope(payload=msg.HeartbeatResponse())
+            return ReplyEnvelope(
+                payload=msg.HeartbeatResponse(
+                    master_session=self.session_id
+                )
+            )
         if isinstance(req, msg.GlobalStep):
             self.speed_monitor.collect_worker_step(
                 req.node_id, req.step, req.timestamp

@@ -40,6 +40,11 @@ class DatasetManager:
         self._doing: Dict[int, _PendingTask] = {}
         self._next_task_id = 0
         self._completed = 0
+        # restore bookkeeping: tasks issued since the last applied
+        # restore + whether any restore ever applied (see
+        # restore_checkpoint's staleness rule)
+        self._tasks_issued = 0
+        self._restore_count = 0
         self._lock = threading.Lock()
 
     # ---- queue ops -------------------------------------------------------
@@ -71,6 +76,7 @@ class DatasetManager:
             self._doing[task.task_id] = _PendingTask(
                 task, node_id, time.time()
             )
+            self._tasks_issued += 1
             return task
 
     def report_task(self, task_id: int, success: bool) -> bool:
@@ -142,8 +148,20 @@ class DatasetManager:
                 "todo_shards": shards,
             }
 
-    def restore_checkpoint(self, state: Dict):
+    def restore_checkpoint(self, state: Dict) -> bool:
+        """Rebuild the queues from a snapshot. The FIRST restore always
+        applies (requeues in-flight shards — the roundtrip/resume use).
+        After that, a restore only applies while no tasks have been
+        issued since the last applied one: after a master restart the
+        first recovering worker's restore wins, and peers' stale
+        restores are ignored — otherwise each would wipe `_doing` and
+        re-issue everything the others just processed. Returns whether
+        the restore was applied."""
         with self._lock:
+            if self._restore_count and self._tasks_issued:
+                return False
+            self._restore_count += 1
+            self._tasks_issued = 0
             self._todo = []
             self._doing = {}
             self.splitter.epoch = state.get("epoch", 0)
@@ -159,6 +177,7 @@ class DatasetManager:
                     )
                 )
                 self._next_task_id += 1
+            return True
 
 
 class TaskManager:

@@ -18,6 +18,7 @@ here is the data-parallel shard count of the mesh, not torch ranks.
 """
 
 import threading
+import time
 from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -53,7 +54,15 @@ class ShardingClient:
         self._node_id = node_id
         self._current = None
         self._lock = threading.Lock()
-        self._mc.report_dataset_params(
+        # kept for master-restart recovery: a restarted master has no
+        # datasets; the client re-registers with these params and
+        # restores the last pulled shard checkpoint (shards acked since
+        # the last pull are replayed — the same at-least-once semantics
+        # shard recovery gives dead workers). The pull is TIME-bounded,
+        # not per-ack: the snapshot serializes the whole remaining todo
+        # list under the master's dataset lock, so per-ack pulls would
+        # scale master load with fleet size for a rarely-read value.
+        self._params = dict(
             dataset_name=dataset_name,
             dataset_size=dataset_size,
             shard_size=shard_size,
@@ -61,10 +70,26 @@ class ShardingClient:
             shuffle=shuffle,
             storage_type=storage_type,
         )
+        self.checkpoint_interval_s = 30.0  # min seconds between pulls
+        self._last_ckpt_pull = 0.0
+        self._cached_checkpoint = ""
+        self._mc.report_dataset_params(**self._params)
+
+    def _recover_master_state(self):
+        """The master lost this dataset (restart): re-register and
+        restore the last pulled shard checkpoint."""
+        self._mc.report_dataset_params(**self._params)
+        if self._cached_checkpoint:
+            self._mc.restore_shard_checkpoint(
+                self._name, self._cached_checkpoint
+            )
 
     def fetch_shard(self):
         """Next shard task or None when the dataset is exhausted."""
         task = self._mc.get_task(self._name)
+        if not getattr(task, "dataset_known", True):
+            self._recover_master_state()
+            task = self._mc.get_task(self._name)
         if not task.exists:
             return None
         with self._lock:
@@ -78,6 +103,15 @@ class ShardingClient:
             self._current = None
         if task_id is not None and task_id >= 0:
             self._mc.report_task_result(self._name, task_id, success)
+            now = time.monotonic()
+            if now - self._last_ckpt_pull >= self.checkpoint_interval_s:
+                self._last_ckpt_pull = now
+                try:
+                    self._cached_checkpoint = (
+                        self._mc.get_shard_checkpoint(self._name)
+                    )
+                except Exception:  # noqa: BLE001 — stale cache is fine
+                    pass
 
     def shard_checkpoint(self) -> str:
         return self._mc.get_shard_checkpoint(self._name)
