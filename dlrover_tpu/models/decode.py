@@ -1,0 +1,203 @@
+"""KV-cache autoregressive decoding for the Llama family.
+
+Reference parity: the serving path the reference delegates to vLLM
+(atorch/rl/inference_backend/vllm_backend.py) and the incremental decode
+TFPlus's fmha skips (flash_attention.h:161 is training-only, like ours).
+TPU redesign: one jittable step with STATIC shapes — the cache is a
+fixed [L, B, M, KV, hd] buffer, each step writes position `pos` via
+dynamic_update_slice and attends over the full buffer under a position
+mask. O(M) attention per token instead of the O(P+t) re-forward
+rl/generate.py does; `lax.scan` drives the whole generation in one
+compiled program.
+
+Prefill and decode share `_block` (S=P vs S=1) so there is exactly one
+attention/cache implementation to keep correct.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.llama import (
+    LlamaConfig,
+    _attn_qkv,
+    _attn_residual,
+    _compute_weights,
+    _head_matrix,
+    _mlp_residual,
+    _rms_norm,
+)
+
+Params = Dict
+
+
+def init_kv_cache(
+    cfg: LlamaConfig, batch: int, max_len: int
+) -> Dict[str, jax.Array]:
+    """Fixed-size cache buffers; dtype follows compute dtype."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _cached_attention(q, k_cache, v_cache, q_positions, scale):
+    """q [B,S,H,hd] attends over the whole cache [B,M,KV,hd] under the
+    causal position mask (cache col j visible to query at position p
+    iff j <= p). Unwritten cache slots are masked out by the same rule.
+    GQA runs as a grouped einsum against the UNEXPANDED cache — no
+    n_rep-times repeat of the K/V buffers per step."""
+    b, s, h, hd = q.shape
+    m = k_cache.shape[1]
+    kv = k_cache.shape[2]
+    n_rep = h // kv
+    qg = q.reshape(b, s, kv, n_rep, hd)
+    scores = jnp.einsum(
+        "bskrd,bmkd->bkrsm", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    cols = jnp.arange(m)[None, None, None, None, :]   # [1,1,1,1,M]
+    rows = q_positions[:, None, None, :, None]        # [B,1,1,S,1]
+    scores = jnp.where(cols <= rows, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkrsm,bmkd->bskrd", p, v_cache)
+    return out.reshape(b, s, h, hd)
+
+
+def _block(
+    cfg: LlamaConfig,
+    x: jax.Array,            # [B, S, D]
+    layer_params: Params,
+    k_cache: jax.Array,      # [B, M, KV, hd]
+    v_cache: jax.Array,
+    positions: jax.Array,    # [B, S] global positions of x's tokens
+    start,                   # scalar: cache slot of x's first token
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder block writing its K/V into the cache. Prefill is
+    S=prompt_len/start=0; decode is S=1/start=pos. The projections,
+    RoPE, residuals and MLP are llama._layer's own helpers — the cache
+    write + position-masked attention are the only decode-specific
+    parts."""
+    lp = _compute_weights(cfg, layer_params)
+    h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
+    q, k, v = _attn_qkv(cfg, None, h, lp, positions)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
+    )
+    attn = _cached_attention(
+        q, k_cache, v_cache, positions, float(cfg.head_dim) ** -0.5
+    )
+    x = _attn_residual(cfg, None, x, attn, lp)
+    x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
+    return x, k_cache, v_cache
+
+
+def _forward_cached(cfg, params, tokens, cache, positions, start):
+    """tokens [B,S] → logits [B,S,V], writing the cache at
+    [start, start+S)."""
+    x = params["embed"]["weight"].astype(cfg.dtype)[tokens]
+
+    def body(carry, inp):
+        h = carry
+        layer_params, kc, vc = inp
+        h, kc, vc = _block(
+            cfg, h, layer_params, kc, vc, positions, start
+        )
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = _rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
+    logits = (x @ _head_matrix(cfg, params)).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def prefill(
+    cfg: LlamaConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, P]
+    cache: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Fill the cache from a prompt; returns (last-token logits, cache)."""
+    b, p = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(p), (b, p))
+    logits, cache = _forward_cached(
+        cfg, params, tokens, cache, positions, 0
+    )
+    return logits[:, -1], cache
+
+
+def decode_step(
+    cfg: LlamaConfig,
+    params: Params,
+    token: jax.Array,   # [B] current token
+    cache: Dict[str, jax.Array],
+    pos,                # scalar int: position of `token`
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One cached step → (next-token logits [B,V], updated cache)."""
+    b = token.shape[0]
+    positions = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32), (b, 1)
+    )
+    logits, cache = _forward_cached(
+        cfg, params, token[:, None], cache, positions, pos
+    )
+    return logits[:, 0], cache
+
+
+def generate(
+    cfg: LlamaConfig,
+    params: Params,
+    prompt: jax.Array,      # [B, P]
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Greedy / temperature sampling with the KV cache; one compiled
+    scan drives all steps. Returns [B, P + max_new_tokens]."""
+    b, p = prompt.shape
+    m = max_len or (p + max_new_tokens)
+    if m < p + max_new_tokens:
+        raise ValueError(
+            f"max_len {m} < prompt {p} + new {max_new_tokens}"
+        )
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = init_kv_cache(cfg, b, m)
+    logits, cache = prefill(cfg, params, prompt, cache)
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            key, logits / temperature
+        ).astype(prompt.dtype)
+
+    # single-use key discipline: the first draw gets its own subkey,
+    # never the key the scan derives the rest from
+    key, first_key = jax.random.split(key)
+    first = sample(logits, first_key)
+
+    def step(carry, t):
+        token, cache, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = decode_step(
+            cfg, params, token, cache, p + t
+        )
+        nxt = sample(logits, sub)
+        return (nxt, cache, key), token
+
+    (_, _, _), out_tokens = jax.lax.scan(
+        step, (first, cache, key), jnp.arange(max_new_tokens)
+    )
+    # out_tokens [N, B] are the tokens fed at steps 0..N-1, i.e. the
+    # sampled continuations shifted by one — collect them in order
+    gen = out_tokens.swapaxes(0, 1)  # [B, N]
+    return jnp.concatenate([prompt, gen], axis=1)

@@ -223,19 +223,22 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
-    """One decoder block on [B, S, D] activations."""
-    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    b, s, _ = x.shape
-    # matmul weights compute in bf16 on the MXU; norms stay in
-    # param dtype (_rms_norm does its own f32 math)
-    lp = {
+def _compute_weights(cfg: LlamaConfig, layer_params) -> Dict:
+    """Matmul weights cast to the compute dtype; norms stay in param
+    dtype (_rms_norm does its own f32 math)."""
+    return {
         k: v.astype(cfg.dtype)
         for k, v in layer_params.items()
         if not k.endswith("_norm")
     }
 
-    h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
+
+def _attn_qkv(cfg: LlamaConfig, mesh, h, lp, positions):
+    """Projections + RoPE of one block — shared by the training layer
+    and the KV-cache decoder (models/decode.py), so there is exactly
+    one definition of the attention inputs."""
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    b, s, _ = h.shape
     q = checkpoint_name((h @ lp["wq"]).reshape(b, s, H, hd), "qkv_proj")
     k = checkpoint_name((h @ lp["wk"]).reshape(b, s, KV, hd), "qkv_proj")
     v = checkpoint_name((h @ lp["wv"]).reshape(b, s, KV, hd), "qkv_proj")
@@ -244,28 +247,24 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
     v = constrain(v, mesh, ("data", "fsdp"), "seq", "tensor", None)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    sp_live = (
-        mesh is not None
-        and cfg.seq_parallel != "none"
-        and dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1)
-        > 1
-    )
-    if sp_live:
-        from dlrover_tpu.parallel.sequence import sp_attention
+    return q, k, v
 
-        attn = sp_attention(
-            q, k, v, mesh, mode=cfg.seq_parallel, causal=True
-        )
-    else:
-        attn = dot_product_attention(
-            q, k, v, causal=True, impl=cfg.attn_impl
-        )
-    attn = checkpoint_name(attn.reshape(b, s, H * hd), "attn_out")
-    x = x + constrain(
+
+def _attn_residual(cfg: LlamaConfig, mesh, x, attn, lp):
+    """Output projection + residual (shared with decode)."""
+    b, s, _ = x.shape
+    attn = checkpoint_name(
+        attn.reshape(b, s, cfg.n_heads * cfg.head_dim), "attn_out"
+    )
+    return x + constrain(
         checkpoint_name(attn @ lp["wo"], "attn_proj"),
         mesh, ("data", "fsdp"), "seq", None,
     )
 
+
+def _mlp_residual(cfg: LlamaConfig, mesh, x, layer_params, lp):
+    """Dense-SwiGLU / MoE feed-forward + residual (shared with decode).
+    Returns (x, moe aux loss — zero for dense)."""
     h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
     if cfg.n_experts > 0:
         from dlrover_tpu.models.moe import moe_mlp
@@ -290,6 +289,31 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
         mesh, ("data", "fsdp"), "seq", None,
     )
     return x, jnp.zeros((), jnp.float32)
+
+
+def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
+    """One decoder block on [B, S, D] activations."""
+    lp = _compute_weights(cfg, layer_params)
+    h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
+    q, k, v = _attn_qkv(cfg, mesh, h, lp, positions)
+    sp_live = (
+        mesh is not None
+        and cfg.seq_parallel != "none"
+        and dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1)
+        > 1
+    )
+    if sp_live:
+        from dlrover_tpu.parallel.sequence import sp_attention
+
+        attn = sp_attention(
+            q, k, v, mesh, mode=cfg.seq_parallel, causal=True
+        )
+    else:
+        attn = dot_product_attention(
+            q, k, v, causal=True, impl=cfg.attn_impl
+        )
+    x = _attn_residual(cfg, mesh, x, attn, lp)
+    return _mlp_residual(cfg, mesh, x, layer_params, lp)
 
 
 def apply(

@@ -2,17 +2,43 @@
 
 Reference parity: atorch rl/inference_backend/vllm_backend.py — actor
 rollouts for PPO. TPU design: ONE jitted step function over a padded
-[batch, max_len] token buffer; each decode step runs the full causal
-forward and writes position t (causality makes padding beyond t
-irrelevant), so the program has a single static shape — no recompiles,
-no KV-cache bookkeeping. O(L) full passes is the honest cost here; a
-paged KV-cache decoder is the serving-path optimization."""
+[batch, max_len] token buffer; each decode step writes position t, so
+the program has a single static shape — no recompiles.
+
+Two engines, same semantics (ragged prompts, EOS early-stop masks):
+  sample_tokens        — model-agnostic: full causal re-forward per
+                         step (works with ANY apply_fn);
+  sample_tokens_cached — llama-family KV-cache path
+                         (models/decode.py): O(1) qkv + O(max_len)
+                         attention per step instead of a full forward —
+                         the vLLM-shaped fast path for PPO rollouts."""
 
 from functools import partial
 from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+def _select_next(
+    last_logits, toks, done, k, t, start_pos, temperature, greedy,
+    eos_id,
+):
+    """Shared sampling/EOS/ragged-prompt masking of one step — the ONE
+    definition both engines use, so their semantics cannot drift."""
+    if greedy:
+        nxt = jnp.argmax(last_logits, axis=-1)
+        k2 = k
+    else:
+        k2, sub = jax.random.split(k)
+        nxt = jax.random.categorical(
+            sub, last_logits / jnp.maximum(temperature, 1e-6), axis=-1
+        )
+    gen_here = t >= start_pos  # still inside the prompt? keep it
+    nxt = jnp.where(gen_here & ~done, nxt, toks[:, t])
+    done = done | (gen_here & (nxt == eos_id))
+    toks = toks.at[:, t].set(nxt)
+    return toks, done, k2
 
 
 @partial(
@@ -33,19 +59,10 @@ def _decode(
     def step(carry, t):
         toks, done, k = carry
         logits = apply_fn(params, toks)  # [B, L, V]
-        last = logits[:, t - 1, :]
-        if greedy:
-            nxt = jnp.argmax(last, axis=-1)
-            k2 = k
-        else:
-            k2, sub = jax.random.split(k)
-            nxt = jax.random.categorical(
-                sub, last / jnp.maximum(temperature, 1e-6), axis=-1
-            )
-        gen_here = t >= start_pos  # still inside the prompt? keep it
-        nxt = jnp.where(gen_here & ~done, nxt, toks[:, t])
-        done = done | (gen_here & (nxt == eos_id))
-        toks = toks.at[:, t].set(nxt)
+        toks, done, k2 = _select_next(
+            logits[:, t - 1, :], toks, done, k, t, start_pos,
+            temperature, greedy, eos_id,
+        )
         return (toks, done, k2), None
 
     B = tokens.shape[0]
@@ -78,6 +95,73 @@ def sample_tokens(
         prompt_lens,
         key,
         apply_fn=apply_fn,
+        max_len=max_len,
+        temperature=temperature,
+        greedy=greedy,
+        eos_id=eos_id,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_len", "temperature", "greedy"),
+)
+def _decode_cached(
+    params,
+    tokens,       # [B, max_len]
+    start_pos,    # [B]
+    key,
+    cfg,
+    max_len: int,
+    temperature: float,
+    greedy: bool,
+    eos_id,       # traced (like _decode) — no recompile per tokenizer
+):
+    from dlrover_tpu.models.decode import decode_step, init_kv_cache
+
+    B = tokens.shape[0]
+    cache = init_kv_cache(cfg, B, max_len)
+
+    def step(carry, t):
+        toks, done, k, cache = carry
+        logits, cache = decode_step(
+            cfg, params, toks[:, t - 1], cache, t - 1
+        )
+        toks, done, k2 = _select_next(
+            logits, toks, done, k, t, start_pos,
+            temperature, greedy, eos_id,
+        )
+        return (toks, done, k2, cache), None
+
+    done0 = jnp.zeros((B,), jnp.bool_)
+    (toks, done, _, _), _ = jax.lax.scan(
+        step,
+        (tokens, done0, key, cache),
+        jnp.arange(1, max_len),
+    )
+    return toks, done
+
+
+def sample_tokens_cached(
+    cfg,
+    params,
+    prompts: jax.Array,
+    prompt_lens: jax.Array,
+    max_len: int,
+    key: Optional[jax.Array] = None,
+    temperature: float = 1.0,
+    greedy: bool = False,
+    eos_id: int = -1,
+) -> Tuple[jax.Array, jax.Array]:
+    """sample_tokens semantics on the KV-cache engine (llama-family
+    configs). `cfg` must be hashable (LlamaConfig is frozen)."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    return _decode_cached(
+        params,
+        prompts,
+        prompt_lens,
+        key,
+        cfg=cfg,
         max_len=max_len,
         temperature=temperature,
         greedy=greedy,

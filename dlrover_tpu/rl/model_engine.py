@@ -18,6 +18,10 @@ class ModelSpec:
     apply_fn: Callable  # (params, tokens[B,L]) -> model-specific output
     params: Any
     trainable: bool = False
+    # llama-family config (hashable LlamaConfig) enabling the KV-cache
+    # rollout engine (rl/generate.py sample_tokens_cached); None keeps
+    # the model-agnostic full-forward sampler
+    model_cfg: Any = None
 
 
 class ModelEngine:

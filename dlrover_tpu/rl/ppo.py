@@ -157,16 +157,38 @@ class PpoTrainer:
     ) -> Experience:
         cfg = self.cfg
         eng = self.engine
-        tokens, _ = sample_tokens(
-            eng.actor.apply_fn,
-            eng.actor.params,
-            prompts,
-            prompt_lens,
-            cfg.max_len,
-            key=key,
-            temperature=cfg.temperature,
-            eos_id=self.eos_id,
-        )
+        model_cfg = getattr(eng.actor, "model_cfg", None)
+        if model_cfg is not None:
+            # llama-family actor: KV-cache rollout engine (O(1) qkv per
+            # step instead of a full forward). Greedy outputs are
+            # byte-identical to the generic sampler
+            # (test_decode.py::TestCachedRolloutEngine); under
+            # temperature sampling the engines' logits agree to float
+            # rounding, so individual draws near decision boundaries
+            # may differ — same policy distribution either way
+            from dlrover_tpu.rl.generate import sample_tokens_cached
+
+            tokens, _ = sample_tokens_cached(
+                model_cfg,
+                eng.actor.params,
+                prompts,
+                prompt_lens,
+                cfg.max_len,
+                key=key,
+                temperature=cfg.temperature,
+                eos_id=self.eos_id,
+            )
+        else:
+            tokens, _ = sample_tokens(
+                eng.actor.apply_fn,
+                eng.actor.params,
+                prompts,
+                prompt_lens,
+                cfg.max_len,
+                key=key,
+                temperature=cfg.temperature,
+                eos_id=self.eos_id,
+            )
         logp = eng.actor_logprobs(tokens)         # [B, L-1]
         ref_logp = eng.ref_logprobs(tokens)
         values = eng.values(tokens)[:, :-1]       # [B, L-1]
