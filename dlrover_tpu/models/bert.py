@@ -1,0 +1,258 @@
+"""BERT-family bidirectional encoder, written for pjit.
+
+Reference parity: the encoder models ATorch accelerates with its FA
+adapters (atorch modules/transformer/layers.py `BertAttentionFA` :801 —
+HF BERT with flash attention patched in) and trains under
+auto_accelerate. TPU redesign: same recipe as models/{llama,gpt}.py —
+params as a scanned [L, ...] pytree, partition rules over a
+data/fsdp/tensor mesh, the Pallas flash kernel with `causal=False`
+(bidirectional is the kernel's non-causal path), masked-LM loss with
+f32 reductions.
+
+Padding rides the attention dispatcher's segment_ids (real/pad key
+partition) instead of dynamic shapes — fixed [B, S] batches,
+XLA-friendly; unpadded batches take the flash kernel.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.ops.attention import dot_product_attention
+from dlrover_tpu.parallel.sharding import constrain
+from dlrover_tpu.models.normalization import layer_norm_gb as _layer_norm
+
+Params = Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    max_seq_len: int = 512
+    n_segments: int = 2
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attn_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def base(cls, **kw) -> "BertConfig":
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw) -> "BertConfig":
+        d = dict(dim=1024, n_layers=24, n_heads=16, mlp_dim=4096)
+        d.update(kw)
+        return cls(**d)
+
+    @classmethod
+    def tiny(cls, **kw) -> "BertConfig":
+        d = dict(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4,
+            mlp_dim=128, max_seq_len=64, attn_impl="reference",
+        )
+        d.update(kw)
+        return cls(**d)
+
+
+def init_params(cfg: BertConfig, key: jax.Array) -> Params:
+    L, D, M = cfg.n_layers, cfg.dim, cfg.mlp_dim
+    pd = cfg.param_dtype
+    ks = jax.random.split(key, 10)
+
+    def dense(key, shape, fan_in):
+        return jax.random.normal(key, shape, pd) / math.sqrt(fan_in)
+
+    return {
+        "tok_emb": jax.random.normal(ks[0], (cfg.vocab_size, D), pd) * 0.02,
+        "pos_emb": jax.random.normal(ks[1], (cfg.max_seq_len, D), pd) * 0.01,
+        "seg_emb": jax.random.normal(ks[2], (cfg.n_segments, D), pd) * 0.01,
+        "emb_ln_g": jnp.ones((D,), pd),
+        "emb_ln_b": jnp.zeros((D,), pd),
+        "layers": {
+            "wqkv": dense(ks[3], (L, D, 3 * D), D),
+            "wo": dense(ks[4], (L, D, D), D),
+            "ln1_g": jnp.ones((L, D), pd),
+            "ln1_b": jnp.zeros((L, D), pd),
+            "w_up": dense(ks[5], (L, D, M), D),
+            "b_up": jnp.zeros((L, M), pd),
+            "w_down": dense(ks[6], (L, M, D), M),
+            "b_down": jnp.zeros((L, D), pd),
+            "ln2_g": jnp.ones((L, D), pd),
+            "ln2_b": jnp.zeros((L, D), pd),
+        },
+        # MLM head: transform + LN; decoder tied to tok_emb
+        "mlm_dense": dense(ks[7], (D, D), D),
+        "mlm_ln_g": jnp.ones((D,), pd),
+        "mlm_ln_b": jnp.zeros((D,), pd),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), pd),
+        # [CLS] pooler
+        "pool_w": dense(ks[8], (D, D), D),
+        "pool_b": jnp.zeros((D,), pd),
+    }
+
+
+def partition_rules(cfg: BertConfig):
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"tok_emb$", P("tensor", None)),
+        (r"(pos|seg)_emb$", P(None, None)),
+        (r"layers/wqkv$", P(None, None, "tensor")),
+        (r"layers/wo$", P(None, "tensor", None)),
+        (r"layers/w_up$", P(None, None, "tensor")),
+        (r"layers/b_up$", P(None, "tensor")),
+        (r"layers/w_down$", P(None, "tensor", None)),
+        (r"layers/(ln1|ln2)_", P(None, None)),
+        (r"layers/b_down$", P(None, None)),
+        (r"(emb|mlm)_ln_", P(None)),
+        (r"mlm_dense$", P(None, None)),
+        (r"mlm_bias$", P("tensor")),
+        (r"pool_w$", P(None, None)),
+        (r"pool_b$", P(None)),
+    ]
+
+
+
+
+def _block(cfg: BertConfig, mesh, x, lp, pad_mask):
+    """Post-LN encoder block (BERT convention). Padding rides the
+    attention dispatcher's segment_ids (real=1/pad=0 partitions keys):
+    real tokens never attend to pads; unpadded batches (pad_mask None)
+    take the Pallas flash non-causal path."""
+    H, hd = cfg.n_heads, cfg.head_dim
+    b, s, d = x.shape
+    cd = cfg.dtype
+    qkv = x @ lp["wqkv"].astype(cd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, H, hd)
+    k = k.reshape(b, s, H, hd)
+    v = v.reshape(b, s, H, hd)
+    q = constrain(q, mesh, ("data", "fsdp"), None, "tensor", None)
+    attn = dot_product_attention(
+        q, k, v, causal=False, impl=cfg.attn_impl,
+        segment_ids=pad_mask,
+    )
+    attn = attn.reshape(b, s, H * hd)
+    x = _layer_norm(
+        x + attn @ lp["wo"].astype(cd),
+        lp["ln1_g"], lp["ln1_b"], cfg.norm_eps,
+    )
+    h = jax.nn.gelu(
+        x @ lp["w_up"].astype(cd) + lp["b_up"].astype(cd)
+    )
+    h = constrain(h, mesh, ("data", "fsdp"), None, "tensor")
+    x = _layer_norm(
+        x + (h @ lp["w_down"].astype(cd) + lp["b_down"].astype(cd)),
+        lp["ln2_g"], lp["ln2_b"], cfg.norm_eps,
+    )
+    return x
+
+
+def apply(
+    cfg: BertConfig,
+    params: Params,
+    tokens: jax.Array,                    # [B, S] int32
+    attention_mask: Optional[jax.Array] = None,  # [B, S] 1=real, 0=pad
+    segments: Optional[jax.Array] = None,        # [B, S] int32
+    mesh=None,
+) -> jax.Array:
+    """→ final hidden states [B, S, D] (compute dtype)."""
+    b, s = tokens.shape
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    x = x + params["pos_emb"].astype(cfg.dtype)[None, :s]
+    if segments is not None:
+        x = x + params["seg_emb"].astype(cfg.dtype)[segments]
+    x = _layer_norm(
+        x, params["emb_ln_g"], params["emb_ln_b"], cfg.norm_eps
+    )
+    x = constrain(x, mesh, ("data", "fsdp"), None, None)
+
+    pad_mask = (
+        attention_mask.astype(jnp.int32)
+        if attention_mask is not None
+        else None
+    )
+
+    def body(carry, layer_params):
+        return _block(cfg, mesh, carry, layer_params, pad_mask), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def pool(cfg: BertConfig, params: Params, hidden: jax.Array) -> jax.Array:
+    """[CLS] pooler: tanh(dense(hidden[:, 0])) — sequence-level repr."""
+    cls = hidden[:, 0]
+    return jnp.tanh(
+        cls @ params["pool_w"].astype(cfg.dtype)
+        + params["pool_b"].astype(cfg.dtype)
+    )
+
+
+def mlm_logits(
+    cfg: BertConfig, params: Params, hidden: jax.Array
+) -> jax.Array:
+    """Masked-LM head: transform + LN + tied decoder → [B, S, V] f32."""
+    h = jax.nn.gelu(hidden @ params["mlm_dense"].astype(cfg.dtype))
+    h = _layer_norm(
+        h, params["mlm_ln_g"], params["mlm_ln_b"], cfg.norm_eps
+    )
+    logits = h @ params["tok_emb"].astype(cfg.dtype).T
+    return logits.astype(jnp.float32) + params["mlm_bias"].astype(
+        jnp.float32
+    )
+
+
+def mlm_loss_fn(
+    cfg: BertConfig,
+    params: Params,
+    batch: Dict[str, jax.Array],
+    mesh=None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Masked-LM cross entropy. batch: tokens [B,S] (with [MASK] ids
+    already substituted), labels [B,S] (original ids), mlm_mask [B,S]
+    (1 at masked positions), optional attention_mask / segments."""
+    hidden = apply(
+        cfg,
+        params,
+        batch["tokens"],
+        attention_mask=batch.get("attention_mask"),
+        segments=batch.get("segments"),
+        mesh=mesh,
+    )
+    logits = mlm_logits(cfg, params, hidden)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, batch["labels"][..., None], axis=-1
+    ).squeeze(-1)
+    m = batch["mlm_mask"].astype(jnp.float32)
+    total = jnp.maximum(m.sum(), 1.0)
+    loss = (nll * m).sum() / total
+    return loss, {"loss": loss, "masked_tokens": total}
+
+
+def num_params(cfg: BertConfig) -> int:
+    import numpy as np
+
+    return int(
+        sum(
+            np.prod(x.shape)
+            for x in jax.tree_util.tree_leaves(
+                jax.eval_shape(
+                    lambda k: init_params(cfg, k), jax.random.PRNGKey(0)
+                )
+            )
+        )
+    )

@@ -194,10 +194,14 @@ def generate(
         nxt = sample(logits, sub)
         return (nxt, cache, key), token
 
-    (_, _, _), out_tokens = jax.lax.scan(
-        step, (first, cache, key), jnp.arange(max_new_tokens)
+    # N-1 steps: `first` is token #1 (from the prefill logits); each
+    # step feeds the previous sample and emits it, and the final carry
+    # is token #N — no wasted trailing forward whose sample would be
+    # dropped
+    (last_tok, _, _), out_tokens = jax.lax.scan(
+        step, (first, cache, key), jnp.arange(max_new_tokens - 1)
     )
-    # out_tokens [N, B] are the tokens fed at steps 0..N-1, i.e. the
-    # sampled continuations shifted by one — collect them in order
-    gen = out_tokens.swapaxes(0, 1)  # [B, N]
+    gen = jnp.concatenate(
+        [out_tokens.swapaxes(0, 1), last_tok[:, None]], axis=1
+    )  # [B, N]
     return jnp.concatenate([prompt, gen], axis=1)

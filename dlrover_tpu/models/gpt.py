@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from dlrover_tpu.ops.attention import dot_product_attention
 from dlrover_tpu.parallel.sharding import constrain
+from dlrover_tpu.models.normalization import layer_norm_gb as _layer_norm
 
 Params = Dict[str, Any]
 
@@ -125,14 +126,6 @@ def partition_rules(cfg: GptConfig):
     ]
 
 
-def _layer_norm(x, g, b, eps):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
-    return (y * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(
-        x.dtype
-    )
 
 
 def _block(cfg: GptConfig, mesh, x, lp):

@@ -72,18 +72,25 @@ def init_layer_norm(dim: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
     }
 
 
+def layer_norm_gb(
+    x: jax.Array, g: jax.Array, b: jax.Array, eps: float
+) -> jax.Array:
+    """LayerNorm over the trailing axis, f32 stats — THE functional
+    definition; the encoder stacks (models/gpt.py, models/bert.py) and
+    the params-dict wrapper below all call this one."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    y = y * g.astype(jnp.float32) + b.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
 def layer_norm(
     params: Dict[str, jax.Array], x: jax.Array, eps: float = 1e-5
 ) -> jax.Array:
     """LayerNorm over the trailing axis, f32 stats."""
-    x32 = x.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
-    y = y * params["scale"].astype(jnp.float32) + params[
-        "bias"
-    ].astype(jnp.float32)
-    return y.astype(x.dtype)
+    return layer_norm_gb(x, params["scale"], params["bias"], eps)
 
 
 def init_rms_norm(dim: int, dtype=jnp.float32) -> Dict[str, jax.Array]:

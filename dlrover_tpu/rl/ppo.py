@@ -158,6 +158,14 @@ class PpoTrainer:
         cfg = self.cfg
         eng = self.engine
         model_cfg = getattr(eng.actor, "model_cfg", None)
+        # dense models only: MoE capacity dropping is sequence-length
+        # dependent (GShard capacity = f(S), moe.py), so S=1 decode
+        # logits are NOT the teacher-forced distribution the PPO ratio
+        # uses — cached rollouts would be silently off-policy
+        if model_cfg is not None and getattr(
+            model_cfg, "n_experts", 0
+        ):
+            model_cfg = None
         if model_cfg is not None:
             # llama-family actor: KV-cache rollout engine (O(1) qkv per
             # step instead of a full forward). Greedy outputs are
