@@ -16,7 +16,7 @@ Layer map (mirrors reference SURVEY.md §1, re-architected for TPU):
             elastic data/trainer (reference: dlrover/trainer)
   parallel/ mesh + sharding strategy library — the TPU answer to ATorch's
             auto_accelerate (reference: atorch/atorch/auto)
-  models/   flagship model families (Llama, GPT-2, MoE) written for pjit
+  models/   model families (Llama, GPT-2, MoE, BERT) + KV-cache decoding
   ops/      Pallas TPU kernels: flash attention, ring attention, quant
   common/   typed control-plane messages, RPC, node model, storage
 """
@@ -37,3 +37,39 @@ def shutdown():
     from dlrover_tpu import runtime
 
     return runtime.shutdown()
+
+
+def __getattr__(name):
+    """Lazy top-level API (reference `import atorch; atorch.auto_accelerate`
+    ergonomics) without importing jax at package-import time — the
+    control-plane processes (master, operator, agent) must stay off the
+    TPU runtime."""
+    lazy = {
+        # compute path
+        "accelerate": ("dlrover_tpu.parallel.accelerate", "accelerate"),
+        "Strategy": ("dlrover_tpu.parallel.accelerate", "Strategy"),
+        "MeshSpec": ("dlrover_tpu.parallel.mesh", "MeshSpec"),
+        # trainer surface
+        "Trainer": ("dlrover_tpu.trainer.trainer", "Trainer"),
+        "TrainingArguments": (
+            "dlrover_tpu.trainer.trainer", "TrainingArguments",
+        ),
+        "ElasticTrainer": (
+            "dlrover_tpu.trainer.elastic.trainer", "ElasticTrainer",
+        ),
+        # flash checkpoint
+        "Checkpointer": (
+            "dlrover_tpu.trainer.flash_checkpoint.engine", "Checkpointer",
+        ),
+        "StorageType": (
+            "dlrover_tpu.trainer.flash_checkpoint.engine", "StorageType",
+        ),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(
+        f"module 'dlrover_tpu' has no attribute {name!r}"
+    )

@@ -168,6 +168,8 @@ def generate(
         raise ValueError(
             f"max_len {m} < prompt {p} + new {max_new_tokens}"
         )
+    if max_new_tokens == 0:
+        return prompt
     if key is None:
         key = jax.random.PRNGKey(0)
     cache = init_kv_cache(cfg, b, m)

@@ -145,3 +145,9 @@ class TestCachedRolloutEngine:
         )
         np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
         np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+    def test_zero_new_tokens_returns_prompt(self):
+        cfg = _cfg()
+        params, prompt = _setup(cfg, b=1, p=4)
+        out = generate(cfg, params, prompt, 0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
