@@ -193,6 +193,9 @@ class AsyncCheckpointSaver:
         # check-then-write below must not interleave (a stale reader
         # could regress the tracker to an older step)
         self._commit_lock = threading.Lock()
+        # (checkpoint_dir, max_to_keep) of the installed retention
+        # strategy — see _handle_event
+        self._retention = (None, 0)
         self.last_persisted_step = -1
 
     # ---- lifecycle -------------------------------------------------------
@@ -250,6 +253,22 @@ class AsyncCheckpointSaver:
     def _handle_event(self, event: dict):
         step = event["step"]
         path = event["path"]
+        # deletion policy rides the event (the trainer owns the config,
+        # this saver process owns the storage doing the commits):
+        # save_total_limit → keep only the newest N step dirs. The
+        # saver outlives trainer restarts, so re-install whenever the
+        # dir or limit changes (a stale strategy would prune the WRONG
+        # directory and ignore limit updates).
+        max_to_keep = int(event.get("max_to_keep", 0) or 0)
+        if max_to_keep > 0 and self._retention != (path, max_to_keep):
+            from dlrover_tpu.common.storage import (
+                KeepLatestStepStrategy,
+            )
+
+            self.storage.deletion_strategy = KeepLatestStepStrategy(
+                max_to_keep, path
+            )
+            self._retention = (path, max_to_keep)
         t0 = time.monotonic()
         self.save_step_checkpoint(step, path)
         logger.info(

@@ -18,12 +18,22 @@ class CheckpointDeletionStrategy:
 
 
 class KeepLatestStepStrategy(CheckpointDeletionStrategy):
-    """Keep only the newest `max_to_keep` step directories."""
+    """Keep only the newest `max_to_keep` step directories.
+
+    Pre-existing step dirs are counted from construction (a resumed job
+    after an agent restart must still converge to the limit, not keep
+    the old run's dirs forever)."""
 
     def __init__(self, max_to_keep: int = 3, checkpoint_dir: str = ""):
         self.max_to_keep = max(1, max_to_keep)
         self.checkpoint_dir = checkpoint_dir
         self._steps: List[int] = []
+        if checkpoint_dir and os.path.isdir(checkpoint_dir):
+            self._steps = sorted(
+                int(d)
+                for d in os.listdir(checkpoint_dir)
+                if d.isdigit()
+            )
 
     def clean_up(self, step: int, delete_func):
         if step in self._steps:
@@ -51,6 +61,11 @@ class CheckpointStorage:
     """write/read/listdir/exists/commit — the agent saver and the trainer
     engines only speak this interface, so GCS/other backends drop in."""
 
+    # retention policy applied on successful commits; part of the
+    # interface so every backend carries the attribute (the saver
+    # installs it from trainer config — see ckpt_saver._handle_event)
+    deletion_strategy: Optional[CheckpointDeletionStrategy] = None
+
     def write(self, content, path: str):
         raise NotImplementedError
 
@@ -70,7 +85,11 @@ class CheckpointStorage:
         raise NotImplementedError
 
     def commit(self, step: int, success: bool):
-        """Hook called after a step's files are fully persisted."""
+        """Hook called after a step's files are fully persisted —
+        applies the retention policy (any backend with a working
+        `delete` gets it for free)."""
+        if success and self.deletion_strategy is not None:
+            self.deletion_strategy.clean_up(step, self.delete)
 
 
 class PosixDiskStorage(CheckpointStorage):
@@ -113,11 +132,6 @@ class PosixDiskStorage(CheckpointStorage):
             shutil.rmtree(path, ignore_errors=True)
         elif os.path.exists(path):
             os.unlink(path)
-
-    def commit(self, step: int, success: bool):
-        if success and self.deletion_strategy is not None:
-            self.deletion_strategy.clean_up(step, self.delete)
-
 
 def get_checkpoint_storage(
     deletion_strategy=None,

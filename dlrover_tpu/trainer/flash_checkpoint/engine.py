@@ -300,8 +300,12 @@ class CheckpointEngine:
         node_rank: Optional[int] = None,
         local_saver: bool = True,
         replica_manager=None,
+        max_to_keep: int = 0,
     ):
         self.checkpoint_dir = checkpoint_dir
+        # >0: keep only the newest N committed step dirs
+        # (KeepLatestStepStrategy applied by whichever saver commits)
+        self.max_to_keep = max_to_keep
         self.replica_manager = replica_manager
         self._replica_thread = None
         self._staging_thread = None
@@ -439,9 +443,12 @@ class CheckpointEngine:
     def save_to_storage(self, step: int, state: Any) -> float:
         """Stage + queue async persist (reference save_to_storage)."""
         blocked = self.save_to_memory(step, state)
-        self.event_queue.put(
-            {"step": step, "path": self.checkpoint_dir}
-        )
+        event = {"step": step, "path": self.checkpoint_dir}
+        if self.max_to_keep:
+            # the saver (agent process) owns the storage that commits —
+            # the retention policy rides the event to it
+            event["max_to_keep"] = self.max_to_keep
+        self.event_queue.put(event)
         return blocked
 
     # ---- load ------------------------------------------------------------

@@ -107,7 +107,8 @@ class Trainer:
             self.args.save_steps > 0 or self.args.resume
         ):
             self.checkpointer = Checkpointer(
-                os.path.join(self.args.output_dir, "checkpoints")
+                os.path.join(self.args.output_dir, "checkpoints"),
+                max_to_keep=self.args.save_total_limit,
             )
         self.global_step = 0
         self.last_logs: Dict = {}

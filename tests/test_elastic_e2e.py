@@ -254,11 +254,11 @@ class TestMemoryOnlyScaleDownNoStepLoss:
             a0.start()
             a1.start()
             _wait(
-                lambda: rdzv.state()[1] == 2, 60, "2-host world"
+                lambda: rdzv.state()[1] == 2, 150, "2-host world"
             )
             _wait(
                 lambda: _max_step(_node_log(log_dir, 0)) >= 6,
-                90,
+                240,
                 "joint progress to step 6 (memory saves only)",
             )
             assert _read_tracker(ckpt_dir) < 6  # nothing durable yet
@@ -266,7 +266,7 @@ class TestMemoryOnlyScaleDownNoStepLoss:
             a1.agent.leave()
             _wait(
                 lambda: rdzv.state()[1] == 1,
-                60,
+                150,
                 "solo world after scale-down",
             )
 
@@ -277,7 +277,7 @@ class TestMemoryOnlyScaleDownNoStepLoss:
                     if line.startswith("start") and "devices=8" in line
                 ]
 
-            _wait(lambda: solo_resume(), 90, "solo restart")
+            _wait(lambda: solo_resume(), 240, "solo restart")
             resumed = solo_resume()[-1]
             # no step loss: the solo restart resumed from the staged
             # MEMORY step (>= where training was at the scale-down,
@@ -320,13 +320,13 @@ class TestTwoAgentElasticResize:
         a1.start()
         _wait(
             lambda: rdzv.state()[1] == 2,
-            60,
+            150,
             "initial 2-host world",
         )
         round_initial = rdzv.state()[0]
         _wait(
             lambda: _read_tracker(ckpt_dir) >= 3,
-            90,
+            240,
             "joint progress (tracker >= 3)",
         )
         log0 = _node_log(log_dir, 0)
@@ -337,19 +337,19 @@ class TestTwoAgentElasticResize:
         # the world re-forms with both hosts and passes the crash point
         _wait(
             lambda: "crash-injected" in _node_log(log_dir, 1),
-            60,
+            150,
             "injected crash",
         )
         _wait(
             lambda: rdzv.state()[0] > round_initial
             and rdzv.state()[1] == 2,
-            60,
+            150,
             "2-host world re-formed after crash",
         )
         tracker_now = _read_tracker(ckpt_dir)
         _wait(
             lambda: _read_tracker(ckpt_dir) >= max(tracker_now, 6) + 2,
-            90,
+            240,
             "progress resumed past the crash point",
         )
         # the restarted worker resumed from a checkpoint, not step 0
@@ -367,13 +367,13 @@ class TestTwoAgentElasticResize:
         a1.agent.leave()
         _wait(
             lambda: rdzv.state()[1] == 1,
-            60,
+            150,
             "solo world after scale-down",
         )
         down_tracker = _read_tracker(ckpt_dir)
         _wait(
             lambda: _read_tracker(ckpt_dir) >= down_tracker + 2,
-            90,
+            240,
             "solo progress (re-sharded restore 16→8)",
         )
         solo_starts = [
@@ -392,13 +392,13 @@ class TestTwoAgentElasticResize:
         a2.start()
         _wait(
             lambda: rdzv.state()[1] == 2,
-            60,
+            150,
             "2-host world after scale-up",
         )
         up_tracker = _read_tracker(ckpt_dir)
         _wait(
             lambda: _read_tracker(ckpt_dir) >= min(up_tracker + 2, TOTAL_STEPS),
-            90,
+            240,
             "progress after scale-up",
         )
         log2 = _node_log(log_dir, 2)
@@ -408,7 +408,7 @@ class TestTwoAgentElasticResize:
         # ---- phase 5: run to completion
         _wait(
             lambda: a0.exit_code is not None and a2.exit_code is not None,
-            180,
+            400,
             "both agents finished",
         )
         assert a0.exit_code == 0

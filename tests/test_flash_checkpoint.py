@@ -376,3 +376,63 @@ class TestShardedReassembly:
         del flat["w#shard1"]
         with pytest.raises(KeyError, match="staged on other hosts"):
             unflatten_state(flat, aux)
+
+
+class TestRetentionPolicy:
+    def test_max_to_keep_prunes_old_steps(self, tmp_path):
+        """save_total_limit wiring: only the newest N committed step
+        dirs survive (KeepLatestStepStrategy runs in whichever saver
+        process commits)."""
+        import numpy as np
+
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            CheckpointEngine,
+        )
+
+        eng = CheckpointEngine(
+            str(tmp_path), job_name="retainjob", max_to_keep=2
+        )
+        try:
+            import time as _time
+
+            state = {"w": np.arange(8.0), "step": 0}
+            for step in (1, 2, 3, 4):
+                state["step"] = step
+                eng.save_to_storage(step, state)
+                # one shm slot: let the saver drain this step's persist
+                # before the next save overwrites the staging area
+                deadline = _time.monotonic() + 30
+                while _time.monotonic() < deadline:
+                    if os.path.isdir(tmp_path / str(step)):
+                        break
+                    _time.sleep(0.1)
+            deadline = _time.monotonic() + 30
+            while _time.monotonic() < deadline:
+                dirs = sorted(
+                    d for d in os.listdir(tmp_path) if d.isdigit()
+                )
+                if dirs == ["3", "4"]:
+                    break
+                _time.sleep(0.2)
+            assert dirs == ["3", "4"], dirs
+            # the tracker still points at the newest retained step
+            step, restored = eng.load_from_storage()
+            assert step == 4 and int(restored["step"]) == 4
+        finally:
+            eng.close()
+
+    def test_retention_counts_preexisting_dirs(self, tmp_path):
+        """An agent/saver restart must still converge to the limit —
+        KeepLatestStepStrategy seeds from dirs already on disk."""
+        import numpy as np
+
+        from dlrover_tpu.common.storage import KeepLatestStepStrategy
+
+        for old in (1, 2):
+            os.makedirs(tmp_path / str(old))
+        strat = KeepLatestStepStrategy(2, str(tmp_path))
+        deleted = []
+        strat.clean_up(3, lambda p: deleted.append(p))
+        assert deleted == [str(tmp_path / "1")]
+        strat.clean_up(4, lambda p: deleted.append(p))
+        assert deleted == [str(tmp_path / "1"), str(tmp_path / "2")]
