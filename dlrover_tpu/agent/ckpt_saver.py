@@ -260,14 +260,20 @@ class AsyncCheckpointSaver:
         # dir or limit changes (a stale strategy would prune the WRONG
         # directory and ignore limit updates).
         max_to_keep = int(event.get("max_to_keep", 0) or 0)
-        if max_to_keep > 0 and self._retention != (path, max_to_keep):
-            from dlrover_tpu.common.storage import (
-                KeepLatestStepStrategy,
-            )
+        if self._retention != (path, max_to_keep):
+            if max_to_keep > 0:
+                from dlrover_tpu.common.storage import (
+                    KeepLatestStepStrategy,
+                )
 
-            self.storage.deletion_strategy = KeepLatestStepStrategy(
-                max_to_keep, path
-            )
+                self.storage.deletion_strategy = (
+                    KeepLatestStepStrategy(max_to_keep, path)
+                )
+            else:
+                # the trainer restarted WITHOUT a retention limit (or
+                # into a different dir): a stale strategy would keep
+                # pruning — including under the OLD directory
+                self.storage.deletion_strategy = None
             self._retention = (path, max_to_keep)
         t0 = time.monotonic()
         self.save_step_checkpoint(step, path)

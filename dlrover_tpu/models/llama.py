@@ -47,6 +47,12 @@ class LlamaConfig:
     # "dots_no_batch" saves matmul outputs (≈no recompute, more HBM)
     remat_policy: str = "full"
     attn_impl: str = "auto"            # auto | flash | reference
+    # explicit flash block sizes for tuning sweeps (0 = VMEM-aware auto,
+    # ops/flash_attention.auto_blocks). Single-device attention only:
+    # the sequence-parallel branch (ring/Ulysses) does its own
+    # S/sp chunking and ignores these.
+    attn_block_q: int = 0
+    attn_block_k: int = 0
     seq_parallel: str = "none"         # none | ring | ulysses
     # chunked fused cross-entropy: never materializes [B,S,V] logits
     # (ops/fused_ce.py). Auto-disabled under sequence parallelism
@@ -310,7 +316,9 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
         )
     else:
         attn = dot_product_attention(
-            q, k, v, causal=True, impl=cfg.attn_impl
+            q, k, v, causal=True, impl=cfg.attn_impl,
+            block_q=cfg.attn_block_q or None,
+            block_k=cfg.attn_block_k or None,
         )
     x = _attn_residual(cfg, mesh, x, attn, lp)
     return _mlp_residual(cfg, mesh, x, layer_params, lp)

@@ -76,6 +76,8 @@ def dot_product_attention(
     scale: Optional[float] = None,
     segment_ids: Optional[jax.Array] = None,
     impl: str = "auto",
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jax.Array:
     """Main entry. impl: 'auto' | 'flash' | 'reference'.
 
@@ -93,10 +95,14 @@ def dot_product_attention(
                 "use impl='reference' for packed sequences"
             )
         if impl == "flash" or (
-            _tpu_available() and fa.supports(q, k, segment_ids)
+            _tpu_available()
+            and fa.supports(
+                q, k, segment_ids, block_q=block_q, block_k=block_k
+            )
         ):
             return fa.flash_attention(
-                q, k, v, causal=causal, scale=scale
+                q, k, v, causal=causal, scale=scale,
+                block_q=block_q, block_k=block_k,
             )
         return reference_attention(q, k, v, causal, scale, segment_ids)
     raise ValueError(f"unknown attention impl: {impl}")

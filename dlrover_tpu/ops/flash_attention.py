@@ -471,6 +471,14 @@ def flash_attention(
             f"no flash block size divides seq lengths "
             f"{q.shape[1]}/{k.shape[1]}; use the XLA reference path"
         )
+    # explicit (tuning-sweep) blocks must tile the sequence exactly —
+    # the grid uses floor division, so a non-dividing block would
+    # silently leave the tail rows unwritten
+    if q.shape[1] % block_q or k.shape[1] % block_k:
+        raise ValueError(
+            f"block_q={block_q}/block_k={block_k} do not divide seq "
+            f"lengths {q.shape[1]}/{k.shape[1]}"
+        )
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
     n_rep = q.shape[2] // k.shape[2]
