@@ -86,6 +86,16 @@ def dot_product_attention(
     """
     if impl == "reference":
         return reference_attention(q, k, v, causal, scale, segment_ids)
+    # explicit tuning blocks must be valid wherever they're given — a
+    # silent supports() fallback would benchmark the XLA reference and
+    # record wrong sweep results
+    if (block_q and q.shape[1] % block_q) or (
+        block_k and k.shape[1] % block_k
+    ):
+        raise ValueError(
+            f"explicit block_q={block_q}/block_k={block_k} do not "
+            f"divide seq lengths {q.shape[1]}/{k.shape[1]}"
+        )
     if impl in ("auto", "flash"):
         from dlrover_tpu.ops import flash_attention as fa
 

@@ -103,3 +103,47 @@ class _null:
 
     def __exit__(self, *a):
         return False
+
+
+def test_long_context_8k_ring():
+    """Long-context is first-class: ring attention at seq 8192 over the
+    full 8-way seq mesh. Correctness vs the reference at a length where
+    the unsharded [S, S] score matrix (64M entries/head) is exactly what
+    the ring formulation exists to avoid materializing per-device."""
+    mesh = MeshSpec(seq=8).build()
+    s, h, d = 8192, 2, 32
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, s, h, d), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True, impl="reference")
+    out = jax.jit(
+        lambda q, k, v: sp_attention(q, k, v, mesh, mode="ring")
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=3e-5
+    )
+
+
+def test_long_context_grad_flows():
+    """Backward through the 8k ring program (remat inside the scan) —
+    the training direction of the long-context path."""
+    mesh = MeshSpec(seq=8).build()
+    s, h, d = 8192, 2, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, s, h, d), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return sp_attention(q, k, v, mesh, mode="ring").sum()
+
+    def loss_ref(q, k, v):
+        return dot_product_attention(
+            q, k, v, causal=True, impl="reference"
+        ).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-4
+        )
