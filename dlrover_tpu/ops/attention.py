@@ -86,16 +86,6 @@ def dot_product_attention(
     """
     if impl == "reference":
         return reference_attention(q, k, v, causal, scale, segment_ids)
-    # explicit tuning blocks must be valid wherever they're given — a
-    # silent supports() fallback would benchmark the XLA reference and
-    # record wrong sweep results
-    if (block_q and q.shape[1] % block_q) or (
-        block_k and k.shape[1] % block_k
-    ):
-        raise ValueError(
-            f"explicit block_q={block_q}/block_k={block_k} do not "
-            f"divide seq lengths {q.shape[1]}/{k.shape[1]}"
-        )
     if impl in ("auto", "flash"):
         from dlrover_tpu.ops import flash_attention as fa
 
@@ -104,15 +94,27 @@ def dot_product_attention(
                 "flash attention does not support segment_ids yet; "
                 "use impl='reference' for packed sequences"
             )
-        if impl == "flash" or (
+        take_flash = impl == "flash" or (
             _tpu_available()
             and fa.supports(
                 q, k, segment_ids, block_q=block_q, block_k=block_k
             )
-        ):
+        )
+        if take_flash:
             return fa.flash_attention(
                 q, k, v, causal=causal, scale=scale,
                 block_q=block_q, block_k=block_k,
+            )
+        if block_q or block_k:
+            # explicit tuning blocks were given but the flash path was
+            # NOT taken (any supports() failure: divisibility, head
+            # dim, cross-length, segment_ids, non-TPU backend) — a
+            # silent reference fallback would record wrong sweep
+            # results as tuned-flash numbers
+            raise ValueError(
+                f"explicit block_q={block_q}/block_k={block_k} given "
+                "but the flash path is unsupported for these "
+                f"shapes/backend (q{q.shape} k{k.shape})"
             )
         return reference_attention(q, k, v, causal, scale, segment_ids)
     raise ValueError(f"unknown attention impl: {impl}")
