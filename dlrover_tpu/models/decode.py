@@ -1,4 +1,4 @@
-"""KV-cache autoregressive decoding for the Llama family.
+"""KV-cache autoregressive decoding (Llama + GPT-2 families).
 
 Reference parity: the serving path the reference delegates to vLLM
 (atorch/rl/inference_backend/vllm_backend.py) and the incremental decode
@@ -33,10 +33,13 @@ Params = Dict
 
 
 def init_kv_cache(
-    cfg: LlamaConfig, batch: int, max_len: int
+    cfg, batch: int, max_len: int
 ) -> Dict[str, jax.Array]:
-    """Fixed-size cache buffers; dtype follows compute dtype."""
-    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    """Fixed-size cache buffers; dtype follows compute dtype. Works for
+    any family config with n_layers/n_heads/head_dim (GPT has no GQA,
+    so its KV head count is n_heads)."""
+    kv_heads = getattr(cfg, "n_kv_heads", cfg.n_heads)
+    shape = (cfg.n_layers, batch, max_len, kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -97,15 +100,65 @@ def _block(
     return x, k_cache, v_cache
 
 
+def _block_gpt(cfg, x, lp, k_cache, v_cache, positions, start):
+    """GPT-2 pre-LN block with cache write — built from gpt.py's own
+    helpers; the cache write + masked attention are the only
+    decode-specific parts (positions are consumed at embedding time)."""
+    from dlrover_tpu.models import gpt
+
+    q, k, v = gpt._attn_qkv(cfg, x, lp)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
+    )
+    attn = _cached_attention(
+        q, k_cache, v_cache, positions, float(cfg.head_dim) ** -0.5
+    )
+    x = gpt._attn_residual(cfg, x, attn, lp)
+    x = gpt._mlp_residual(cfg, x, lp)
+    return x, k_cache, v_cache
+
+
+def _is_gpt(cfg) -> bool:
+    from dlrover_tpu.models.gpt import GptConfig
+
+    return isinstance(cfg, GptConfig)
+
+
+def _check_positional_capacity(cfg, max_len: int):
+    """GPT's LEARNED position table hard-stops at max_seq_len: JAX
+    clamps out-of-bounds gathers, so decoding past it would silently
+    reuse wpe[-1] and emit garbage. RoPE (llama) computes any position,
+    so no bound applies there."""
+    if _is_gpt(cfg) and max_len > cfg.max_seq_len:
+        raise ValueError(
+            f"decode length {max_len} exceeds the GPT position table "
+            f"(max_seq_len={cfg.max_seq_len}); positions would clamp "
+            "and produce wrong logits"
+        )
+
+
 def _forward_cached(cfg, params, tokens, cache, positions, start):
     """tokens [B,S] → logits [B,S,V], writing the cache at
-    [start, start+S)."""
-    x = params["embed"]["weight"].astype(cfg.dtype)[tokens]
+    [start, start+S). Family dispatch: llama (RoPE/GQA/RMSNorm) or
+    GPT-2 (learned positions, pre-LN, tied wte head)."""
+    gpt = _is_gpt(cfg)
+    if gpt:
+        x = (
+            params["wte"].astype(cfg.dtype)[tokens]
+            + params["wpe"].astype(cfg.dtype)[positions]
+        )
+        block = _block_gpt
+    else:
+        x = params["embed"]["weight"].astype(cfg.dtype)[tokens]
+        block = _block
 
     def body(carry, inp):
         h = carry
         layer_params, kc, vc = inp
-        h, kc, vc = _block(
+        h, kc, vc = block(
             cfg, h, layer_params, kc, vc, positions, start
         )
         return h, (kc, vc)
@@ -113,8 +166,19 @@ def _forward_cached(cfg, params, tokens, cache, positions, start):
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
-    x = _rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps)
-    logits = (x @ _head_matrix(cfg, params)).astype(jnp.float32)
+    if gpt:
+        from dlrover_tpu.models.gpt import _layer_norm
+
+        x = _layer_norm(
+            x, params["lnf_g"], params["lnf_b"], cfg.norm_eps
+        )
+        head = params["wte"].astype(cfg.dtype).T
+    else:
+        x = _rms_norm(
+            x, params["final_norm"]["scale"], cfg.norm_eps
+        )
+        head = _head_matrix(cfg, params)
+    logits = (x @ head).astype(jnp.float32)
     return logits, {"k": k_new, "v": v_new}
 
 
@@ -168,6 +232,7 @@ def generate(
         raise ValueError(
             f"max_len {m} < prompt {p} + new {max_new_tokens}"
         )
+    _check_positional_capacity(cfg, m)
     if max_new_tokens == 0:
         return prompt
     if key is None:

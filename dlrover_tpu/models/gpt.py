@@ -128,27 +128,41 @@ def partition_rules(cfg: GptConfig):
 
 
 
-def _block(cfg: GptConfig, mesh, x, lp):
-    B, S, D = x.shape
+def _attn_qkv(cfg: GptConfig, x, lp):
+    """LN1 + fused qkv projection — shared with the KV-cache decoder
+    (models/decode.py) so there is one definition of the block math."""
+    B, S, _ = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
-
     h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
     qkv = h @ lp["wqkv"].astype(cfg.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, hd)
-    k = k.reshape(B, S, H, hd)
-    v = v.reshape(B, S, H, hd)
-    q = constrain(q, mesh, ("data", "fsdp"), "seq", "tensor", None)
-    attn = dot_product_attention(q, k, v, causal=True)
-    attn = attn.reshape(B, S, D)
-    x = x + attn @ lp["wo"].astype(cfg.dtype)
+    return (
+        q.reshape(B, S, H, hd),
+        k.reshape(B, S, H, hd),
+        v.reshape(B, S, H, hd),
+    )
 
+
+def _attn_residual(cfg: GptConfig, x, attn, lp):
+    B, S, _ = x.shape
+    return x + attn.reshape(B, S, cfg.dim) @ lp["wo"].astype(cfg.dtype)
+
+
+def _mlp_residual(cfg: GptConfig, x, lp):
     h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.norm_eps)
     up = h @ lp["w_up"].astype(cfg.dtype) + lp["b_up"].astype(cfg.dtype)
     up = jax.nn.gelu(up)
-    x = x + up @ lp["w_down"].astype(cfg.dtype) + lp["b_down"].astype(
-        cfg.dtype
-    )
+    return x + up @ lp["w_down"].astype(cfg.dtype) + lp[
+        "b_down"
+    ].astype(cfg.dtype)
+
+
+def _block(cfg: GptConfig, mesh, x, lp):
+    q, k, v = _attn_qkv(cfg, x, lp)
+    q = constrain(q, mesh, ("data", "fsdp"), "seq", "tensor", None)
+    attn = dot_product_attention(q, k, v, causal=True)
+    x = _attn_residual(cfg, x, attn, lp)
+    x = _mlp_residual(cfg, x, lp)
     return constrain(x, mesh, ("data", "fsdp"), "seq", None)
 
 

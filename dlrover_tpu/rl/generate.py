@@ -8,7 +8,7 @@ the program has a single static shape — no recompiles.
 Two engines, same semantics (ragged prompts, EOS early-stop masks):
   sample_tokens        — model-agnostic: full causal re-forward per
                          step (works with ANY apply_fn);
-  sample_tokens_cached — llama-family KV-cache path
+  sample_tokens_cached — llama/GPT-family KV-cache path
                          (models/decode.py): O(1) qkv + O(max_len)
                          attention per step instead of a full forward —
                          the vLLM-shaped fast path for PPO rollouts."""
@@ -117,8 +117,13 @@ def _decode_cached(
     greedy: bool,
     eos_id,       # traced (like _decode) — no recompile per tokenizer
 ):
-    from dlrover_tpu.models.decode import decode_step, init_kv_cache
+    from dlrover_tpu.models.decode import (
+        _check_positional_capacity,
+        decode_step,
+        init_kv_cache,
+    )
 
+    _check_positional_capacity(cfg, max_len)
     B = tokens.shape[0]
     cache = init_kv_cache(cfg, B, max_len)
 
@@ -153,8 +158,8 @@ def sample_tokens_cached(
     greedy: bool = False,
     eos_id: int = -1,
 ) -> Tuple[jax.Array, jax.Array]:
-    """sample_tokens semantics on the KV-cache engine (llama-family
-    configs). `cfg` must be hashable (LlamaConfig is frozen)."""
+    """sample_tokens semantics on the KV-cache engine (llama + GPT-2
+    family configs — both frozen/hashable dataclasses)."""
     key = key if key is not None else jax.random.PRNGKey(0)
     return _decode_cached(
         params,
