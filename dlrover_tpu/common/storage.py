@@ -7,6 +7,7 @@ strategies (:189-258 `KeepLatestStepStrategy`, `KeepStepIntervalStrategy`).
 
 import os
 import shutil
+import threading
 from typing import List, Optional
 
 from dlrover_tpu.common.log import default_logger as logger
@@ -102,12 +103,26 @@ class PosixDiskStorage(CheckpointStorage):
     def write(self, content, path: str):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         mode = "wb" if isinstance(content, (bytes, bytearray, memoryview)) else "w"
-        tmp = path + ".tmp"
-        with open(tmp, mode) as f:
-            f.write(content)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        # per-writer tmp name: SHARED targets (the tracker file — every
+        # committing host writes the same path) would otherwise collide
+        # on one ".tmp", interleaving writes into a corrupt file or
+        # losing the rename (FileNotFoundError when the peer's replace
+        # wins). Unique tmp + atomic replace = last-writer-wins.
+        tmp = (
+            f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        )
+        try:
+            with open(tmp, mode) as f:
+                f.write(content)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):  # failed mid-write: don't litter
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
 
     def read(self, path: str, mode: str = "rb"):
         if not os.path.exists(path):

@@ -69,6 +69,24 @@ def _cached_attention(q, k_cache, v_cache, q_positions, scale):
     return out.reshape(b, s, h, hd)
 
 
+def _write_cache_and_attend(
+    q, k, v, k_cache, v_cache, positions, start, head_dim
+):
+    """THE decode-specific core, shared by both family blocks: write
+    this chunk's K/V into the cache at `start` and attend over the
+    whole buffer under the position mask."""
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
+    )
+    attn = _cached_attention(
+        q, k_cache, v_cache, positions, float(head_dim) ** -0.5
+    )
+    return attn, k_cache, v_cache
+
+
 def _block(
     cfg: LlamaConfig,
     x: jax.Array,            # [B, S, D]
@@ -86,14 +104,8 @@ def _block(
     lp = _compute_weights(cfg, layer_params)
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
     q, k, v = _attn_qkv(cfg, None, h, lp, positions)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
-    )
-    attn = _cached_attention(
-        q, k_cache, v_cache, positions, float(cfg.head_dim) ** -0.5
+    attn, k_cache, v_cache = _write_cache_and_attend(
+        q, k, v, k_cache, v_cache, positions, start, cfg.head_dim
     )
     x = _attn_residual(cfg, None, x, attn, lp)
     x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
@@ -107,14 +119,8 @@ def _block_gpt(cfg, x, lp, k_cache, v_cache, positions, start):
     from dlrover_tpu.models import gpt
 
     q, k, v = gpt._attn_qkv(cfg, x, lp)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
-    )
-    attn = _cached_attention(
-        q, k_cache, v_cache, positions, float(cfg.head_dim) ** -0.5
+    attn, k_cache, v_cache = _write_cache_and_attend(
+        q, k, v, k_cache, v_cache, positions, start, cfg.head_dim
     )
     x = gpt._attn_residual(cfg, x, attn, lp)
     x = gpt._mlp_residual(cfg, x, lp)
@@ -232,7 +238,9 @@ def generate(
         raise ValueError(
             f"max_len {m} < prompt {p} + new {max_new_tokens}"
         )
-    _check_positional_capacity(cfg, m)
+    # positions actually used reach p + max_new_tokens - 1; the cache
+    # buffer (m) may be padded larger for static-shape reuse
+    _check_positional_capacity(cfg, p + max_new_tokens)
     if max_new_tokens == 0:
         return prompt
     if key is None:

@@ -175,6 +175,13 @@ def apply(
 ) -> jax.Array:
     """tokens [B, S] int32 → logits [B, S, vocab] f32."""
     b, s = tokens.shape
+    if s > cfg.max_seq_len:
+        # the learned position table clamps out-of-bounds gathers —
+        # every token past max_seq_len would silently reuse wpe[-1]
+        raise ValueError(
+            f"sequence length {s} exceeds the GPT position table "
+            f"(max_seq_len={cfg.max_seq_len})"
+        )
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     x = (

@@ -157,13 +157,19 @@ class _AgentHandle:
         self.client = MasterClient(
             master_addr, node_id=node_id, node_type="worker"
         )
+        # job name unique per TEST RUN, not just per node: the IPC
+        # socket + shm segment names derive from it, and a stale server
+        # lingering from a previous test in the same pytest process
+        # would poison this test's agents (seen as UNAVAILABLE persist
+        # failures mid-lifecycle)
+        uniq = master_addr.rsplit(":", 1)[-1]
         config = ElasticLaunchConfig(
             min_nodes=1,
             max_nodes=2,
             max_restarts=4,
             monitor_interval=0.2,
             rdzv_timeout=90,
-            job_name=f"e2e-h{node_id}",
+            job_name=f"e2e{uniq}-h{node_id}",
             log_dir=str(log_dir),
         )
         self.agent = ElasticTrainingAgent(
