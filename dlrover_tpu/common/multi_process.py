@@ -70,7 +70,7 @@ class LocalSocketServer:
     def __init__(self, job_name: str = "default"):
         self.path = socket_path(job_name)
         self._locks: Dict[str, threading.Lock] = {}
-        self._lock_owners: Dict[str, str] = {}
+        self._lock_owners: Dict[str, str] = {}  # name -> acquire nonce
         self._queues: Dict[str, _queue.Queue] = {}
         self._dicts: Dict[str, dict] = {}
         self._meta_lock = threading.Lock()
@@ -91,20 +91,30 @@ class LocalSocketServer:
         with self._meta_lock:
             return self._dicts.setdefault(name, {})
 
-    def _release_dead_owner(self, name: str):
+    def _release_dead_owner(self, name: str, token: str):
+        # only reap if the CURRENT holder is the acquire this dead
+        # connection performed: a release that was retried over a fresh
+        # socket (transient send error) leaves `name` in the dead
+        # connection's held map, and blindly releasing here would yank
+        # the lock from a different client that since acquired it
+        with self._meta_lock:
+            if self._lock_owners.get(name) != token:
+                return
+            self._lock_owners.pop(name, None)
         lock = self._lock(name)
         try:
             lock.release()
-            self._lock_owners.pop(name, None)
             logger.warning(
-                "released lock %r held by a disconnected client", name
+                "released lock %r held by disconnected client %s",
+                name,
+                token,
             )
         except RuntimeError:
             pass  # already released through the normal path
 
     # request handling -----------------------------------------------------
 
-    def _handle(self, req: dict, conn_held: set = None) -> Any:
+    def _handle(self, req: dict, conn_held: dict = None) -> Any:
         kind, name, op = req["kind"], req["name"], req["op"]
         if kind == "lock":
             lock = self._lock(name)
@@ -114,16 +124,35 @@ class LocalSocketServer:
                     timeout=req.get("timeout", -1),
                 )
                 if ok:
-                    self._lock_owners[name] = req.get("owner", "")
+                    # the client's per-acquire nonce becomes the owner
+                    # token: release and the dead-connection reaper
+                    # both check it, so neither a release retried over
+                    # a fresh socket nor a stale reap can yank the
+                    # lock from a LATER holder
+                    token = req.get("owner", "")
+                    with self._meta_lock:
+                        self._lock_owners[name] = token
                     if conn_held is not None:
-                        conn_held.add(name)
+                        conn_held[name] = token
                 return ok
             if op == "release":
+                # pop the ownership entry BEFORE releasing: releasing
+                # first would let a concurrent acquirer write its
+                # fresh token and then have it wiped, disarming the
+                # reaper for that holder
+                token = req.get("owner", "")
+                with self._meta_lock:
+                    cur = self._lock_owners.get(name)
+                    if cur is not None and cur != token:
+                        # retried release racing a new holder, OR a
+                        # double/stray release with an empty nonce:
+                        # either way the lock is not ours to release
+                        return False
+                    self._lock_owners.pop(name, None)
+                if conn_held is not None:
+                    conn_held.pop(name, None)
                 try:
                     lock.release()
-                    self._lock_owners.pop(name, None)
-                    if conn_held is not None:
-                        conn_held.discard(name)
                     return True
                 except RuntimeError:
                     return False
@@ -167,7 +196,7 @@ class LocalSocketServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):  # one connection, many requests
-                held = set()  # locks acquired through THIS connection
+                held = {}  # name -> acquire token, THIS connection
                 try:
                     while True:
                         try:
@@ -184,8 +213,8 @@ class LocalSocketServer:
                     # trainer SIGKILLed mid-save) must not leave a
                     # named lock held forever — the agent's teardown
                     # persist would deadlock on the shm lock
-                    for name in held:
-                        release_dead(name)
+                    for name, token in held.items():
+                        release_dead(name, token)
 
         self._server = socketserver.ThreadingUnixStreamServer(
             self.path, Handler
@@ -213,62 +242,103 @@ class LocalSocketServer:
 
 
 class _Proxy:
+    """Connections are PER THREAD (threading.local), not per proxy.
+
+    A single shared socket would serialize all threads of a process
+    through one server handler thread — and that handler blocks inline
+    in `lock.acquire`, so two threads of one process contending on the
+    same SharedLock (async ckpt staging vs. a concurrent restore; the
+    saver loop vs. the agent's crash-path persist) would wedge the
+    connection in a 4-way cycle: waiter stuck in recv holding the
+    socket, holder's release stuck behind it, server stuck in acquire.
+    With a connection per thread the blocked acquire occupies only its
+    own handler thread and the holder's release flows independently.
+    """
+
     kind = ""
 
     def __init__(self, name: str, job_name: str = "default"):
         self.name = name
         self.job_name = job_name
-        self._sock: Optional[socket.socket] = None
-        self._sock_lock = threading.Lock()
+        self._tls = threading.local()
 
-    def _connect(self):
+    def _connect(self) -> socket.socket:
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         s.connect(socket_path(self.job_name))
-        self._sock = s
+        self._tls.sock = s
+        return s
 
     def _request(self, op: str, **kw) -> Any:
-        with self._sock_lock:
-            for attempt in (0, 1):
-                try:
-                    if self._sock is None:
-                        self._connect()
-                    _send_msg(
-                        self._sock,
-                        {
-                            "kind": self.kind,
-                            "name": self.name,
-                            "op": op,
-                            **kw,
-                        },
-                    )
-                    status, result = _recv_msg(self._sock)
-                    if status == "err":
-                        raise RuntimeError(result)
-                    return result
-                except (ConnectionError, OSError):
-                    self._sock = None
-                    if attempt:
-                        raise
-        return None
+        for attempt in (0, 1):
+            sock = getattr(self._tls, "sock", None)
+            try:
+                if sock is None:
+                    sock = self._connect()
+                _send_msg(
+                    sock,
+                    {
+                        "kind": self.kind,
+                        "name": self.name,
+                        "op": op,
+                        **kw,
+                    },
+                )
+                status, result = _recv_msg(sock)
+                if status == "err":
+                    raise RuntimeError(result)
+                return result
+            except (ConnectionError, OSError):
+                self._tls.sock = None
+                if attempt:
+                    raise
+        raise AssertionError("unreachable: attempt 1 returns or raises")
+
+    def close_thread(self):
+        """Close the CALLING thread's connection (if any). Short-lived
+        worker threads (async ckpt staging, replica backup) should call
+        this on exit — otherwise their connection and the server handler
+        thread parked on it linger until GC reclaims the dead thread."""
+        sock = getattr(self._tls, "sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._tls.sock = None
 
 
 class SharedLock(_Proxy):
-    """Reference SharedLock multi_process.py:227."""
+    """Reference SharedLock multi_process.py:227.
+
+    Every acquire carries a fresh nonce; the matching release sends it
+    back. The server only honors a release whose nonce matches the
+    current holder, so a release retried over a fresh socket after a
+    transient send error can never release a DIFFERENT client's
+    acquire. Acquire/release must pair within one thread (they do
+    everywhere: `with lock:`)."""
 
     kind = "lock"
 
     def acquire(self, blocking=True, timeout=-1) -> bool:
-        return bool(
+        import uuid
+
+        nonce = f"{os.getpid()}:{uuid.uuid4().hex}"
+        ok = bool(
             self._request(
                 "acquire",
                 blocking=blocking,
                 timeout=timeout,
-                owner=str(os.getpid()),
+                owner=nonce,
             )
         )
+        if ok:
+            self._tls.nonce = nonce
+        return ok
 
     def release(self) -> bool:
-        return bool(self._request("release"))
+        nonce = getattr(self._tls, "nonce", "")
+        self._tls.nonce = ""
+        return bool(self._request("release", owner=nonce))
 
     def locked(self) -> bool:
         return bool(self._request("locked"))
@@ -358,26 +428,34 @@ class SharedMemorySegment:
         if create:
             fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o600)
             try:
-                cur = os.fstat(fd).st_size
-                if size > cur:
+                st = os.fstat(fd)
+                if size > st.st_size:
                     os.ftruncate(fd, size)
-                self.size = max(size, cur)
+                self.size = max(size, st.st_size)
+                self.ino = st.st_ino
                 self.buf = mmap.mmap(fd, self.size)
             finally:
                 os.close(fd)
         else:
             fd = os.open(self.path, os.O_RDWR)
             try:
-                self.size = os.fstat(fd).st_size
+                st = os.fstat(fd)
+                self.size = st.st_size
+                self.ino = st.st_ino
                 self.buf = mmap.mmap(fd, self.size)
             finally:
                 os.close(fd)
 
-    @classmethod
-    def exists(cls, name: str) -> bool:
-        return os.path.exists(
-            os.path.join(SHM_DIR, name.replace("/", "_"))
-        )
+    def is_stale(self) -> bool:
+        """True when the file at `path` is no longer the inode this
+        mapping covers (unlinked + recreated) or changed size — grown
+        means slices miss the new bytes; shrunk means touching pages
+        past EOF SIGBUSes the process. Either way: re-attach."""
+        try:
+            st = os.stat(self.path)
+        except FileNotFoundError:
+            return True
+        return st.st_ino != self.ino or st.st_size != self.size
 
     def close(self):
         try:

@@ -78,6 +78,16 @@ class DataManager:
         with self._lock:
             return list(self._data.get(data_type, []))
 
+    def purge_node(self, data_type: str, node_id: int):
+        """Drop one node's rows — used when the master ACTS on a
+        conclusion (e.g. restarts a straggler's worker) so stale
+        pre-action evidence cannot re-trigger the same action."""
+        with self._lock:
+            rows = self._data.get(data_type, [])
+            self._data[data_type] = [
+                d for d in rows if d.node_id != node_id
+            ]
+
 
 class CheckTrainingHangOperator(InferenceOperator):
     """Training is hung if every running node's last step report is older
@@ -202,6 +212,72 @@ class CheckChipMetricsOperator(InferenceOperator):
         return out or [Inference("chip", "is", "healthy")]
 
 
+class CheckStragglerOperator(InferenceOperator):
+    """Runtime straggler attribution from per-node HOST compute times.
+
+    Under SPMD lockstep a slow host drags every node's wall clock
+    equally — per-node step *rates* never diverge, so the signal is
+    the host-side (python/dispatch, pre-collective) ms each worker
+    reports with its step. A node whose sustained host time exceeds
+    `ratio` x the fastest peer (and by at least `min_gap_ms`, so tiny
+    absolute jitter never flags) is a straggler. Reference compares
+    per-node bench elapsed the same way at rendezvous time
+    (rdzv_manager.py:579 `get_straggler`, :607 `_detect_stragglers`);
+    this operator extends that comparison to live training.
+    """
+
+    def __init__(
+        self,
+        data_mgr: DataManager,
+        ratio: float = 2.0,
+        min_samples: int = 3,
+        min_gap_ms: float = 100.0,
+    ):
+        self._data = data_mgr
+        self._ratio = ratio
+        self._min_samples = min_samples
+        self._min_gap_ms = min_gap_ms
+
+    def is_compatible(self, problem: Inference) -> bool:
+        return problem.key() == ("node", "is", "straggler?")
+
+    def infer(self, problem: Inference) -> List[Inference]:
+        import statistics
+
+        per_node: Dict[int, List[float]] = {}
+        for d in self._data.get(DiagnosisDataType.STEP_REPORT):
+            # node_id -1 is the job-global step row; per-node rows
+            # carry host_compute_ms as payload
+            if d.node_id < 0 or d.payload is None:
+                continue
+            per_node.setdefault(d.node_id, []).append(
+                float(d.payload)
+            )
+        reps = {
+            nid: statistics.median(vals[-self._min_samples * 2 :])
+            for nid, vals in per_node.items()
+            if len(vals) >= self._min_samples
+        }
+        if len(reps) < 2:
+            return [Inference("node", "is", "no-straggler")]
+        fastest = min(reps.values())
+        out = [
+            Inference(
+                "node", "is", "straggler",
+                evidence={
+                    "node_id": nid,
+                    "host_compute_ms": round(ms, 1),
+                    "fastest_peer_ms": round(fastest, 1),
+                    "ratio": round(ms / max(fastest, 1e-9), 2),
+                },
+            )
+            for nid, ms in sorted(reps.items())
+            if ms > fastest * self._ratio
+            and ms - fastest > self._min_gap_ms
+        ]
+        return out or [Inference("node", "is", "no-straggler")]
+
+
 class InferenceChain:
     """Walk operators compatible with the problem; first non-empty
     conclusion wins (reference inference_chain.py:38)."""
@@ -236,6 +312,7 @@ class DiagnosisManager:
                 CheckTrainingHangOperator(self.data, hang_timeout),
                 CheckFailureNodeOperator(self.data),
                 CheckChipMetricsOperator(self.data),
+                CheckStragglerOperator(self.data),
             ]
         )
 
@@ -258,6 +335,7 @@ class DiagnosisManager:
             Inference("training", "is", "hung?"),
             Inference("node", "is", "failed?"),
             Inference("chip", "is", "pressured?"),
+            Inference("node", "is", "straggler?"),
         ):
             results.extend(self._chain.infer(problem))
         return results

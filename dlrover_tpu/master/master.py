@@ -13,7 +13,11 @@ import time
 from typing import Optional
 
 from dlrover_tpu.common.comm import build_master_server
-from dlrover_tpu.common.constants import JobConstant, JobStage
+from dlrover_tpu.common.constants import (
+    JobConstant,
+    JobStage,
+    NodeType,
+)
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.common.messages import find_free_port
 from dlrover_tpu.master.servicer import MasterServicer
@@ -174,6 +178,10 @@ class DistributedJobMaster(JobMaster):
         self.servicer.diagnosis_sink = self.diagnosis.report
         self.last_diagnosis = []
         self._fed_ts = {}  # (data_type, node_id) -> last fed ts
+        # runtime-straggler action log + per-node rate limit
+        self.straggler_actions = []
+        self.straggler_cooldown = 300.0
+        self._straggler_acted = {}
         nm = self.servicer.node_manager
         nm.register_callback(_DiagnosisFeedCallback(self.diagnosis))
         if job_args is not None:
@@ -236,7 +244,52 @@ class DistributedJobMaster(JobMaster):
                 self.servicer.job_stage = JobStage.FAILED
                 self.exit_code = 1
                 return True
+            if inf.key() == ("node", "is", "straggler"):
+                self._act_on_straggler(inf)
         return super()._poll_once()
+
+    def _act_on_straggler(self, inf):
+        """Diagnosed runtime straggler: restart its worker (a wedged
+        host process is the common cause) by cutting it from the
+        rendezvous world — its agent sees the membership change and
+        respawns into a new round; on a platform, also relaunch the
+        pod through the role pool. Rate-limited per node so a
+        genuinely slow host is acted on once per cooldown, not every
+        poll (reference: stragglers reported via rdzv_manager.py:579
+        and relaunched by job config)."""
+        node_id = inf.evidence["node_id"]
+        now = time.time()
+        last = self._straggler_acted.get(node_id, 0.0)
+        if now - last < self.straggler_cooldown:
+            return
+        self._straggler_acted[node_id] = now
+        logger.error(
+            "diagnosis: node %d is a runtime straggler — %s; "
+            "restarting its worker",
+            node_id,
+            inf.evidence,
+        )
+        self.straggler_actions.append(
+            {"node_id": node_id, "ts": now, **inf.evidence}
+        )
+        # drop the node's pre-action samples everywhere: the relaunched
+        # worker must be judged on FRESH evidence, not re-flagged from
+        # the history that triggered this action
+        from dlrover_tpu.master.diagnosis import DiagnosisDataType
+
+        self.servicer.speed_monitor.clear_worker_compute(node_id)
+        self.diagnosis.data.purge_node(
+            DiagnosisDataType.STEP_REPORT, node_id
+        )
+        self._fed_ts.pop(("wstep", node_id), None)
+        for rdzv in self.servicer.rdzv_managers.values():
+            rdzv.remove_node(node_id)
+        if self.scaler is not None:
+            node = self.servicer.node_manager.get_node(
+                NodeType.WORKER, node_id
+            )
+            if node is not None:
+                self._relaunch_node(node)
 
     def _feed_diagnosis(self):
         """Mirror the step/heartbeat signals the servicer already
@@ -254,6 +307,18 @@ class DistributedJobMaster(JobMaster):
             self._fed_ts[("step", -1)] = ts
             self.diagnosis.report(
                 DiagnosisDataType.STEP_REPORT, -1, payload=step, ts=ts
+            )
+        for nid, (ms, wts) in (
+            s.speed_monitor.worker_compute_samples().items()
+        ):
+            if self._fed_ts.get(("wstep", nid)) == wts:
+                continue
+            self._fed_ts[("wstep", nid)] = wts
+            self.diagnosis.report(
+                DiagnosisDataType.STEP_REPORT,
+                nid,
+                payload=ms,
+                ts=wts,
             )
         for node_type, node_id, ts in s.node_manager.heartbeats():
             if self._fed_ts.get(("beat", node_type, node_id)) == ts:

@@ -22,6 +22,11 @@ class SpeedMonitor:
         self._start_ts = time.time()
         self._speeds: Deque[Tuple[float, float]] = deque(maxlen=window)
         self._worker_steps: Dict[int, Tuple[int, float]] = {}
+        # per-node latest host-compute sample (ms, ts) — the runtime
+        # straggler signal (host time diverges under SPMD lockstep
+        # even though wall time cannot); smoothing happens over the
+        # diagnosis store's history, not here
+        self._worker_compute: Dict[int, Tuple[float, float]] = {}
         self._worker_start: Dict[int, float] = {}
         self._paused: Set[int] = set()
         self.first_step_ts: float = 0.0
@@ -48,12 +53,36 @@ class SpeedMonitor:
             return self._global_step, self._global_step_ts
 
     def collect_worker_step(
-        self, node_id: int, step: int, ts: Optional[float] = None
+        self,
+        node_id: int,
+        step: int,
+        ts: Optional[float] = None,
+        host_compute_ms: float = 0.0,
     ):
         ts = ts or time.time()
         with self._lock:
             self._worker_steps[node_id] = (step, ts)
+            if host_compute_ms > 0.0:
+                self._worker_compute[node_id] = (
+                    host_compute_ms,
+                    ts,
+                )
         self.collect_global_step(step, ts)
+
+    def worker_compute_samples(
+        self,
+    ) -> Dict[int, Tuple[float, float]]:
+        """Latest (host_compute_ms, ts) per node — feeds the
+        diagnosis straggler operator."""
+        with self._lock:
+            return dict(self._worker_compute)
+
+    def clear_worker_compute(self, node_id: int):
+        """Forget a node's host-compute sample — called when the
+        master acts on a straggler so pre-restart samples cannot
+        re-flag the relaunched (healthy) worker."""
+        with self._lock:
+            self._worker_compute.pop(node_id, None)
 
     def add_running_worker(self, node_id: int):
         with self._lock:
@@ -63,6 +92,7 @@ class SpeedMonitor:
         with self._lock:
             self._worker_start.pop(node_id, None)
             self._worker_steps.pop(node_id, None)
+            self._worker_compute.pop(node_id, None)
 
     # ---- queries ---------------------------------------------------------
 

@@ -290,7 +290,12 @@ class MasterServicer(MasterServicerBase):
             )
         if isinstance(req, msg.GlobalStep):
             self.speed_monitor.collect_worker_step(
-                req.node_id, req.step, req.timestamp
+                req.node_id,
+                req.step,
+                req.timestamp,
+                host_compute_ms=getattr(
+                    req, "host_compute_ms", 0.0
+                ),
             )
             return ReplyEnvelope()
         if isinstance(req, msg.ResourceStats):

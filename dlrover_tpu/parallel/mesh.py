@@ -87,6 +87,9 @@ class MeshSpec:
                 f"MeshSpec needs {n} devices, only {len(devices)} available"
             )
         devices = list(devices)[:n]
+        num_slices = len({_slice_id(d) for d in devices})
+        if num_slices > 1:
+            return self._build_hybrid(devices, num_slices)
         shape = tuple(self.axis_sizes[a] for a in AXIS_ORDER)
         try:
             dev_array = mesh_utils.create_device_mesh(
@@ -95,6 +98,92 @@ class MeshSpec:
         except (ValueError, AssertionError):
             # CPU/virtual devices: topology-aware layout unavailable.
             dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, AXIS_ORDER)
+
+    def _dcn_factors(self, num_slices: int) -> Dict[str, int]:
+        """Split `num_slices` across the batch axes (data first, then
+        fsdp): gradient all-reduce / reduce-scatter tolerate DCN
+        latency, while tensor/seq/pipe collectives are per-layer and
+        must stay on ICI (SURVEY §2.7; reference
+        atorch/distributed/distributed.py:505-520 picks groups by
+        fabric hierarchy the same way)."""
+        import math
+
+        dcn = {a: 1 for a in AXIS_ORDER}
+        rem = num_slices
+        for axis in ("data", "fsdp"):
+            g = math.gcd(self.axis_sizes[axis], rem)
+            dcn[axis] = g
+            rem //= g
+        if rem != 1:
+            raise ValueError(
+                f"{num_slices} slices cannot be absorbed by the batch "
+                f"axes (data={self.data}, fsdp={self.fsdp}): model "
+                f"axes must not span DCN — resize data/fsdp so their "
+                f"product is divisible by the slice count"
+            )
+        return dcn
+
+    def _build_hybrid(self, devices: Sequence, num_slices: int) -> Mesh:
+        """Multi-slice topology: per-slice (ICI) mesh per slice, outer
+        (DCN) product across slices — jax's hybrid mesh when the
+        topology is real, manual assembly for virtual/CPU devices."""
+        dcn = self._dcn_factors(num_slices)
+        ici_shape = tuple(
+            self.axis_sizes[a] // dcn[a] for a in AXIS_ORDER
+        )
+        dcn_shape = tuple(dcn[a] for a in AXIS_ORDER)
+        try:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape,
+                dcn_shape,
+                devices=devices,
+                allow_split_physical_axes=True,
+            )
+        except (ValueError, AssertionError, KeyError, AttributeError):
+            # virtual devices: group by slice, lay each slice out as
+            # the ICI block, then interleave so the DCN factor is the
+            # OUTER (slow) component of every merged axis
+            groups: Dict[int, list] = {}
+            for d in devices:
+                groups.setdefault(_slice_id(d), []).append(d)
+            per_slice_n = 1
+            for s in ici_shape:
+                per_slice_n *= s
+            if any(len(g) != per_slice_n for g in groups.values()):
+                # truncation cut mid-slice (or slices are ragged): a
+                # hybrid layout is impossible — fall back to a flat
+                # mesh rather than crash (DCN-suboptimal but valid)
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "uneven slice groups %s for ici shape %s — "
+                    "building a flat (non-hybrid) mesh",
+                    {k: len(g) for k, g in groups.items()},
+                    ici_shape,
+                )
+                return Mesh(
+                    np.asarray(devices).reshape(
+                        tuple(
+                            self.axis_sizes[a] for a in AXIS_ORDER
+                        )
+                    ),
+                    AXIS_ORDER,
+                )
+            per_slice = np.stack(
+                [
+                    np.asarray(groups[k], dtype=object).reshape(
+                        ici_shape
+                    )
+                    for k in sorted(groups)
+                ]
+            )  # (num_slices, *ici_shape)
+            k = len(AXIS_ORDER)
+            arr = per_slice.reshape(dcn_shape + ici_shape)
+            perm = [x for i in range(k) for x in (i, i + k)]
+            dev_array = arr.transpose(perm).reshape(
+                tuple(self.axis_sizes[a] for a in AXIS_ORDER)
+            )
         return Mesh(dev_array, AXIS_ORDER)
 
     @classmethod
@@ -123,6 +212,15 @@ class MeshSpec:
             expert=expert,
             pipe=pipe,
         )
+
+
+def _slice_id(device) -> int:
+    """Which slice (DCN island) a device belongs to. Real multi-slice
+    TPU devices carry `slice_index`; everything else is one slice."""
+    idx = getattr(device, "slice_index", None)
+    if idx is not None:
+        return int(idx)
+    return 0
 
 
 def batch_spec(extra: Tuple = ()) -> PartitionSpec:

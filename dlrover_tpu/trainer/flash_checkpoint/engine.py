@@ -28,6 +28,7 @@ import numpy as np
 from dlrover_tpu.agent.ckpt_saver import (
     CKPT_QUEUE_NAME,
     SharedMemoryHandler,
+    ShmIntegrityError,
     read_tracker_step,
 )
 from dlrover_tpu.common.constants import NodeEnv
@@ -383,6 +384,11 @@ class CheckpointEngine:
             except Exception as e:  # noqa: BLE001
                 logger.exception("async checkpoint staging failed")
                 self._staging_error = e
+            finally:
+                # this thread dies now — drop its IPC connections so
+                # the server isn't left holding a parked handler per
+                # checkpoint at high save frequency
+                self.shm_handler.close_thread_conns()
 
         self._staging_thread = threading.Thread(target=_stage, daemon=True)
         self._staging_thread.start()
@@ -456,7 +462,10 @@ class CheckpointEngine:
     def load_from_memory(
         self, target: Any = None
     ) -> Tuple[int, Optional[Any]]:
-        meta, flat = self.shm_handler.load_flat_state()
+        # the shared lock keeps a concurrent writer resize (save path)
+        # from tearing this read — the saver takes it too
+        with self.shm_handler.lock:
+            meta, flat = self.shm_handler.load_flat_state()
         if meta is None or meta.step < 0:
             return -1, None
         return meta.step, unflatten_state(flat, meta.aux, target)
@@ -555,12 +564,16 @@ class CheckpointEngine:
         if mem_step >= 0 and mem_step >= disk_step:
             try:
                 step, state = self.load_from_memory(target)
-            except KeyError as e:
-                # shm shards don't cover the (resized) mesh — fall back
-                # to storage, whose merged shard files re-shard fully
+            except (KeyError, ValueError, ShmIntegrityError) as e:
+                # shm shards don't cover the (resized) mesh, or the
+                # mapping is stale/torn across a writer resize — fall
+                # back to storage, whose merged shard files re-shard
+                # fully. Crash-looping here strands a job whose disk
+                # checkpoint is fine (round-3 postmortem).
                 logger.warning(
                     "shm restore failed (%s); falling back to storage", e
                 )
+                step, state = -1, None
         if state is None:
             step, state = self.load_from_storage(
                 disk_step if disk_step >= 0 else None, target

@@ -273,6 +273,7 @@ class Trainer:
 
         window_t0 = time.monotonic()
         window_steps = 0
+        window_host_ms = 0.0
         stop = False
         try:
             for epoch in range(start_epoch, self.args.num_epochs):
@@ -284,7 +285,14 @@ class Trainer:
                     if skip > 0:
                         skip -= 1
                         continue
+                    # host time = python + dispatch, BEFORE the device
+                    # wait: the runtime-straggler signal (SPMD lockstep
+                    # equalizes wall time across hosts, not this)
+                    t_host = time.monotonic()
                     state, metrics = self.et.step(state, batch)
+                    window_host_ms += (
+                        time.monotonic() - t_host
+                    ) * 1e3
                     jax.block_until_ready(
                         metrics.get("loss", metrics)
                     )
@@ -331,12 +339,17 @@ class Trainer:
                         if self._mc is not None:
                             try:
                                 self._mc.report_global_step(
-                                    self.global_step
+                                    self.global_step,
+                                    host_compute_ms=(
+                                        window_host_ms
+                                        / max(window_steps, 1)
+                                    ),
                                 )
                             except Exception:
                                 pass
                         window_t0 = time.monotonic()
                         window_steps = 0
+                        window_host_ms = 0.0
                     if (
                         a.eval_steps
                         and self.global_step % a.eval_steps == 0

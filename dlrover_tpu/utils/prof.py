@@ -146,6 +146,31 @@ class StepProfiler:
         return achieved / (self.peak_tflops * 1e12 * n_dev)
 
 
+def detect_tpu_gen(default: str = "v5e") -> str:
+    """Chip generation from the live device's device_kind, with the
+    PALLAS_AXON_TPU_GEN env var as fallback. Known kind strings:
+    'TPU v4'; 'TPU v5 lite' / 'TPU v5e' (v5e); 'TPU v5' / 'TPU v5p'
+    (v5p — the bare 'v5' has NO suffix, so substring order matters);
+    'TPU v6 lite' / 'TPU v6e' (v6e)."""
+    import os
+
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001 — no backend yet
+        kind = ""
+    norm = kind.replace(" ", "").replace("lite", "e")
+    for gen in ("v6e", "v5e", "v5p", "v4"):
+        if gen in norm:
+            return gen
+    if "v5" in norm:
+        return "v5p"  # bare 'TPU v5' is the p-series
+    if "v6" in norm:
+        return "v6e"
+    return os.environ.get("PALLAS_AXON_TPU_GEN", default)
+
+
 def detect_peak_tflops() -> float:
     import jax
 
@@ -153,12 +178,9 @@ def detect_peak_tflops() -> float:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:
         return PEAK_TFLOPS["cpu"]
-    for gen, tf in PEAK_TFLOPS.items():
-        if gen in kind:
-            return tf
-    if "tpu" in kind:
-        return PEAK_TFLOPS["v5e"]
-    return PEAK_TFLOPS["cpu"]
+    if "tpu" not in kind:
+        return PEAK_TFLOPS["cpu"]
+    return PEAK_TFLOPS.get(detect_tpu_gen(), PEAK_TFLOPS["v5e"])
 
 
 def cost_analysis(fn: Callable, *args, **kw) -> Dict[str, float]:

@@ -30,6 +30,7 @@ class ProgramStats:
     argument_bytes: int = 0
     output_bytes: int = 0
     temp_bytes: int = 0
+    alias_bytes: int = 0  # donated outputs aliasing arguments
     generated_code_bytes: int = 0
     # HLO op histogram
     op_count: int = 0
@@ -41,10 +42,14 @@ class ProgramStats:
 
     @property
     def peak_hbm_bytes(self) -> int:
-        """Arguments + outputs + temps — the allocation the runtime
-        must fit alongside the weights already resident."""
+        """Arguments + outputs + temps, minus donated aliases (a
+        donated train state is counted once, not as arg AND out) —
+        the allocation the runtime must fit."""
         return (
-            self.argument_bytes + self.output_bytes + self.temp_bytes
+            self.argument_bytes
+            + self.output_bytes
+            + self.temp_bytes
+            - self.alias_bytes
         )
 
     @property
@@ -114,6 +119,9 @@ def extract_program_stats(compiled: Any) -> ProgramStats:
             getattr(mem, "output_size_in_bytes", 0)
         )
         stats.temp_bytes = int(getattr(mem, "temp_size_in_bytes", 0))
+        stats.alias_bytes = int(
+            getattr(mem, "alias_size_in_bytes", 0)
+        )
         stats.generated_code_bytes = int(
             getattr(mem, "generated_code_size_in_bytes", 0)
         )

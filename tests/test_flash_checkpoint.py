@@ -16,6 +16,7 @@ import pytest
 from dlrover_tpu.agent.ckpt_saver import (
     AsyncCheckpointSaver,
     SharedMemoryHandler,
+    ShmIntegrityError,
     read_tracker_step,
 )
 from dlrover_tpu.common.multi_process import (
@@ -106,6 +107,37 @@ class TestIPCPrimitives:
         assert got, "lock never reaped after holder death"
         other.release()
 
+    def test_same_proxy_cross_thread_contention(self, ipc):
+        # two threads of ONE process contending on the same SharedLock
+        # proxy (async ckpt staging vs. concurrent restore) must not
+        # deadlock: with a single shared socket the holder's release
+        # wedged behind the waiter's in-flight blocking acquire
+        lock = SharedLock("xthread", JOB)
+        held = threading.Event()
+        in_critical = [False]
+        exclusion_ok = [False]
+
+        def holder():
+            with lock:
+                in_critical[0] = True
+                held.set()
+                time.sleep(0.5)
+                in_critical[0] = False
+
+        def waiter():
+            held.wait(timeout=5)  # ensure holder wins the race
+            with lock:
+                exclusion_ok[0] = not in_critical[0]
+
+        t1 = threading.Thread(target=holder, daemon=True)
+        t2 = threading.Thread(target=waiter, daemon=True)
+        t1.start()
+        t2.start()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert not t1.is_alive() and not t2.is_alive(), "deadlocked"
+        assert exclusion_ok[0], "waiter entered while holder held"
+
     def test_segment_survives_creator_close(self, tmp_path):
         seg = SharedMemorySegment("seg_test_x", size=64, create=True)
         seg.buf[:4] = b"abcd"
@@ -137,6 +169,58 @@ class TestShmHandler:
         meta, loaded = h.load_flat_state()
         assert loaded["x"].shape == (4096,)
         h.close(unlink=True)
+
+    def test_stale_mapping_reattaches_after_writer_grow(self, ipc):
+        # round-3 postmortem: a reader that mapped the segment BEFORE a
+        # reshard grew it (16→8: per-host shards double) kept slicing
+        # its stale smaller mmap — silent truncation, then a reshape
+        # crash-loop in load_flat_state. The reader must re-attach.
+        writer = SharedMemoryHandler(JOB, node_rank=9)
+        reader = SharedMemoryHandler(JOB, node_rank=9)
+        writer.save_flat_state(1, {"x": np.zeros(4, np.float32)})
+        _, loaded = reader.load_flat_state()  # maps the small segment
+        assert loaded["x"].shape == (4,)
+        big = np.arange(8192, dtype=np.float32)
+        writer.save_flat_state(2, {"x": big})
+        meta, loaded = reader.load_flat_state()
+        assert meta.step == 2
+        np.testing.assert_array_equal(loaded["x"], big)
+        reader.close()
+        writer.close(unlink=True)
+
+    def test_stale_mapping_detects_unlink_recreate(self, ipc):
+        # unlink + recreate at the SAME size defeats any size-only
+        # check — the reader would silently serve the orphaned old
+        # inode. The inode comparison must force a re-attach.
+        writer = SharedMemoryHandler(JOB, node_rank=11)
+        reader = SharedMemoryHandler(JOB, node_rank=11)
+        writer.save_flat_state(1, {"x": np.zeros(64, np.float32)})
+        _, loaded = reader.load_flat_state()
+        assert loaded["x"].sum() == 0
+        writer.close(unlink=True)
+        writer2 = SharedMemoryHandler(JOB, node_rank=11)
+        new = np.full(64, 7.0, np.float32)
+        writer2.save_flat_state(2, {"x": new})
+        meta, loaded = reader.load_flat_state()
+        assert meta.step == 2
+        np.testing.assert_array_equal(loaded["x"], new)
+        reader.close()
+        writer2.close(unlink=True)
+
+    def test_integrity_error_when_segment_truncated(self, ipc):
+        # meta claims more bytes than the backing file holds (torn
+        # write / external truncation): the read must fail loudly with
+        # ShmIntegrityError, never return truncated arrays
+        h = SharedMemoryHandler(JOB, node_rank=10)
+        h.save_flat_state(3, {"x": np.zeros(1024, np.float32)})
+        path = h._segment.path
+        h.close()
+        os.truncate(path, 16)
+        reader = SharedMemoryHandler(JOB, node_rank=10)
+        with pytest.raises(ShmIntegrityError):
+            reader.load_flat_state()
+        reader.close()
+        os.unlink(path)
 
 
 class TestFlattenState:
@@ -219,6 +303,25 @@ class TestEngineEndToEnd:
         assert step == 9
         assert int(restored["step"]) == 9
         eng.close()
+
+    def test_load_falls_back_to_disk_on_torn_shm(self, tmp_path):
+        # shm meta points at a newer step than disk, but the segment is
+        # torn (truncated): load() must fall back to the committed disk
+        # checkpoint instead of crash-looping (round-3 postmortem)
+        eng = self._engine(tmp_path)
+        eng.save_to_storage(1, {"w": jnp.zeros(1024)})
+        assert eng.wait_for_persist(1, timeout=10)
+        eng.save_to_memory(2, {"w": jnp.ones(1024)})
+        seg_path = eng.shm_handler._segment.path
+        eng.shm_handler.close()
+        os.truncate(seg_path, 8)
+        step, restored = eng.load()
+        assert step == 1
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.zeros(1024, np.float32)
+        )
+        eng.close()
+        os.unlink(seg_path)
 
     def test_load_prefers_newer_memory(self, tmp_path):
         eng = self._engine(tmp_path)

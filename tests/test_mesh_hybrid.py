@@ -1,0 +1,107 @@
+"""DCN-hybrid mesh construction (VERDICT r3 missing #5).
+
+Multi-slice topologies must put the batch axes (data, fsdp) across DCN
+and keep model axes (tensor/seq/pipe/expert) inside a slice on ICI —
+SURVEY §2.7's comm-backend mapping; the reference picks process groups
+by fabric hierarchy in atorch/atorch/distributed/distributed.py:505-520.
+
+CPU devices carry no slice_index, so the two-slice topology is faked by
+monkeypatching `_slice_id` to split the 8 virtual devices into two
+islands of 4 — exercising the manual-assembly path `build` falls back
+to when jax's `create_hybrid_device_mesh` rejects virtual devices.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dlrover_tpu.parallel import mesh as mesh_mod
+from dlrover_tpu.parallel.mesh import AXIS_ORDER, MeshSpec
+
+
+@pytest.fixture()
+def two_slices(monkeypatch):
+    # devices 0-3 -> slice 0, devices 4-7 -> slice 1
+    monkeypatch.setattr(
+        mesh_mod, "_slice_id", lambda d: d.id // 4
+    )
+    return {d.id: d.id // 4 for d in jax.devices()}
+
+
+class TestHybridMesh:
+    def test_data_axis_spans_dcn_model_axes_stay_on_ici(
+        self, two_slices
+    ):
+        spec = MeshSpec(data=2, fsdp=2, tensor=2)
+        m = spec.build()
+        assert m.devices.shape == tuple(
+            spec.axis_sizes[a] for a in AXIS_ORDER
+        )
+        arr = m.devices  # (pipe, data, fsdp, expert, seq, tensor)
+        # every device with data-index 0 lives in slice 0, data-index 1
+        # in slice 1: the slice boundary IS the data axis
+        for di in range(2):
+            block = arr[:, di]
+            slices = {
+                two_slices[d.id] for d in block.flatten().tolist()
+            }
+            assert slices == {di}, (
+                f"data={di} spans slices {slices}"
+            )
+        # tensor pairs (innermost) never cross a slice
+        for idx in np.ndindex(arr.shape[:-1]):
+            row = arr[idx]
+            assert (
+                len({two_slices[d.id] for d in row.tolist()}) == 1
+            ), "tensor axis crosses DCN"
+
+    def test_fsdp_absorbs_slices_when_data_is_one(self, two_slices):
+        spec = MeshSpec(fsdp=4, tensor=2)
+        m = spec.build()
+        arr = m.devices
+        # dcn factor lands on fsdp: outer half of the fsdp axis is
+        # slice 0, inner half slice 1
+        for fi in range(4):
+            block = arr[:, :, fi]
+            slices = {
+                two_slices[d.id] for d in block.flatten().tolist()
+            }
+            assert len(slices) == 1
+        first = {
+            two_slices[d.id]
+            for d in arr[:, :, :2].flatten().tolist()
+        }
+        second = {
+            two_slices[d.id]
+            for d in arr[:, :, 2:].flatten().tolist()
+        }
+        assert first == {0} and second == {1}
+
+    def test_model_axes_cannot_span_dcn(self, two_slices):
+        with pytest.raises(ValueError, match="model"):
+            MeshSpec(tensor=8).build()
+
+    def test_single_slice_unchanged(self):
+        # no slice faking: the flat path must keep working
+        m = MeshSpec(data=2, fsdp=4).build()
+        assert m.devices.size == 8
+
+    def test_hybrid_mesh_runs_a_psum(self, two_slices):
+        # the assembled mesh is usable end-to-end: a data-axis psum
+        # over the hybrid layout compiles and produces the right value
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = MeshSpec(data=2, fsdp=2, tensor=2)
+        m = spec.build()
+        x = jnp.arange(16.0).reshape(8, 2)
+        sharding = NamedSharding(
+            m, PartitionSpec(("data", "fsdp"), "tensor")
+        )
+        xs = jax.device_put(x, sharding)
+        total = jax.jit(
+            lambda a: a.sum(), out_shardings=NamedSharding(
+                m, PartitionSpec()
+            )
+        )(xs)
+        assert float(total) == float(x.sum())

@@ -1,0 +1,151 @@
+"""Runtime straggler detection + master action (VERDICT r3 missing #6a).
+
+A slow-but-ALIVE worker cannot be caught by step rates under SPMD
+lockstep (the fast hosts wait in the collective, so every node's wall
+clock is identical) — the signal is per-node HOST compute ms reported
+with each step. These tests drive the REAL pipeline: MasterClient gRPC
+step reports with a genuine `time.sleep` in the slow worker's loop →
+speed monitor → diagnosis CheckStragglerOperator → master action
+(rendezvous cut, so the straggler's agent restarts its worker).
+
+Reference behavior: rdzv_manager.py:579 `get_straggler`, :607
+`_detect_stragglers` (bench-time ratio comparison — here extended from
+rendezvous-time to live training).
+"""
+
+import time
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.master.diagnosis import (
+    CheckStragglerOperator,
+    DataManager,
+    DiagnosisData,
+    DiagnosisDataType,
+    Inference,
+)
+from dlrover_tpu.master.master import DistributedJobMaster
+
+
+class TestStragglerOperator:
+    def _mgr(self, samples):
+        mgr = DataManager()
+        for nid, vals in samples.items():
+            for v in vals:
+                mgr.report(
+                    DiagnosisData(
+                        data_type=DiagnosisDataType.STEP_REPORT,
+                        node_id=nid,
+                        ts=time.time(),
+                        payload=v,
+                    )
+                )
+        return mgr
+
+    def test_flags_sustained_slow_node(self):
+        mgr = self._mgr({0: [50, 55, 52], 1: [400, 420, 410]})
+        op = CheckStragglerOperator(mgr)
+        out = op.infer(Inference("node", "is", "straggler?"))
+        assert [i.state for i in out] == ["straggler"]
+        assert out[0].evidence["node_id"] == 1
+        assert out[0].evidence["ratio"] > 2.0
+
+    def test_small_absolute_jitter_not_flagged(self):
+        # 3x ratio but only 20ms apart: below min_gap_ms, stays quiet
+        mgr = self._mgr({0: [10, 11, 10], 1: [30, 31, 30]})
+        out = CheckStragglerOperator(mgr).infer(
+            Inference("node", "is", "straggler?")
+        )
+        assert [i.state for i in out] == ["no-straggler"]
+
+    def test_single_node_never_flagged(self):
+        mgr = self._mgr({0: [500, 510, 505]})
+        out = CheckStragglerOperator(mgr).infer(
+            Inference("node", "is", "straggler?")
+        )
+        assert [i.state for i in out] == ["no-straggler"]
+
+    def test_global_step_rows_ignored(self):
+        # node_id -1 rows carry the global step count, not ms
+        mgr = self._mgr({-1: [100, 200, 300], 0: [50, 52, 51]})
+        out = CheckStragglerOperator(mgr).infer(
+            Inference("node", "is", "straggler?")
+        )
+        assert [i.state for i in out] == ["no-straggler"]
+
+
+class TestStragglerEndToEnd:
+    def test_slow_worker_detected_and_cut(self):
+        master = DistributedJobMaster(
+            min_nodes=1, max_nodes=2, poll_interval=0.1
+        )
+        master.start()
+        rdzv = master.servicer.rdzv_managers["training"]
+        try:
+            clients = [
+                MasterClient(
+                    master.addr, node_id=i, node_type="worker"
+                )
+                for i in (0, 1)
+            ]
+            for c in clients:
+                c.register_node()
+                c.join_rendezvous(local_world_size=8)
+            # drive round completion the way agents do: poll
+            # get_comm_world until both nodes land in one world
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                worlds = [
+                    c.get_comm_world()[2] for c in clients
+                ]
+                if all(len(w) == 2 for w in worlds):
+                    break
+                time.sleep(0.05)
+            assert rdzv.state()[1] == 2
+            round_before = rdzv.state()[0]
+
+            # fake SPMD lockstep training: both report each step at
+            # the same wall cadence, but node 1 spends its time in a
+            # REAL sleep (host compute) while node 0 idles in the
+            # "collective" — exactly what the wall clock hides
+            for step in range(1, 6):
+                t0 = time.monotonic()
+                time.sleep(0.3)  # node 1's injected slow host work
+                slow_ms = (time.monotonic() - t0) * 1e3
+                clients[1].report_global_step(
+                    step, host_compute_ms=slow_ms
+                )
+                clients[0].report_global_step(
+                    step, host_compute_ms=5.0
+                )
+                time.sleep(0.05)
+
+            # master poll loop: feed -> diagnose -> act
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if master.straggler_actions:
+                    break
+                time.sleep(0.1)
+            assert master.straggler_actions, (
+                "straggler never diagnosed/acted on"
+            )
+            act = master.straggler_actions[0]
+            assert act["node_id"] == 1
+            assert act["host_compute_ms"] > 100
+            # the action cut node 1 from the rendezvous: the world is
+            # invalidated so node 1's agent will restart its worker
+            rnd, world, _ = rdzv.state()
+            assert world == 0 or rnd > round_before
+            # rate-limited: repeated polls do not spam actions
+            n = len(master.straggler_actions)
+            time.sleep(0.5)
+            assert len(master.straggler_actions) == n
+            # and even past the cooldown, the PRE-action samples were
+            # purged — the relaunched worker is judged on fresh
+            # evidence only, so no re-flag without new slow reports
+            master.straggler_cooldown = 0.05
+            time.sleep(0.6)
+            assert len(master.straggler_actions) == n, (
+                "re-flagged from stale pre-restart samples"
+            )
+        finally:
+            master.stop()
