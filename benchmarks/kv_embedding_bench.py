@@ -12,9 +12,15 @@ Reference bar: tfplus KvVariable's reason to exist is sparse throughput
 """
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def _bench(fn, n_iter: int, rows_per_iter: int) -> float:

@@ -143,10 +143,17 @@ class LocalSocketServer:
                 token = req.get("owner", "")
                 with self._meta_lock:
                     cur = self._lock_owners.get(name)
-                    if cur is not None and cur != token:
-                        # retried release racing a new holder, OR a
-                        # double/stray release with an empty nonce:
-                        # either way the lock is not ours to release
+                    if cur != token:
+                        # Not ours to release. Covers: a retried
+                        # release racing a new holder (cur is the new
+                        # holder's nonce); a double/stray release
+                        # (empty nonce); AND cur=None — every
+                        # legitimate release follows an acquire whose
+                        # handler wrote the owner before replying, so
+                        # a missing entry means the lock was already
+                        # released (or a new acquire is mid-handshake
+                        # between lock.acquire() and its token write,
+                        # which a blind release here would break).
                         return False
                     self._lock_owners.pop(name, None)
                 if conn_held is not None:

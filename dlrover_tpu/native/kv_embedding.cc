@@ -19,12 +19,16 @@
 #include <atomic>
 #include <chrono>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <mutex>
 #include <random>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -44,6 +48,100 @@ struct Slot {
 };
 
 constexpr int kNumShards = 64;
+
+// Lazy persistent worker pool for the batched optimizer updates:
+// spawning+joining std::threads per call taxed the exact hot path the
+// batching exists to speed up (~100 us/call). Workers are detached and
+// park on a condition variable between jobs; the caller participates
+// in every job, so zero workers (1-core hosts) degrades to serial.
+// DLROVER_KV_THREADS overrides the worker count (tests use it to
+// exercise the pool on single-core machines).
+class WorkPool {
+ public:
+  static WorkPool& get() {
+    static WorkPool* p = new WorkPool();  // leaked: workers detached
+    return *p;
+  }
+
+  template <typename F>
+  void parallel_for(size_t total, F&& fn) {
+    if (workers_ == 0 || total <= 1) {
+      for (size_t i = 0; i < total; ++i) fn(i);
+      return;
+    }
+    Job job;
+    std::function<void(size_t)> wrapped =
+        [&fn](size_t i) { fn(i); };
+    job.fn = &wrapped;
+    job.total = total;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      cur_ = &job;
+      ++epoch_;
+    }
+    cv_.notify_all();
+    size_t i;
+    while ((i = job.next.fetch_add(1)) < total) wrapped(i);
+    std::unique_lock<std::mutex> lk(mu_);
+    cur_ = nullptr;  // late wakers see no job and keep parking
+    done_cv_.wait(lk, [&] { return job.active.load() == 0; });
+  }
+
+ private:
+  struct Job {
+    std::function<void(size_t)>* fn = nullptr;
+    size_t total = 0;
+    std::atomic<size_t> next{0};
+    std::atomic<int> active{0};
+  };
+
+  WorkPool() {
+    long n = -1;
+    if (const char* e = std::getenv("DLROVER_KV_THREADS")) {
+      n = std::strtol(e, nullptr, 10);
+    }
+    if (n < 0) {
+      const unsigned hw = std::thread::hardware_concurrency();
+      n = hw > 1 ? static_cast<long>(std::min(hw - 1, 7u)) : 0;
+    }
+    workers_ = static_cast<size_t>(n);
+    for (size_t t = 0; t < workers_; ++t) {
+      std::thread([this] { worker(); }).detach();
+    }
+  }
+
+  void worker() {
+    uint64_t seen = 0;
+    for (;;) {
+      Job* j;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] {
+          return epoch_ != seen && cur_ != nullptr;
+        });
+        seen = epoch_;
+        j = cur_;
+        // counted under mu_: the caller's done-wait (also under
+        // mu_) can never observe active==0 while we hold the job
+        j->active.fetch_add(1);
+      }
+      size_t i;
+      while ((i = j->next.fetch_add(1)) < j->total) (*j->fn)(i);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        j->active.fetch_sub(1);
+      }
+      done_cv_.notify_all();
+    }
+  }
+
+  size_t workers_ = 0;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  Job* cur_ = nullptr;
+  uint64_t epoch_ = 0;
+};
 
 struct Shard {
   std::unordered_map<int64_t, Slot> map;
@@ -117,14 +215,11 @@ class KvTable {
   void scatter_add(const int64_t* keys, int64_t n, const float* vals,
                    float alpha) {
     const uint64_t ver = ++version_;
-    for (int64_t i = 0; i < n; ++i) {
-      const float* v = vals + i * dim_;
-      with_slot(keys[i], 1, [&](Slot& slot) {
-        float* w = slot.data.data();
-        for (int64_t d = 0; d < dim_; ++d) w[d] += alpha * v[d];
-        slot.version = ver;
-      });
-    }
+    batched_update(keys, n, vals, 1, [&](const float* v, Slot& slot) {
+      float* w = slot.data.data();
+      for (int64_t d = 0; d < dim_; ++d) w[d] += alpha * v[d];
+      slot.version = ver;
+    });
   }
 
   // SGD on the touched rows.
@@ -137,18 +232,16 @@ class KvTable {
   void apply_adagrad(const int64_t* keys, int64_t n, const float* grads,
                      float lr, float eps) {
     const uint64_t ver = ++version_;
-    for (int64_t i = 0; i < n; ++i) {
-      const float* g2 = grads + i * dim_;
-      with_slot(keys[i], 2, [&](Slot& slot) {
-        float* w = slot.data.data();
-        float* acc = w + dim_;
-        for (int64_t d = 0; d < dim_; ++d) {
-          acc[d] += g2[d] * g2[d];
-          w[d] -= lr * g2[d] / (std::sqrt(acc[d]) + eps);
-        }
-        slot.version = ver;
-      });
-    }
+    batched_update(keys, n, grads, 2, [&](const float* g2_, Slot& slot) {
+      const float* __restrict__ g2 = g2_;
+      float* __restrict__ w = slot.data.data();
+      float* __restrict__ acc = w + dim_;
+      for (int64_t d = 0; d < dim_; ++d) {
+        acc[d] += g2[d] * g2[d];
+        w[d] -= lr * g2[d] / (std::sqrt(acc[d]) + eps);
+      }
+      slot.version = ver;
+    });
   }
 
   // Adam with optional sparse-group-lasso regularization — the
@@ -162,39 +255,44 @@ class KvTable {
     const uint64_t ver = ++version_;
     const float bc1 = 1.0f - std::pow(b1, static_cast<float>(step));
     const float bc2 = 1.0f - std::pow(b2, static_cast<float>(step));
-    for (int64_t i = 0; i < n; ++i) {
-      const float* gr = grads + i * dim_;
-      with_slot(keys[i], 3, [&](Slot& slot) {
-        float* w = slot.data.data();
-        float* m = w + dim_;
-        float* v = w + 2 * dim_;
-        for (int64_t d = 0; d < dim_; ++d) {
-          m[d] = b1 * m[d] + (1 - b1) * gr[d];
-          v[d] = b2 * v[d] + (1 - b2) * gr[d] * gr[d];
-          const float mh = m[d] / bc1;
-          const float vh = v[d] / bc2;
-          w[d] -= lr * mh / (std::sqrt(vh) + eps);
+    // pre-fold the bias corrections into per-term scales: one divide
+    // per row instead of two per element
+    const float mscale = 1.0f / bc1;
+    const float vscale = 1.0f / bc2;
+    batched_update(keys, n, grads, 3, [&](const float* gr_, Slot& slot) {
+      // __restrict__ lets the compiler vectorize the hot loop (sqrtps/
+      // divps): w/m/v are disjoint dim_-sized segments of slot.data and
+      // gr lives in the dedup accumulator, never aliasing them
+      const float* __restrict__ gr = gr_;
+      float* __restrict__ w = slot.data.data();
+      float* __restrict__ m = w + dim_;
+      float* __restrict__ v = w + 2 * dim_;
+      for (int64_t d = 0; d < dim_; ++d) {
+        m[d] = b1 * m[d] + (1 - b1) * gr[d];
+        v[d] = b2 * v[d] + (1 - b2) * gr[d] * gr[d];
+        const float mh = m[d] * mscale;
+        const float vh = v[d] * vscale;
+        w[d] -= lr * mh / (std::sqrt(vh) + eps);
+      }
+      if (l2 > 0.f) {
+        const float shrink = 1.0f / (1.0f + lr * l2);
+        for (int64_t d = 0; d < dim_; ++d) w[d] *= shrink;
+      }
+      if (l1 > 0.f) {
+        // group soft-threshold on the row norm
+        float norm = 0.f;
+        for (int64_t d = 0; d < dim_; ++d) norm += w[d] * w[d];
+        norm = std::sqrt(norm);
+        const float thresh = lr * l1;
+        if (norm <= thresh) {
+          std::memset(w, 0, sizeof(float) * dim_);
+        } else {
+          const float scale = (norm - thresh) / norm;
+          for (int64_t d = 0; d < dim_; ++d) w[d] *= scale;
         }
-        if (l2 > 0.f) {
-          const float shrink = 1.0f / (1.0f + lr * l2);
-          for (int64_t d = 0; d < dim_; ++d) w[d] *= shrink;
-        }
-        if (l1 > 0.f) {
-          // group soft-threshold on the row norm
-          float norm = 0.f;
-          for (int64_t d = 0; d < dim_; ++d) norm += w[d] * w[d];
-          norm = std::sqrt(norm);
-          const float thresh = lr * l1;
-          if (norm <= thresh) {
-            std::memset(w, 0, sizeof(float) * dim_);
-          } else {
-            const float scale = (norm - thresh) / norm;
-            for (int64_t d = 0; d < dim_; ++d) w[d] *= scale;
-          }
-        }
-        slot.version = ver;
-      });
-    }
+      }
+      slot.version = ver;
+    });
   }
 
   // Remove rows with freq < min_freq OR idle longer than max_idle_sec.
@@ -568,12 +666,16 @@ class KvTable {
     disk_index_.erase(it);
     return true;
   }
-  Shard& shard(int64_t key) {
+  size_t shard_index(int64_t key) const {
     // splitmix64 scramble → shard index
     uint64_t x = static_cast<uint64_t>(key) + 0x9e3779b97f4a7c15ULL;
     x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
     x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
-    return shards_[(x ^ (x >> 31)) % kNumShards];
+    return (x ^ (x >> 31)) % kNumShards;
+  }
+
+  Shard& shard(int64_t key) {
+    return shards_[shard_index(key)];
   }
 
   void init_value(int64_t key, Slot& slot) {
@@ -588,6 +690,110 @@ class KvTable {
       std::normal_distribution<float> dist(0.f, init_scale_);
       for (int64_t d = 0; d < dim_; ++d) slot.data[d] = dist(rng);
     }
+  }
+
+  // Batched write path: group rows by shard, DEDUP-ACCUMULATE the
+  // gradients of duplicate keys (single vectorized float add per
+  // dup), then take each shard lock ONCE and apply the optimizer a
+  // single pass per UNIQUE key; disjoint shard groups fan out across
+  // threads. This replaces both the per-row lock+hash round-trip
+  // (the sparse update ran ~10x slower than the raw lookup) and the
+  // caller's python-side np.unique + np.add.at (which dominated at
+  // ~5 ms per 8k batch). row_fn(acc_grad_row, slot) sees the SUMMED
+  // gradient exactly as the dedup'd path did before.
+  // Lock order shard -> disk is preserved: each worker thread holds
+  // only ITS shard's lock when promote_from_disk takes disk_mu_.
+  template <typename F>
+  void batched_update(const int64_t* keys, int64_t n,
+                      const float* grads, int state_mult, F&& row_fn) {
+    std::vector<std::vector<int64_t>> by_shard(kNumShards);
+    for (int64_t i = 0; i < n; ++i)
+      by_shard[shard_index(keys[i])].push_back(i);
+    const size_t need = static_cast<size_t>(dim_) * state_mult;
+    const int64_t dim = dim_;
+    auto run_shard = [&](size_t s) {
+      const auto& rows = by_shard[s];
+      if (rows.empty()) return;
+      // dedup + accumulate OUTSIDE the lock: writers in other threads
+      // own other shards, readers only need the lock for the apply.
+      // Common case (callers already dedup'd / few collisions): no
+      // copy at all — each unique points at its grads row; the first
+      // duplicate triggers a copy into `acc` (reserved upfront, so
+      // row pointers stay stable) and sums there.
+      std::unordered_map<int64_t, int64_t> uidx;
+      uidx.reserve(rows.size() * 2);
+      std::vector<int64_t> ukeys;
+      std::vector<const float*> gsrc;
+      std::vector<int64_t> accpos;  // offset into acc, -1 = none
+      std::vector<float> acc;
+      ukeys.reserve(rows.size());
+      gsrc.reserve(rows.size());
+      accpos.reserve(rows.size());
+      acc.reserve(rows.size() * dim);  // no realloc: pointers stable
+      for (int64_t i : rows) {
+        const int64_t key = keys[i];
+        const float* g = grads + i * dim;
+        auto [it, fresh] = uidx.try_emplace(
+            key, static_cast<int64_t>(ukeys.size()));
+        if (fresh) {
+          ukeys.push_back(key);
+          gsrc.push_back(g);
+          accpos.push_back(-1);
+        } else {
+          const int64_t u = it->second;
+          if (accpos[u] < 0) {
+            // first dup for this key: materialize the accumulator
+            accpos[u] = static_cast<int64_t>(acc.size());
+            acc.insert(acc.end(), gsrc[u], gsrc[u] + dim);
+            gsrc[u] = acc.data() + accpos[u];
+          }
+          float* a = acc.data() + accpos[u];
+          for (int64_t d = 0; d < dim; ++d) a[d] += g[d];
+        }
+      }
+      Shard& sh = shards_[s];
+      std::lock_guard<std::mutex> g(sh.mu);
+      // resolve all slots first, then apply with the NEXT rows
+      // prefetched: slot payloads live at random heap addresses, so
+      // the apply loop is memory-latency bound without this (the
+      // update's cost scales with slot bytes, not flops)
+      std::vector<Slot*> slots(ukeys.size());
+      for (size_t u = 0; u < ukeys.size(); ++u) {
+        const int64_t key = ukeys[u];
+        auto it = sh.map.find(key);
+        if (it == sh.map.end() && promote_from_disk(key, sh)) {
+          it = sh.map.find(key);
+        }
+        if (it == sh.map.end()) {
+          it = sh.map.emplace(key, Slot{}).first;
+          init_value(key, it->second);
+        }
+        if (it->second.data.size() < need) {
+          it->second.data.resize(need, 0.f);
+        }
+        slots[u] = &it->second;
+      }
+      constexpr size_t kAhead = 8;
+      for (size_t u = 0; u < slots.size(); ++u) {
+        if (u + kAhead < slots.size()) {
+          const float* p = slots[u + kAhead]->data.data();
+          for (size_t b = 0; b < need * sizeof(float);
+               b += 64) {
+            __builtin_prefetch(
+                reinterpret_cast<const char*>(p) + b, 1);
+          }
+        }
+        row_fn(gsrc[u], *slots[u]);
+      }
+    };
+    // parallelism only pays off on big batches; below the threshold
+    // the pool handoff overhead beats the win
+    if (n < 4096) {
+      for (size_t s = 0; s < kNumShards; ++s) run_shard(s);
+      return;
+    }
+    WorkPool::get().parallel_for(
+        kNumShards, [&](size_t s) { run_shard(s); });
   }
 
   // find-or-create + run f(slot), all under the shard lock so a
