@@ -1,0 +1,47 @@
+#!/bin/bash
+# One-shot real-TPU measurement pass (r4 task #1/#5): probe the tunnel,
+# then capture every number the round needs while the chip is alive.
+# Results land in benchmarks/tpu_run_<ts>/ as raw logs; bench.py's JSON
+# line is what the driver records as BENCH_r{N}.json.
+#
+# Usage: bash benchmarks/run_tpu_suite.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%Y%m%d_%H%M%S)
+OUT=${1:-benchmarks/tpu_run_$TS}
+mkdir -p "$OUT"
+
+echo "== probe =="
+timeout 240 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((1024,1024), jnp.bfloat16)
+(x @ x).block_until_ready()
+print('ALIVE', jax.devices()[0].device_kind)
+" > "$OUT/probe.log" 2>&1
+if ! grep -q ALIVE "$OUT/probe.log"; then
+  echo "tunnel down — aborting (see $OUT/probe.log)"
+  exit 1
+fi
+cat "$OUT/probe.log"
+
+run() {  # name, timeout_s, cmd...
+  local name=$1 to=$2; shift 2
+  echo "== $name =="
+  timeout "$to" "$@" > "$OUT/$name.log" 2>&1
+  echo "rc=$? (log: $OUT/$name.log)"
+  grep -E '^\{' "$OUT/$name.log" | tail -20
+}
+
+# 1. flagship training bench (the driver's metric) — measured ckpt axes
+run bench 2400 python bench.py
+
+# 2. fused CE timing (r3: unmeasured; may unlock batch 16)
+run fused_ce 2400 python benchmarks/fused_ce_probe.py
+
+# 3. flash-attention kernel vs XLA reference
+run flash_attn 3600 python benchmarks/flash_attention_bench.py
+
+# 4. decode/KV-cache: prefill + per-token + cached-vs-uncached
+run decode 2400 python benchmarks/decode_bench.py
+
+echo "== done: $OUT =="

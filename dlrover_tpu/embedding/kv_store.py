@@ -70,14 +70,16 @@ def _build_so() -> str:
                 ):
                     return so
                 tmp = f"{so}.{os.getpid()}.tmp"
-                # x86-64-v3 (AVX2 baseline), NOT -march=native: the
-                # .so may be prebuilt into an image or land in a
-                # shared ~/.cache, and native ISA extensions from the
-                # build host would SIGILL on older fleet CPUs
+                # baseline ISA only (no -march): the .so may be
+                # prebuilt into an image or land in a shared ~/.cache
+                # crossing heterogeneous hosts, where newer ISA
+                # extensions SIGILL with no diagnostic. Measured cost
+                # of forgoing AVX2 here: none — the batched update is
+                # memory-latency bound, not vector-ALU bound
+                # (benchmarks/RESULTS.md).
                 cmd = [
-                    "g++", "-O3", "-march=x86-64-v3", "-shared",
-                    "-fPIC", "-std=c++17", "-pthread", "-o", tmp,
-                    _SRC,
+                    "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                    "-pthread", "-o", tmp, _SRC,
                 ]
                 logger.info(
                     "building kv_embedding native lib: %s", " ".join(cmd)

@@ -188,7 +188,14 @@ def partition_rules(cfg: LlamaConfig):
 
         moe_rules = moe_partition_rules()
     return moe_rules + [
-        (r"embed/weight", P("tensor", "fsdp")),
+        # D-axis sharding ONLY for the embedding: a vocab-sharded
+        # table turns `weight[tokens]` into an involuntary full
+        # all-gather of the table every step (SPMD "involuntary full
+        # rematerialization", surfaced by the 7B v5p-64 AOT compile).
+        # Sharding D over fsdp+tensor keeps per-device bytes identical
+        # while the gather stays local; the only comm left is the
+        # activation-sized all-gather at the constrain below it.
+        (r"embed/weight", P(None, ("fsdp", "tensor"))),
         (r"layers/wq", P("pipe", "fsdp", "tensor")),
         (r"layers/wk", P("pipe", "fsdp", "tensor")),
         (r"layers/wv", P("pipe", "fsdp", "tensor")),
