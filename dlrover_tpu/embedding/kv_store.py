@@ -29,13 +29,30 @@ _BUILD_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 
 
+_CXX_FLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17", "-pthread"]
+
+
+def _so_fresh(so: str) -> bool:
+    """Fresh = newer than the source AND built with the CURRENT flags
+    (the `.flags` sidecar): an mtime-only check kept serving cached
+    .so files built with since-removed ISA flags, so a flag fix never
+    reached deployed caches."""
+    if not os.path.exists(so) or (
+        os.path.getmtime(so) < os.path.getmtime(_SRC)
+    ):
+        return False
+    try:
+        with open(so + ".flags") as f:
+            return f.read() == " ".join(_CXX_FLAGS)
+    except OSError:
+        return False
+
+
 def _so_path() -> str:
     """Prefer a fresh prebuilt .so next to the source (no toolchain
     needed at runtime); else build there if writable, falling back to a
     per-user cache dir (installed read-only site-packages)."""
-    if os.path.exists(_SO) and (
-        os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
-    ):
+    if _so_fresh(_SO):
         return _SO
     if os.access(_NATIVE_DIR, os.W_OK):
         return _SO
@@ -52,9 +69,7 @@ def _build_so() -> str:
     so = _so_path()
     # fresh prebuilt .so: no lock file, no toolchain — works on
     # read-only installs
-    if os.path.exists(so) and (
-        os.path.getmtime(so) >= os.path.getmtime(_SRC)
-    ):
+    if _so_fresh(so):
         return so
     with _BUILD_LOCK:
         # cross-process exclusion: g++ writes the output in place, so
@@ -65,9 +80,7 @@ def _build_so() -> str:
         with open(lock_path, "w") as lockf:
             fcntl.flock(lockf, fcntl.LOCK_EX)
             try:
-                if os.path.exists(so) and (
-                    os.path.getmtime(so) >= os.path.getmtime(_SRC)
-                ):
+                if _so_fresh(so):
                     return so
                 tmp = f"{so}.{os.getpid()}.tmp"
                 # baseline ISA only (no -march): the .so may be
@@ -77,10 +90,7 @@ def _build_so() -> str:
                 # of forgoing AVX2 here: none — the batched update is
                 # memory-latency bound, not vector-ALU bound
                 # (benchmarks/RESULTS.md).
-                cmd = [
-                    "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                    "-pthread", "-o", tmp, _SRC,
-                ]
+                cmd = ["g++"] + _CXX_FLAGS + ["-o", tmp, _SRC]
                 logger.info(
                     "building kv_embedding native lib: %s", " ".join(cmd)
                 )
@@ -94,6 +104,8 @@ def _build_so() -> str:
                     )
                     raise
                 os.replace(tmp, so)
+                with open(so + ".flags", "w") as f:
+                    f.write(" ".join(_CXX_FLAGS))
                 return so
             finally:
                 fcntl.flock(lockf, fcntl.LOCK_UN)

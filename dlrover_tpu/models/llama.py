@@ -106,6 +106,20 @@ class LlamaConfig:
         )
 
     @classmethod
+    def llama3_8b(cls, **kw) -> "LlamaConfig":
+        """Llama-3 family: GQA (8 kv heads), 128k vocab, theta 500k
+        (public architecture; the GQA + large-vocab shape stresses the
+        kv-head sharding and the fused-CE path differently than the
+        llama2 presets)."""
+        defaults = dict(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, mlp_dim=14336, max_seq_len=8192,
+            rope_theta=500000.0,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
     def tiny(cls, **kw) -> "LlamaConfig":
         """Test-size model: runs on the 8-device CPU mesh in seconds."""
         defaults = dict(
@@ -180,7 +194,9 @@ def partition_rules(cfg: LlamaConfig):
 
     Megatron-style TP: column-parallel wq/wk/wv/w_gate/w_up shard the
     output dim on "tensor"; row-parallel wo/w_down shard the input dim.
-    FSDP shards the other dim; vocab sharded on tensor for embed/head.
+    FSDP shards the other dim. lm_head shards vocab on tensor; the
+    EMBEDDING shards D only (vocab replicated in layout) so the token
+    gather stays local — see the embed rule's comment below.
     """
     moe_rules = []
     if cfg.n_experts > 0:

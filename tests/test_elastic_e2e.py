@@ -398,8 +398,12 @@ class TestTwoAgentElasticResize:
         # load (sampled before our own phases began) so a shared CI
         # box doesn't fail on timing while every functional phase
         # passed (42s idle / 93s at ~50% load on the 1-core dev box)
+        # graded, not binary: 93s was measured at ~0.5 external load
+        # on the 1-core dev box, so a hard 60s gate below load 1.5
+        # would still flake in exactly the shared-box band it should
+        # tolerate. 60s idle, +120s per unit of pre-test load, 240 cap.
         load = self._load0
-        limit = 60.0 if load < 1.5 else 240.0
+        limit = min(60.0 + 120.0 * load, 240.0)
         print(
             f"\n[e2e] recovery stall (kill -> first post-restore "
             f"step): {stall_s:.1f}s (pre-test load {load:.2f}, "

@@ -33,6 +33,51 @@ def test_num_params_matches(cfg):
     assert actual == llama.num_params(cfg)
 
 
+def test_llama3_8b_preset_shapes():
+    # 8B-class GQA preset: verify the architecture WITHOUT allocating
+    # 8B params (eval_shape is abstract)
+    cfg = llama.LlamaConfig.llama3_8b()
+    assert cfg.n_kv_heads == 8 and cfg.n_heads == 32  # GQA 4:1
+    abstract = jax.eval_shape(
+        lambda k: llama.init_params(cfg, k), jax.random.PRNGKey(0)
+    )
+    total = sum(
+        np.prod(x.shape)
+        for x in jax.tree_util.tree_leaves(abstract)
+    )
+    assert 7.5e9 < total < 8.5e9, total
+    assert total == llama.num_params(cfg)
+    lyr = abstract["layers"]
+    # kv projections carry n_kv_heads * head_dim columns, not n_heads
+    assert lyr["wk"].shape == (32, 4096, 8 * cfg.head_dim)
+    assert lyr["wq"].shape == (32, 4096, 32 * cfg.head_dim)
+
+
+def test_llama3_architecture_trains_tiny():
+    # the llama3 SHAPE (GQA 4:1, big-theta rope) end to end on the
+    # mesh at toy size — the preset's architecture, not its scale
+    cfg = llama.LlamaConfig.tiny(
+        n_heads=4, n_kv_heads=1, rope_theta=500000.0
+    )
+    acc = accelerate(
+        init_params=lambda k: llama.init_params(cfg, k),
+        loss_fn=lambda p, b, m: llama.loss_fn(cfg, p, b, mesh=m),
+        rules=llama.partition_rules(cfg),
+        optimizer=optax.adam(1e-2),
+        strategy=Strategy(mesh=MeshSpec(data=2, fsdp=2, tensor=2)),
+    )
+    state = acc.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 17), 0, cfg.vocab_size
+    )
+    batch = acc.shard_batch({"tokens": tokens})
+    losses = []
+    for _ in range(15):
+        state, m = acc.train_step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
 @pytest.mark.parametrize(
     "spec",
     [
