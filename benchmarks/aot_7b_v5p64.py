@@ -1,4 +1,7 @@
-"""Llama-2-7B on v5p-64: fit + sharding proof by topology-AOT compile.
+"""7B/8B-class model on v5p-64: fit + sharding proof by topology-AOT
+compile. AOT_MODEL picks the preset (llama2_7b default, llama3_8b for
+the GQA/128k-vocab family); the report lands at AOT_7B_V5P64.json for
+the default and AOT_<MODEL>_V5P64.json otherwise.
 
 The north star (BASELINE.md) is 7B on a v5p-64 pod slice at >=40% MFU;
 one chip cannot *train* it, but the full sharded train step can be
@@ -32,8 +35,12 @@ ensure_cpu_if_forced()
 V5P_HBM_GB = 95.0
 MESH = {"data": 2, "fsdp": 16, "tensor": 2}  # dp x fsdp x tp = 64
 PER_DEVICE_BATCH = 1  # tokens/batch ride the 32 batch shards
+MODEL = os.environ.get("AOT_MODEL", "llama2_7b")  # or llama3_8b
 REPORT = os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), "AOT_7B_V5P64.json"
+    os.path.dirname(os.path.abspath(__file__)),
+    "AOT_7B_V5P64.json"
+    if MODEL == "llama2_7b"
+    else f"AOT_{MODEL.upper()}_V5P64.json",
 )
 
 
@@ -55,7 +62,8 @@ def main() -> int:
         )
         return 2
 
-    cfg = llama.LlamaConfig.llama2_7b(
+    preset = getattr(llama.LlamaConfig, MODEL)
+    cfg = preset(
         max_seq_len=4096, remat=True, remat_policy="proj"
     )
     spec = MeshSpec(**MESH)
@@ -127,7 +135,7 @@ def main() -> int:
         if any(t in key for t in ("wq", "wo", "embed", "w_up")):
             sample[key] = str(sh.spec)
     report = {
-        "model": "llama2_7b",
+        "model": MODEL,
         "params_b": round(llama.num_params(cfg) / 1e9, 2),
         "mesh": MESH,
         "global_batch": global_batch,

@@ -20,6 +20,7 @@ REPORT = os.path.join(REPO, "benchmarks", "AOT_7B_V5P64.json")
 def test_7b_v5p64_aot_fit_and_sharding():
     env = {
         **os.environ,
+        "AOT_MODEL": "llama2_7b",  # pin: the tool is env-driven
         "DLROVER_TPU_FORCE_CPU": "1",
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": (

@@ -13,6 +13,7 @@ Reference behavior: rdzv_manager.py:579 `get_straggler`, :607
 rendezvous-time to live training).
 """
 
+import os
 import time
 
 from dlrover_tpu.agent.master_client import MasterClient
@@ -71,6 +72,111 @@ class TestStragglerOperator:
             Inference("node", "is", "straggler?")
         )
         assert [i.state for i in out] == ["no-straggler"]
+
+
+class TestStragglerAgentLoop:
+    """Full loop with REAL agents: slow worker (actual sleep) reports
+    host-compute ms → master diagnoses → cuts it from the rendezvous →
+    its supervising agent detects the membership change and RESTARTS
+    the worker. The piece TestStragglerEndToEnd stubs (clients instead
+    of agents) proven with the real supervisor."""
+
+    WORKER = """
+import os, sys, time
+from dlrover_tpu.agent.master_client import MasterClient
+
+addr = os.environ["DLROVER_TPU_MASTER_ADDR"]
+nid = int(os.environ["DLROVER_TPU_NODE_ID"])
+log_dir = os.environ["STRAGGLER_LOG_DIR"]
+mc = MasterClient(addr, node_id=nid, node_type="worker")
+
+with open(os.path.join(log_dir, f"w{nid}.log"), "a") as f:
+    f.write(f"start t={time.time():.3f}\\n")
+
+slow = nid == 1
+for step in range(1, 400):
+    t0 = time.monotonic()
+    if slow:
+        time.sleep(0.3)  # the injected slow host work
+    host_ms = (time.monotonic() - t0) * 1e3 + 5.0
+    mc.report_global_step(step, host_compute_ms=host_ms)
+    time.sleep(0.05)
+"""
+
+    def test_master_cut_restarts_slow_worker(
+        self, tmp_path, monkeypatch
+    ):
+        import sys
+        import threading
+
+        from dlrover_tpu.agent.training import (
+            ElasticLaunchConfig,
+            ElasticTrainingAgent,
+        )
+
+        script = tmp_path / "worker.py"
+        script.write_text(self.WORKER)
+        monkeypatch.setenv("STRAGGLER_LOG_DIR", str(tmp_path))
+        master = DistributedJobMaster(
+            min_nodes=1, max_nodes=2, poll_interval=0.1
+        )
+        agents = []
+        threads = []
+        try:
+            master.start()
+            rdzv = master.servicer.rdzv_managers["training"]
+            rdzv.update_rdzv_params(
+                min_nodes=1, max_nodes=2, waiting_timeout=1.0
+            )
+            for nid in (0, 1):
+                client = MasterClient(
+                    master.addr, node_id=nid, node_type="worker"
+                )
+                config = ElasticLaunchConfig(
+                    min_nodes=1,
+                    max_nodes=2,
+                    max_restarts=4,
+                    monitor_interval=0.2,
+                    rdzv_timeout=60,
+                    job_name=f"strag-{master.addr.rsplit(':', 1)[-1]}"
+                    f"-h{nid}",
+                    log_dir=str(tmp_path),
+                )
+                agent = ElasticTrainingAgent(
+                    config, [sys.executable, str(script)], client
+                )
+                agents.append(agent)
+                t = threading.Thread(target=agent.run, daemon=True)
+                threads.append(t)
+                t.start()
+
+            def starts(nid):
+                try:
+                    with open(tmp_path / f"w{nid}.log") as f:
+                        return f.read().count("start")
+                except OSError:
+                    return 0
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if master.straggler_actions and starts(1) >= 2:
+                    break
+                time.sleep(0.25)
+            assert master.straggler_actions, "never diagnosed"
+            assert master.straggler_actions[0]["node_id"] == 1
+            assert starts(1) >= 2, (
+                "slow worker never restarted by its agent"
+            )
+        finally:
+            # stop sets an event; JOIN so run()'s finally (kill worker
+            # subprocess, saver/IPC teardown) completes before the
+            # master goes away or pytest exits (daemon threads get
+            # hard-killed at interpreter exit, orphaning workers)
+            for a in agents:
+                a.stop()
+            for t in threads:
+                t.join(timeout=15)
+            master.stop()
 
 
 class TestStragglerEndToEnd:
