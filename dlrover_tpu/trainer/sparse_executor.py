@@ -46,6 +46,7 @@ class SparseTrainingExecutor:
         # one interval of updates (reference: incremental export cycle)
         self.ckpt_interval_steps = ckpt_interval_steps
         self.global_step = 0
+        self._host_ms_window = 0.0
         self.rebuild_count = 0
         self._local_version = 0
         self._rebuild_callbacks: List[Callable[[int], None]] = []
@@ -143,7 +144,11 @@ class SparseTrainingExecutor:
                 v = self._cluster_version()
                 if v != self._local_version:
                     self.failover(v)
+            t_host = time.monotonic()
             metrics = dict(self.train_step(batch) or {})
+            self._host_ms_window += (
+                time.monotonic() - t_host
+            ) * 1e3
             self.global_step += 1
             if (
                 self.ckpt_interval_steps > 0
@@ -155,9 +160,22 @@ class SparseTrainingExecutor:
                 and self.global_step % self.report_steps == 0
             ):
                 try:
-                    self.mc.report_global_step(self.global_step)
+                    # host-compute ms rides the step report: the PS
+                    # path isn't lockstep, but the same straggler
+                    # operator consumes it (master/diagnosis.py)
+                    self.mc.report_global_step(
+                        self.global_step,
+                        host_compute_ms=self._host_ms_window
+                        / self.report_steps,
+                    )
                 except Exception:  # noqa: BLE001
-                    pass
+                    # a dead master must not kill training — but a
+                    # silent pass once hid a signature mismatch as
+                    # total step-report loss, so log it
+                    logger.warning(
+                        "step report failed", exc_info=True
+                    )
+                self._host_ms_window = 0.0
             if 0 < max_steps <= self.global_step:
                 break
         return metrics

@@ -51,3 +51,34 @@ def test_7b_v5p64_aot_fit_and_sharding():
     wq = report["sample_shardings"]["opt_state/0/.mu/layers/wq"]
     assert "fsdp" in wq and "tensor" in wq
     assert report["collective_count"] > 0
+
+
+def test_llama3_8b_v5p64_aot_fit():
+    # the AOT_MODEL dispatch + non-default report path + GQA/128k-vocab
+    # preset, pinned the same way as the default
+    env = {
+        **os.environ,
+        "AOT_MODEL": "llama3_8b",
+        "DLROVER_TPU_FORCE_CPU": "1",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            "--xla_force_host_platform_device_count=64 "
+            "--xla_disable_hlo_passes=all-reduce-promotion"
+        ),
+    }
+    proc = subprocess.run(
+        [sys.executable, TOOL],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(
+        os.path.join(REPO, "benchmarks", "AOT_LLAMA3_8B_V5P64.json")
+    ) as f:
+        report = json.load(f)
+    assert report["model"] == "llama3_8b"
+    assert report["params_b"] > 7.8
+    assert report["fits_with_10pct_headroom"] is True

@@ -87,7 +87,7 @@ class TestSparseExecutor:
         def update_cluster_version(self, v, t="local"):
             self.acks.append((v, t))
 
-        def report_global_step(self, s):
+        def report_global_step(self, s, host_compute_ms=0.0):
             self.steps.append(s)
 
     def test_failover_on_version_change(self, tmp_path):

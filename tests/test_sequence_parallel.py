@@ -124,6 +124,25 @@ def test_long_context_8k_ring():
     )
 
 
+def test_long_context_16k_ring():
+    """Double the proven length: seq 16384 over the 8-way seq mesh —
+    the unsharded [S, S] score matrix would be 256M entries/head; each
+    ring device holds 2048-sized chunks. Sized (h=1, d=8) to keep the
+    single-core CI cost bounded; the LENGTH is the point."""
+    mesh = MeshSpec(seq=8).build()
+    s, h, d = 16384, 1, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, s, h, d), jnp.float32)
+    ref = dot_product_attention(q, k, v, causal=True, impl="reference")
+    out = jax.jit(
+        lambda q, k, v: sp_attention(q, k, v, mesh, mode="ring")
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=3e-4, atol=5e-5
+    )
+
+
 def test_long_context_grad_flows():
     """Backward through the 8k ring program (remat inside the scan) —
     the training direction of the long-context path."""

@@ -13,7 +13,6 @@ Reference behavior: rdzv_manager.py:579 `get_straggler`, :607
 rendezvous-time to live training).
 """
 
-import os
 import time
 
 from dlrover_tpu.agent.master_client import MasterClient
