@@ -150,10 +150,64 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
     return out
 
 
+class _Watchdog:
+    """Heartbeat deadline for the WHOLE bench run: if no progress beat
+    arrives within `timeout_s`, emit a parseable JSON line and exit.
+    r3's bench died rc=1 with no output when the TPU tunnel dropped —
+    and the tunnel can drop at ANY phase (backend dial, the timed
+    loop, the multi-GB checkpoint D2H probe), so a disarm-once guard
+    on the first op would miss the later hangs. A diagnosed line
+    beats a silent timeout."""
+
+    def __init__(self, timeout_s: float):
+        import threading
+
+        self.timeout_s = timeout_s
+        self._last = time.monotonic()
+        self._done = threading.Event()
+        self._phase = "backend init + first compile"
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def beat(self, phase: str):
+        self._last = time.monotonic()
+        self._phase = phase
+
+    def done(self):
+        self._done.set()
+
+    def _run(self):
+        while not self._done.wait(5.0):
+            idle = time.monotonic() - self._last
+            if idle > self.timeout_s:
+                print(
+                    json.dumps(
+                        {
+                            "metric": "tokens_per_sec_per_chip",
+                            "value": 0.0,
+                            "unit": "tok/s/chip",
+                            "vs_baseline": 0.0,
+                            "detail": {
+                                "error": (
+                                    f"no progress for {idle:.0f}s "
+                                    f"during '{self._phase}' — "
+                                    "backend/tunnel unreachable"
+                                )
+                            },
+                        }
+                    ),
+                    flush=True,
+                )
+                os._exit(3)
+
+
 def main():
     from dlrover_tpu.utils.platform import ensure_cpu_if_forced
 
     ensure_cpu_if_forced()  # DLROVER_TPU_FORCE_CPU=1 -> CPU smoke mode
+
+    watchdog = _Watchdog(
+        float(os.environ.get("BENCH_PROBE_TIMEOUT", "600"))
+    )
 
     import jax
     import jax.numpy as jnp
@@ -216,6 +270,7 @@ def main():
     for _ in range(warmup):
         state, metrics = acc.train_step(state, batch)
     _sync(metrics)
+    watchdog.beat("timed loop")
 
     t0 = time.monotonic()
     for _ in range(iters):
@@ -237,7 +292,9 @@ def main():
     # ---- checkpoint axes (reference: flash_checkpoint.md 362-408) ----
     # save-blocking ms of the async shm staging, restore stall from shm,
     # and a goodput estimate from those + the measured step time.
+    watchdog.beat("checkpoint probe (D2H staging + restore)")
     ckpt = _bench_checkpoint(state, step_ms=elapsed / iters * 1e3)
+    watchdog.done()
 
     print(
         json.dumps(

@@ -14,13 +14,14 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOL = os.path.join(REPO, "benchmarks", "aot_7b_v5p64.py")
-REPORT = os.path.join(REPO, "benchmarks", "AOT_7B_V5P64.json")
 
 
-def test_7b_v5p64_aot_fit_and_sharding():
+def _run_aot(model: str, report_name: str) -> dict:
+    """Run the AOT tool for `model` in its own 64-virtual-device
+    process and load the report it wrote."""
     env = {
         **os.environ,
-        "AOT_MODEL": "llama2_7b",  # pin: the tool is env-driven
+        "AOT_MODEL": model,  # pin: the tool is env-driven
         "DLROVER_TPU_FORCE_CPU": "1",
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": (
@@ -37,8 +38,12 @@ def test_7b_v5p64_aot_fit_and_sharding():
         cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
-    with open(REPORT) as f:
-        report = json.load(f)
+    with open(os.path.join(REPO, "benchmarks", report_name)) as f:
+        return json.load(f)
+
+
+def test_7b_v5p64_aot_fit_and_sharding():
+    report = _run_aot("llama2_7b", "AOT_7B_V5P64.json")
     assert report["params_b"] > 6.5  # a real 7B, not a stand-in
     assert report["mesh"] == {"data": 2, "fsdp": 16, "tensor": 2}
     assert report["fits_with_10pct_headroom"] is True
@@ -56,29 +61,7 @@ def test_7b_v5p64_aot_fit_and_sharding():
 def test_llama3_8b_v5p64_aot_fit():
     # the AOT_MODEL dispatch + non-default report path + GQA/128k-vocab
     # preset, pinned the same way as the default
-    env = {
-        **os.environ,
-        "AOT_MODEL": "llama3_8b",
-        "DLROVER_TPU_FORCE_CPU": "1",
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": (
-            "--xla_force_host_platform_device_count=64 "
-            "--xla_disable_hlo_passes=all-reduce-promotion"
-        ),
-    }
-    proc = subprocess.run(
-        [sys.executable, TOOL],
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=1200,
-        cwd=REPO,
-    )
-    assert proc.returncode == 0, proc.stderr[-2000:]
-    with open(
-        os.path.join(REPO, "benchmarks", "AOT_LLAMA3_8B_V5P64.json")
-    ) as f:
-        report = json.load(f)
+    report = _run_aot("llama3_8b", "AOT_LLAMA3_8B_V5P64.json")
     assert report["model"] == "llama3_8b"
     assert report["params_b"] > 7.8
     assert report["fits_with_10pct_headroom"] is True

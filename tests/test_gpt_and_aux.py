@@ -88,7 +88,7 @@ class TestSparseExecutor:
             self.acks.append((v, t))
 
         def report_global_step(self, s, host_compute_ms=0.0):
-            self.steps.append(s)
+            self.steps.append((s, host_compute_ms))
 
     def test_failover_on_version_change(self, tmp_path):
         layer = self._FakeLayer()
@@ -117,6 +117,14 @@ class TestSparseExecutor:
         assert layer.loads == 1          # restored after rebuild
         assert (2, "local") in mc.acks   # acked to master
         assert ex.global_step == 30 and len(mc.steps) == 6
+        # host-compute ms rides every report (straggler signal) and
+        # the window RESETS between reports: a per-report average,
+        # not an unbounded running sum
+        ms = [m for _, m in mc.steps]
+        assert all(m > 0 for m in ms), ms
+        assert max(ms) < 10 * min(ms), (
+            f"window not reset between reports: {ms}"
+        )
 
     def test_no_master_runs_standalone(self):
         ex = SparseTrainingExecutor(
