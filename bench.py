@@ -37,14 +37,32 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
     The save-*blocking* number needs no probe — the async engine's
     critical path is an on-device snapshot dispatch, which is measured
     on the full state."""
+    import glob
     import shutil
     import tempfile
 
     import jax
 
+    from dlrover_tpu.common.multi_process import SHM_DIR
     from dlrover_tpu.trainer.flash_checkpoint.engine import (
         CheckpointEngine,
     )
+
+    # sweep leftovers of PREVIOUS bench runs first: a watchdog
+    # os._exit (tunnel died mid-probe) skips the finally below, and
+    # /dev/shm segments outlive the process — repeated timed-out runs
+    # would otherwise fill /dev/shm on the shared box
+    for p in glob.glob(
+        os.path.join(SHM_DIR, "dlrover_tpu_ckpt_benchjob*")
+    ):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    for d in glob.glob(
+        os.path.join(tempfile.gettempdir(), "bench_ckpt_*")
+    ):
+        shutil.rmtree(d, ignore_errors=True)
 
     PROBE_FRAC = 0.2
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")

@@ -118,12 +118,14 @@ class TestSparseExecutor:
         assert (2, "local") in mc.acks   # acked to master
         assert ex.global_step == 30 and len(mc.steps) == 6
         # host-compute ms rides every report (straggler signal) and
-        # the window RESETS between reports: a per-report average,
-        # not an unbounded running sum
+        # the window RESETS after each report: deterministic check —
+        # step 30 is a report boundary, so a missing reset leaves the
+        # whole run's accumulated time in the window (timing-ratio
+        # assertions were load-flaky on a busy 1-core box)
         ms = [m for _, m in mc.steps]
         assert all(m > 0 for m in ms), ms
-        assert max(ms) < 10 * min(ms), (
-            f"window not reset between reports: {ms}"
+        assert ex._host_ms_window == 0.0, (
+            "window not reset after report"
         )
 
     def test_no_master_runs_standalone(self):
