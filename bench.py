@@ -51,18 +51,33 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
     # sweep leftovers of PREVIOUS bench runs first: a watchdog
     # os._exit (tunnel died mid-probe) skips the finally below, and
     # /dev/shm segments outlive the process — repeated timed-out runs
-    # would otherwise fill /dev/shm on the shared box
+    # would otherwise fill /dev/shm on the shared box. Age-gated to
+    # 2x the watchdog deadline so a CONCURRENT bench's live state is
+    # never yanked out from under its probe.
+    min_age_s = 2 * float(
+        os.environ.get("BENCH_PROBE_TIMEOUT", "600")
+    )
+    now = time.time()
+
+    def _stale(path):
+        try:
+            return now - os.path.getmtime(path) > min_age_s
+        except OSError:
+            return False
+
     for p in glob.glob(
         os.path.join(SHM_DIR, "dlrover_tpu_ckpt_benchjob*")
     ):
-        try:
-            os.remove(p)
-        except OSError:
-            pass
+        if _stale(p):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
     for d in glob.glob(
         os.path.join(tempfile.gettempdir(), "bench_ckpt_*")
     ):
-        shutil.rmtree(d, ignore_errors=True)
+        if _stale(d):
+            shutil.rmtree(d, ignore_errors=True)
 
     PROBE_FRAC = 0.2
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")

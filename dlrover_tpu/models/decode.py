@@ -70,20 +70,42 @@ def _cached_attention(q, k_cache, v_cache, q_positions, scale):
 
 
 def _write_cache_and_attend(
-    q, k, v, k_cache, v_cache, positions, start, head_dim
+    q, k, v, k_cache, v_cache, positions, start, head_dim,
+    attn_impl: str = "auto",
 ):
     """THE decode-specific core, shared by both family blocks: write
     this chunk's K/V into the cache at `start` and attend over the
-    whole buffer under the position mask."""
+    whole buffer under the position mask.
+
+    Prefill fast path: at a STATIC start of 0 the chunk IS the entire
+    valid cache prefix, so the position-masked attention over the full
+    [B, max_len] buffer (dense scores, max_len >> prompt is wasted
+    work, and no flash kernel) reduces to plain causal attention over
+    the chunk — which dispatches to the Pallas flash kernel on TPU
+    (ops/attention.dot_product_attention). Decode steps (traced
+    `start`) keep the masked-cache formulation."""
     k_cache = jax.lax.dynamic_update_slice(
         k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
     )
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
     )
-    attn = _cached_attention(
-        q, k_cache, v_cache, positions, float(head_dim) ** -0.5
-    )
+    if isinstance(start, int) and start == 0 and q.shape[1] > 1:
+        from dlrover_tpu.ops.attention import dot_product_attention
+
+        # honor an explicit 'reference', but soften 'flash' to 'auto':
+        # a strict flash demand hard-fails on prompt lengths no block
+        # size divides (fine to enforce at training seq lengths,
+        # wrong to crash inference over) — auto still picks the flash
+        # kernel whenever the prompt tiles
+        attn = dot_product_attention(
+            q, k, v, causal=True,
+            impl="reference" if attn_impl == "reference" else "auto",
+        )
+    else:
+        attn = _cached_attention(
+            q, k_cache, v_cache, positions, float(head_dim) ** -0.5
+        )
     return attn, k_cache, v_cache
 
 
@@ -105,7 +127,8 @@ def _block(
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
     q, k, v = _attn_qkv(cfg, None, h, lp, positions)
     attn, k_cache, v_cache = _write_cache_and_attend(
-        q, k, v, k_cache, v_cache, positions, start, cfg.head_dim
+        q, k, v, k_cache, v_cache, positions, start, cfg.head_dim,
+        attn_impl=getattr(cfg, "attn_impl", "auto"),
     )
     x = _attn_residual(cfg, None, x, attn, lp)
     x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
@@ -120,7 +143,8 @@ def _block_gpt(cfg, x, lp, k_cache, v_cache, positions, start):
 
     q, k, v = gpt._attn_qkv(cfg, x, lp)
     attn, k_cache, v_cache = _write_cache_and_attend(
-        q, k, v, k_cache, v_cache, positions, start, cfg.head_dim
+        q, k, v, k_cache, v_cache, positions, start, cfg.head_dim,
+        attn_impl=getattr(cfg, "attn_impl", "auto"),
     )
     x = gpt._attn_residual(cfg, x, attn, lp)
     x = gpt._mlp_residual(cfg, x, lp)
