@@ -54,8 +54,11 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
     # would otherwise fill /dev/shm on the shared box. Age-gated to
     # 2x the watchdog deadline so a CONCURRENT bench's live state is
     # never yanked out from under its probe.
-    min_age_s = 2 * float(
-        os.environ.get("BENCH_PROBE_TIMEOUT", "600")
+    # floored: a run with a SHORT watchdog timeout (tests set 0.1s)
+    # must not collapse the guard and yank a concurrent bench's state
+    min_age_s = max(
+        2 * float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
+        1200.0,
     )
     now = time.time()
 
@@ -209,7 +212,11 @@ class _Watchdog:
         self._done.set()
 
     def _run(self):
-        while not self._done.wait(5.0):
+        # tick bounded by the deadline: with a sub-second test
+        # timeout, a 5s fixed tick would let a fast smoke run finish
+        # before the first check (flaky assert on rc)
+        tick = min(5.0, max(self.timeout_s, 0.05))
+        while not self._done.wait(tick):
             idle = time.monotonic() - self._last
             if idle > self.timeout_s:
                 print(

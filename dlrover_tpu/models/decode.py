@@ -72,25 +72,28 @@ def _cached_attention(q, k_cache, v_cache, q_positions, scale):
 def _write_cache_and_attend(
     q, k, v, k_cache, v_cache, positions, start, head_dim,
     attn_impl: str = "auto",
+    plain_causal: bool = False,
 ):
     """THE decode-specific core, shared by both family blocks: write
     this chunk's K/V into the cache at `start` and attend over the
     whole buffer under the position mask.
 
-    Prefill fast path: at a STATIC start of 0 the chunk IS the entire
-    valid cache prefix, so the position-masked attention over the full
-    [B, max_len] buffer (dense scores, max_len >> prompt is wasted
-    work, and no flash kernel) reduces to plain causal attention over
-    the chunk — which dispatches to the Pallas flash kernel on TPU
-    (ops/attention.dot_product_attention). Decode steps (traced
-    `start`) keep the masked-cache formulation."""
+    `plain_causal` is the prefill fast path, asserted by the CALLER
+    that owns the invariant (prefill(): start==0 and positions are a
+    dense arange, so the chunk IS the entire valid cache prefix): the
+    position-masked attention over the full [B, max_len] buffer
+    (dense scores, max_len >> prompt wasted, no flash kernel) reduces
+    to plain causal attention over the chunk — the Pallas flash
+    kernel on TPU (ops/attention.dot_product_attention). Shape/type
+    sniffing here would silently mis-handle future callers with
+    padded or packed positions."""
     k_cache = jax.lax.dynamic_update_slice(
         k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
     )
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
     )
-    if isinstance(start, int) and start == 0 and q.shape[1] > 1:
+    if plain_causal:
         from dlrover_tpu.ops.attention import dot_product_attention
 
         # honor an explicit 'reference', but soften 'flash' to 'auto':
@@ -117,6 +120,7 @@ def _block(
     v_cache: jax.Array,
     positions: jax.Array,    # [B, S] global positions of x's tokens
     start,                   # scalar: cache slot of x's first token
+    plain_causal: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder block writing its K/V into the cache. Prefill is
     S=prompt_len/start=0; decode is S=1/start=pos. The projections,
@@ -129,13 +133,17 @@ def _block(
     attn, k_cache, v_cache = _write_cache_and_attend(
         q, k, v, k_cache, v_cache, positions, start, cfg.head_dim,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
+        plain_causal=plain_causal,
     )
     x = _attn_residual(cfg, None, x, attn, lp)
     x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
     return x, k_cache, v_cache
 
 
-def _block_gpt(cfg, x, lp, k_cache, v_cache, positions, start):
+def _block_gpt(
+    cfg, x, lp, k_cache, v_cache, positions, start,
+    plain_causal: bool = False,
+):
     """GPT-2 pre-LN block with cache write — built from gpt.py's own
     helpers; the cache write + masked attention are the only
     decode-specific parts (positions are consumed at embedding time)."""
@@ -145,6 +153,7 @@ def _block_gpt(cfg, x, lp, k_cache, v_cache, positions, start):
     attn, k_cache, v_cache = _write_cache_and_attend(
         q, k, v, k_cache, v_cache, positions, start, cfg.head_dim,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
+        plain_causal=plain_causal,
     )
     x = gpt._attn_residual(cfg, x, attn, lp)
     x = gpt._mlp_residual(cfg, x, lp)
@@ -170,7 +179,10 @@ def _check_positional_capacity(cfg, max_len: int):
         )
 
 
-def _forward_cached(cfg, params, tokens, cache, positions, start):
+def _forward_cached(
+    cfg, params, tokens, cache, positions, start,
+    plain_causal: bool = False,
+):
     """tokens [B,S] → logits [B,S,V], writing the cache at
     [start, start+S). Family dispatch: llama (RoPE/GQA/RMSNorm) or
     GPT-2 (learned positions, pre-LN, tied wte head)."""
@@ -189,7 +201,8 @@ def _forward_cached(cfg, params, tokens, cache, positions, start):
         h = carry
         layer_params, kc, vc = inp
         h, kc, vc = block(
-            cfg, h, layer_params, kc, vc, positions, start
+            cfg, h, layer_params, kc, vc, positions, start,
+            plain_causal=plain_causal,
         )
         return h, (kc, vc)
 
@@ -221,8 +234,11 @@ def prefill(
     """Fill the cache from a prompt; returns (last-token logits, cache)."""
     b, p = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(p), (b, p))
+    # prefill owns the fast-path invariant: start 0, dense arange
+    # positions -> the chunk is the whole valid prefix
     logits, cache = _forward_cached(
-        cfg, params, tokens, cache, positions, 0
+        cfg, params, tokens, cache, positions, 0,
+        plain_causal=p > 1,
     )
     return logits[:, -1], cache
 

@@ -217,3 +217,45 @@ class TestGptDecode:
         # within capacity: fine
         out = generate(cfg, params, prompt, 6)
         assert out.shape == (1, 16)
+
+
+class TestPrefillFastPath:
+    def test_prefill_dispatches_plain_causal_attention(
+        self, monkeypatch
+    ):
+        """Pin the r4 optimization: prefill (static start=0, S>1) must
+        go through ops.attention.dot_product_attention (the flash
+        path on TPU), NOT the dense masked-cache formulation; decode
+        steps must NOT take the fast path (their start is traced)."""
+        import dlrover_tpu.models.decode as dec
+        from dlrover_tpu.models import llama
+        from dlrover_tpu.ops import attention as attn_mod
+
+        calls = []
+        real = attn_mod.dot_product_attention
+
+        def spy(*a, **kw):
+            calls.append(kw.get("impl"))
+            return real(*a, **kw)
+
+        monkeypatch.setattr(
+            attn_mod, "dot_product_attention", spy
+        )
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size
+        )
+        cache = dec.init_kv_cache(cfg, 2, 16)
+        _, cache = dec.prefill(cfg, params, prompt, cache)
+        # layers run under lax.scan: the body traces ONCE, so the
+        # fast path shows up as one traced call regardless of depth
+        assert len(calls) >= 1, (
+            "prefill did not take the plain-causal fast path"
+        )
+        calls.clear()
+        tok = prompt[:, -1]
+        dec.decode_step(cfg, params, tok, cache, 8)
+        assert calls == [], (
+            "decode step wrongly took the prefill fast path"
+        )
