@@ -1,0 +1,122 @@
+"""Runnable RLHF PPO example: a tiny llama actor learns to emit a
+target token (reward = +1 per target token generated).
+
+The full PPO stack in miniature — cached rollouts (models/decode.py
+drives generation for dense llama actors), GAE, clipped policy + value
+losses, KL penalty against the frozen reference — on the CPU backend in
+under a minute. Reference shape: atorch's rl/ trainer + vllm rollout
+backend (atorch/rl/, inference_backend/vllm_backend.py).
+
+Run: DLROVER_TPU_FORCE_CPU=1 python examples/train_ppo_tiny.py
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.utils.platform import ensure_cpu_if_forced  # noqa: E402
+
+ensure_cpu_if_forced()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from dlrover_tpu.models import llama  # noqa: E402
+from dlrover_tpu.rl import (  # noqa: E402
+    ModelEngine,
+    PpoConfig,
+    PpoTrainer,
+    sample_tokens,
+)
+from dlrover_tpu.rl.model_engine import ModelSpec  # noqa: E402
+
+MAX_LEN = 12
+TARGET = 3
+
+
+def main():
+    cfg = llama.LlamaConfig.tiny(
+        vocab_size=32, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        mlp_dim=64, max_seq_len=MAX_LEN,
+    )
+
+    def actor_apply(params, tokens):
+        return llama.apply(cfg, params, tokens)
+
+    def critic_apply(params, tokens):
+        h = params["embed"][tokens]  # [B, L, D]
+        return h @ params["v"]
+
+    k = jax.random.PRNGKey(0)
+    ka, kc = jax.random.split(k)
+    critic_params = {
+        "embed": jax.random.normal(kc, (cfg.vocab_size, 16)) * 0.1,
+        "v": jnp.zeros((16,)),
+    }
+
+    def reward_fn(tokens, prompt_lens):
+        pos = jnp.arange(tokens.shape[1])[None, :]
+        gen = pos >= prompt_lens[:, None]
+        return jnp.sum(
+            (tokens == TARGET) & gen, axis=1
+        ).astype(jnp.float32)
+
+    eng = ModelEngine(
+        actor=ModelSpec(
+            actor_apply,
+            llama.init_params(cfg, ka),
+            trainable=True,
+            # enables the KV-cache rollout engine (models/decode.py
+            # prefill + per-token decode) instead of the O(L)
+            # full-re-forward sampler
+            model_cfg=cfg,
+        ),
+        critic=ModelSpec(
+            critic_apply, critic_params, trainable=True
+        ),
+        reward_fn=reward_fn,
+    )
+    trainer = PpoTrainer(
+        eng,
+        PpoConfig(
+            max_len=MAX_LEN, minibatch_size=8, epochs=2,
+            kl_coef=0.02,
+        ),
+        actor_opt=optax.adam(3e-2),
+        critic_opt=optax.adam(1e-2),
+    )
+
+    batch = 16
+    prompts = jnp.zeros((batch, MAX_LEN), jnp.int32).at[:, 0].set(1)
+    lens = jnp.full((batch,), 1, jnp.int32)
+
+    def target_rate(key):
+        toks, _ = sample_tokens(
+            eng.actor.apply_fn, eng.actor.params, prompts, lens,
+            MAX_LEN, key=key,
+        )
+        return float(
+            (np.asarray(toks[:, 1:]) == TARGET).mean()
+        )
+
+    print(f"target-token rate before: {target_rate(jax.random.PRNGKey(99)):.3f}")
+    for i in range(10):
+        metrics = trainer.step(prompts, lens, jax.random.PRNGKey(i))
+        shown = {
+            k: round(v, 4)
+            for k, v in sorted(metrics.items())
+            if k in ("loss", "pg_loss", "value_loss", "kl")
+        }
+        print(f"ppo step {i + 1}: {shown}")
+    after = target_rate(jax.random.PRNGKey(99))
+    print(f"target-token rate after: {after:.3f}")
+    print(f"done: policy_improved={after > 0.3}")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,9 @@ def test_bench_smoke_emits_driver_contract():
             **os.environ,
             "DLROVER_TPU_FORCE_CPU": "1",
             "JAX_PLATFORMS": "cpu",
+            # pin: an externally exported short timeout (debugging the
+            # sibling watchdog test) must not flip this into rc=3
+            "BENCH_PROBE_TIMEOUT": "600",
         },
         capture_output=True,
         text=True,
