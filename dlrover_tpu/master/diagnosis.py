@@ -303,16 +303,29 @@ class DiagnosisManager:
     """Owns the data store + periodic checks; the master polls
     `diagnose()` from its run loop."""
 
-    def __init__(self, hang_timeout: float = 300.0):
+    def __init__(
+        self,
+        hang_timeout: float = 300.0,
+        straggler_ratio: float = None,
+        straggler_min_gap_ms: float = None,
+    ):
         # the store must retain data well past the hang window or the
         # hang operator's evidence is GC'd before it can ever conclude
         self.data = DataManager(ttl=max(600.0, 4 * hang_timeout))
+        # None defers to CheckStragglerOperator's own defaults — the
+        # ONE place the numbers live (passing literals here again
+        # would fork the defaults across layers)
+        strag_kw = {}
+        if straggler_ratio is not None:
+            strag_kw["ratio"] = straggler_ratio
+        if straggler_min_gap_ms is not None:
+            strag_kw["min_gap_ms"] = straggler_min_gap_ms
         self._chain = InferenceChain(
             [
                 CheckTrainingHangOperator(self.data, hang_timeout),
                 CheckFailureNodeOperator(self.data),
                 CheckChipMetricsOperator(self.data),
-                CheckStragglerOperator(self.data),
+                CheckStragglerOperator(self.data, **strag_kw),
             ]
         )
 

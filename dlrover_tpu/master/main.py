@@ -35,6 +35,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--poll-interval", type=float, default=2.0)
     p.add_argument("--hang-timeout", type=float, default=1800.0)
     p.add_argument(
+        "--straggler-ratio", type=float, default=None,
+        help="flag a node whose host-compute ms exceeds this multiple "
+        "of the fastest peer (default: operator's 2.0)",
+    )
+    p.add_argument(
+        "--straggler-cooldown", type=float, default=300.0,
+        help="seconds between straggler actions per node",
+    )
+    p.add_argument(
         "worker_command",
         nargs=argparse.REMAINDER,
         metavar="-- CMD [ARG...]",
@@ -79,6 +88,8 @@ def build_master(args: argparse.Namespace):
         job_args=job_args,
         poll_interval=args.poll_interval,
         hang_timeout=args.hang_timeout,
+        straggler_ratio=args.straggler_ratio,
+        straggler_cooldown=args.straggler_cooldown,
         job_name=args.job_name,
     )
 

@@ -155,6 +155,9 @@ class DistributedJobMaster(JobMaster):
         k8s_client=None,
         ray_client=None,
         auto_scale_interval: float = 300.0,
+        straggler_ratio: float = None,  # None = operator default
+        straggler_min_gap_ms: float = None,
+        straggler_cooldown: float = 300.0,
         **kw,
     ):
         super().__init__(port=port, **kw)
@@ -173,14 +176,16 @@ class DistributedJobMaster(JobMaster):
         self.watcher = None
         self.auto_scaler = None
         self.diagnosis = DiagnosisManager(
-            hang_timeout=self.hang_timeout
+            hang_timeout=self.hang_timeout,
+            straggler_ratio=straggler_ratio,
+            straggler_min_gap_ms=straggler_min_gap_ms,
         )
         self.servicer.diagnosis_sink = self.diagnosis.report
         self.last_diagnosis = []
         self._fed_ts = {}  # (data_type, node_id) -> last fed ts
         # runtime-straggler action log + per-node rate limit
         self.straggler_actions = []
-        self.straggler_cooldown = 300.0
+        self.straggler_cooldown = straggler_cooldown
         self._straggler_acted = {}
         nm = self.servicer.node_manager
         nm.register_callback(_DiagnosisFeedCallback(self.diagnosis))
