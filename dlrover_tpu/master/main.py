@@ -40,8 +40,15 @@ def parse_args(argv=None) -> argparse.Namespace:
         "of the fastest peer (default: operator's 2.0)",
     )
     p.add_argument(
-        "--straggler-cooldown", type=float, default=300.0,
-        help="seconds between straggler actions per node",
+        "--straggler-min-gap-ms", type=float, default=None,
+        help="minimum absolute host-ms gap over the fastest peer "
+        "before flagging (default: operator's 100 ms — lower it for "
+        "fast-step workloads)",
+    )
+    p.add_argument(
+        "--straggler-cooldown", type=float, default=None,
+        help="seconds between straggler actions per node (default: "
+        "master's 300 s)",
     )
     p.add_argument(
         "worker_command",
@@ -89,7 +96,14 @@ def build_master(args: argparse.Namespace):
         poll_interval=args.poll_interval,
         hang_timeout=args.hang_timeout,
         straggler_ratio=args.straggler_ratio,
-        straggler_cooldown=args.straggler_cooldown,
+        straggler_min_gap_ms=args.straggler_min_gap_ms,
+        # None defers to the master's default — the CLI carries no
+        # second copy of the number
+        **(
+            {"straggler_cooldown": args.straggler_cooldown}
+            if args.straggler_cooldown is not None
+            else {}
+        ),
         job_name=args.job_name,
     )
 
