@@ -97,13 +97,7 @@ def build_master(args: argparse.Namespace):
         hang_timeout=args.hang_timeout,
         straggler_ratio=args.straggler_ratio,
         straggler_min_gap_ms=args.straggler_min_gap_ms,
-        # None defers to the master's default — the CLI carries no
-        # second copy of the number
-        **(
-            {"straggler_cooldown": args.straggler_cooldown}
-            if args.straggler_cooldown is not None
-            else {}
-        ),
+        straggler_cooldown=args.straggler_cooldown,
         job_name=args.job_name,
     )
 

@@ -157,7 +157,7 @@ class DistributedJobMaster(JobMaster):
         auto_scale_interval: float = 300.0,
         straggler_ratio: float = None,  # None = operator default
         straggler_min_gap_ms: float = None,
-        straggler_cooldown: float = 300.0,
+        straggler_cooldown: float = None,  # None = 300s
         **kw,
     ):
         super().__init__(port=port, **kw)
@@ -185,7 +185,10 @@ class DistributedJobMaster(JobMaster):
         self._fed_ts = {}  # (data_type, node_id) -> last fed ts
         # runtime-straggler action log + per-node rate limit
         self.straggler_actions = []
-        self.straggler_cooldown = straggler_cooldown
+        self.straggler_cooldown = (
+            300.0 if straggler_cooldown is None
+            else straggler_cooldown
+        )
         self._straggler_acted = {}
         nm = self.servicer.node_manager
         nm.register_callback(_DiagnosisFeedCallback(self.diagnosis))

@@ -8,7 +8,7 @@ are masked per batch, and the model learns to reconstruct them.
 Run standalone (CPU):
   DLROVER_TPU_FORCE_CPU=1 python examples/train_bert_mlm.py
 or through the elastic stack:
-  dlrover-tpu-run --nnodes=1 examples/train_bert_mlm.py --steps 40
+  dlrover-tpu-run --nnodes=1 examples/train_bert_mlm.py
 """
 
 import argparse
@@ -28,6 +28,7 @@ import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 
 import dlrover_tpu  # noqa: E402
+from dlrover_tpu.agent.monitor import write_step_metrics  # noqa: E402
 from dlrover_tpu.models import bert  # noqa: E402
 from dlrover_tpu.parallel.accelerate import Strategy, accelerate  # noqa: E402
 from dlrover_tpu.parallel.mesh import MeshSpec  # noqa: E402
@@ -80,6 +81,8 @@ def main():
         last = float(metrics["loss"])
         if first is None:
             first = last
+        # feed the master's SpeedMonitor (hang/straggler inputs)
+        write_step_metrics(step, loss=last)
         if step % 10 == 0 or step == 1:
             print(f"step {step} mlm_loss {last:.4f}", flush=True)
 
