@@ -25,7 +25,7 @@ from dlrover_tpu.utils.prof import (  # noqa: E402
 )
 
 
-def _bench_checkpoint(state, step_ms: float) -> dict:
+def _bench_checkpoint(state, step_ms: float, beat=None) -> dict:
     """Measure the two non-throughput north-star axes (BASELINE.md):
     flash-checkpoint save blocking and shm-restore stall, plus a modeled
     goodput estimate.
@@ -82,7 +82,9 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
         if _stale(d):
             shutil.rmtree(d, ignore_errors=True)
 
-    PROBE_FRAC = 0.2
+    if beat is None:
+        beat = lambda phase: None  # noqa: E731
+
     ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
     eng = CheckpointEngine(ckpt_dir, job_name="benchjob")
     out = {}
@@ -92,25 +94,53 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
         )
         out["ckpt_gb"] = round(nbytes / 1e9, 2)
 
-        # probe: slice every leaf to ~20% along axis 0 — SAME tree
-        # structure and leaf count as the real state (so the engine's
-        # per-leaf dispatch cost is faithfully measured) at a fraction
-        # of the bytes (so the tunnel's ~1 GB/s D2H keeps the bench's
-        # wall clock bounded); byte-proportional legs are extrapolated
-        def _slice(x):
-            if getattr(x, "ndim", 0) == 0 or x.shape[0] < 5:
-                return x
-            return x[: max(1, int(x.shape[0] * PROBE_FRAC))]
+        # probe: slice every leaf along axis 0 — SAME tree structure
+        # and leaf count as the real state (so the engine's per-leaf
+        # dispatch cost is faithfully measured) at a fraction of the
+        # bytes (so a slow D2H link keeps the bench's wall clock
+        # bounded); byte-proportional legs are extrapolated.
+        def _slice_frac(frac):
+            def _slice(x):
+                if getattr(x, "ndim", 0) == 0 or x.shape[0] < 5:
+                    return x
+                return x[: max(1, int(x.shape[0] * frac))]
 
-        probe = jax.tree_util.tree_map(_slice, state)
-        probe_bytes = sum(
-            x.nbytes for x in jax.tree_util.tree_leaves(probe)
+            return jax.tree_util.tree_map(_slice, state)
+
+        def _tree_bytes(t):
+            return sum(
+                x.nbytes for x in jax.tree_util.tree_leaves(t)
+            )
+
+        # the D2H link varies by ORDERS of magnitude across backends
+        # (tunnel ~MB/s on a bad day, direct v5e PCIe ~16 GB/s), so a
+        # fixed probe size either starves the measurement or blows the
+        # watchdog. Measure the rate on a small warm-up leg first, then
+        # size the real probe so each remaining leg fits its budget.
+        leg_budget_s = float(
+            os.environ.get("BENCH_CKPT_LEG_BUDGET", "90")
         )
+        tiny_frac = max(48e6 / nbytes, 1e-3)
+        tiny = _slice_frac(tiny_frac)
+        beat("checkpoint warm-up probe (D2H rate measure)")
+        t0 = time.monotonic()
+        eng.save_to_memory(0, tiny)  # also warms tunnel/DMA setup
+        warm_s = max(time.monotonic() - t0, 1e-9)
+        rate = _tree_bytes(tiny) / warm_s  # bytes/s through the engine
+        probe_frac = min(
+            0.2,
+            max(tiny_frac, rate * leg_budget_s / nbytes),
+        )
+        probe = _slice_frac(probe_frac)
+        probe_bytes = _tree_bytes(probe)
         out["ckpt_probe_gb"] = round(probe_bytes / 1e9, 2)
         scale = nbytes / probe_bytes
-        # warm the D2H path (first transfer pays one-time tunnel /
-        # DMA setup that steady-state training has long amortized)
-        eng.save_to_memory(0, probe)
+        beat("checkpoint staging probe")
+        if probe_frac > tiny_frac * 1.5:
+            # re-warm at the real probe size (segment resize happens
+            # here, off the timed legs)
+            eng.save_to_memory(0, probe)
+        beat("checkpoint staging probe (timed)")
         # save blocking: the async engine's critical path (on-device
         # snapshot dispatch; staging rides a background thread). The
         # dispatch cost is per-leaf, not per-byte, so the probe's
@@ -118,6 +148,7 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
         blocks = []
         stage_probe = None
         for i in (1, 2):
+            beat(f"checkpoint staging probe (leg {i})")
             t0 = time.monotonic()
             blocks.append(eng.save_to_memory_async(i, probe))
             eng.wait_for_staging()
@@ -143,10 +174,15 @@ def _bench_checkpoint(state, step_ms: float) -> dict:
 
         eng2 = _Eng(ckpt_dir, job_name="benchjob")
         try:
+            beat("checkpoint restore probe (shm read + H2D)")
             t0 = time.monotonic()
             step, restored = eng2.load_from_memory(target=probe)
             restored = restore_to_shardings(restored, probe)
-            jax.block_until_ready(restored)
+            # NOT block_until_ready: the axon backend's returns early
+            # for async buffers, which would under-report the stall
+            from dlrover_tpu.utils.prof import device_fence
+
+            device_fence(restored)
             restore_probe = time.monotonic() - t0
         finally:
             eng2.close()  # client-only: eng owns the IPC server
@@ -333,7 +369,9 @@ def main():
     # save-blocking ms of the async shm staging, restore stall from shm,
     # and a goodput estimate from those + the measured step time.
     watchdog.beat("checkpoint probe (D2H staging + restore)")
-    ckpt = _bench_checkpoint(state, step_ms=elapsed / iters * 1e3)
+    ckpt = _bench_checkpoint(
+        state, step_ms=elapsed / iters * 1e3, beat=watchdog.beat
+    )
     watchdog.done()
 
     print(

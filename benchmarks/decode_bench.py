@@ -30,6 +30,13 @@ def main():
     import jax.numpy as jnp
 
     from dlrover_tpu.models import decode, llama
+    from dlrover_tpu.utils.prof import device_fence, timed_with_fence
+
+    def timed(thunk, iters):
+        # block_until_ready returns early on the axon backend: fence
+        # via a data-dependent scalar read, minus the fence's own cost
+        dt, _ = timed_with_fence(thunk, iters=iters)
+        return dt
 
     on_tpu = False
     try:
@@ -78,14 +85,21 @@ def main():
     )
     cache0 = decode.init_kv_cache(cfg, batch, max_len)
     logits, cache = pf(params, prompt, cache0)  # compile
-    jax.block_until_ready(logits)
+    device_fence(logits)
     iters = 5 if on_tpu else 2
-    t0 = time.monotonic()
-    for _ in range(iters):
-        logits, cache = pf(params, prompt, cache0)
-    jax.block_until_ready(logits)
-    dt = (time.monotonic() - t0) / iters
+    # fence only the logits leaf (one jit program computes both
+    # outputs, so its completion covers the cache too); keep the last
+    # call's cache instead of paying one more prefill to recover it
+    box = {}
+
+    def _pf():
+        lg, c = pf(params, prompt, cache0)
+        box["cache"] = c
+        return lg
+
+    dt = timed(_pf, iters)
     emit("prefill", batch * prompt_len / dt, ms_per_call=round(dt * 1e3, 1))
+    cache = box["cache"]
 
     # ---- per-token cached decode ----------------------------------------
     ds = jax.jit(
@@ -93,14 +107,25 @@ def main():
     )
     tok = prompt[:, -1]
     lg, cache1 = ds(params, tok, cache, prompt_len)  # compile
-    jax.block_until_ready(lg)
-    steps = 64 if on_tpu else 8
-    t0 = time.monotonic()
-    c = cache
-    for i in range(steps):
-        lg, c = ds(params, tok, c, prompt_len + i)
-    jax.block_until_ready(lg)
-    dt = (time.monotonic() - t0) / steps
+    device_fence(lg)
+    # the decode chain threads (position, cache) through the loop; one
+    # timed_with_fence "iteration" runs a whole chain and the per-token
+    # time divides out. The chain runs twice (warmup + timed), so cap
+    # steps at new_tokens//2 to stay inside the cache's capacity.
+    steps = min(64 if on_tpu else 8, new_tokens // 2)
+    pos_box = {"c": cache, "i": 0}
+
+    def _chain():
+        lg = None
+        for _ in range(steps):
+            lg, pos_box["c"] = ds(
+                params, tok, pos_box["c"], prompt_len + pos_box["i"]
+            )
+            pos_box["i"] += 1
+        return lg
+
+    chain_s, _ = timed_with_fence(_chain, iters=1, warmup=1)
+    dt = chain_s / steps
     emit(
         "decode_per_token",
         batch / dt,
@@ -114,10 +139,10 @@ def main():
         )
     )
     out = gen(params, prompt)  # compile
-    jax.block_until_ready(out)
+    device_fence(out)
     t0 = time.monotonic()
     out = gen(params, prompt)
-    jax.block_until_ready(out)
+    device_fence(out)
     dt_cached = time.monotonic() - t0
     emit(
         "generate_cached",
@@ -132,14 +157,14 @@ def main():
     fwd = jax.jit(lambda p, t: llama.apply(cfg, p, t))
     padded = jnp.pad(prompt, ((0, 0), (0, new_tokens)))
     lg = fwd(params, padded)  # compile
-    jax.block_until_ready(lg)
+    device_fence(lg)
     t0 = time.monotonic()
     seq = padded
     for i in range(new_tokens):
         lg = fwd(params, seq)
         nxt = jnp.argmax(lg[:, prompt_len - 1 + i], axis=-1)
         seq = seq.at[:, prompt_len + i].set(nxt)
-    jax.block_until_ready(seq)
+    device_fence(seq)
     dt_uncached = time.monotonic() - t0
     emit(
         "generate_uncached",

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Wait for the axon TPU tunnel to come back (r3: it was down for 6+
+# hours mid-round), then run the full measurement suite exactly once.
+# Usage: bash benchmarks/tpu_wait_and_run.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-benchmarks/tpu_run_retry}
+while true; do
+  if timeout 180 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((512,512), jnp.bfloat16)
+assert float((x @ x).sum()) > 0
+print('ALIVE')
+" 2>/dev/null | grep -q ALIVE; then
+    echo "$(date) tunnel alive — running suite"
+    bash benchmarks/run_tpu_suite.sh "$OUT"
+    exit $?
+  fi
+  echo "$(date) tunnel down, retrying in 300s"
+  sleep 300
+done

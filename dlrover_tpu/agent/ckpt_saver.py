@@ -102,10 +102,13 @@ class SharedMemoryHandler:
         save_path: str = "",
         aux: bytes = b"",
     ):
+        flat = {p: np.asarray(a) for p, a in flat.items()}
         tensors = []
         offset = 0
         for path, arr in flat.items():
-            arr = np.ascontiguousarray(arr)
+            # metadata only needs shape/dtype/nbytes — all invariant
+            # under contiguity, so no copy here (the write loop below
+            # makes the one contiguous copy a strided source needs)
             tensors.append(
                 TensorMeta(
                     path, tuple(arr.shape), str(arr.dtype), offset,
@@ -125,9 +128,15 @@ class SharedMemoryHandler:
             )
         buf = self._segment.buf
         for tm, arr in zip(tensors, flat.values()):
-            buf[tm.offset : tm.offset + tm.nbytes] = np.ascontiguousarray(
-                arr
-            ).tobytes()
+            if tm.nbytes == 0:
+                continue
+            # copy straight into the mapping: tobytes() would material-
+            # ize a second full host copy of every tensor per save
+            dst = np.frombuffer(
+                buf, dtype=np.uint8, count=tm.nbytes, offset=tm.offset
+            )
+            src = np.ascontiguousarray(arr)
+            np.copyto(dst, src.reshape(-1).view(np.uint8))
         meta = CheckpointMeta(
             step=step,
             save_path=save_path,

@@ -146,6 +146,60 @@ class StepProfiler:
         return achieved / (self.peak_tflops * 1e12 * n_dev)
 
 
+def device_fence(out) -> None:
+    """True completion fence for timing: device_get one element of
+    every array leaf in `out`.
+
+    `jax.block_until_ready` can return early for remote/async buffers
+    (the axon-tunneled backend does — r4 caught microbenches reporting
+    12x the chip's peak TFLOPs because of it). A data-dependent D2H
+    read of the result cannot complete before the kernels that produce
+    it, so this is the only fence that holds on every backend. For
+    sharded leaves one element is read from EVERY addressable shard —
+    element (0,..,0) alone would only fence the device owning it. The
+    one-element gather compiles once per leaf shape; time it separately
+    (call this twice, the second call is pure fence cost) when the
+    timed region is short."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        if not (hasattr(leaf, "shape") and hasattr(leaf, "dtype")):
+            continue
+        shards = getattr(leaf, "addressable_shards", None)
+        datas = [s.data for s in shards] if shards else [leaf]
+        for d in datas:
+            if getattr(d, "size", 1) == 0:
+                continue  # nothing to read from an empty leaf
+            if d.shape:
+                d = d[tuple(0 for _ in d.shape)]
+            jax.device_get(d)
+
+
+def timed_with_fence(thunk, iters: int, warmup: int = 1):
+    """Time `iters` calls of `thunk` under device_fence semantics.
+
+    Fences after warmup, times the loop, fences, then re-fences the
+    (already complete) output to measure the fence's own round-trip
+    cost and subtracts it. `warmup` is effectively >= 1: one untimed
+    call is always made to bind the fence target and pre-compile its
+    gather. Returns (seconds_per_iter, last_output)."""
+    import time as _time
+
+    out = thunk()
+    for _ in range(max(warmup - 1, 0)):
+        out = thunk()
+    device_fence(out)
+    t0 = _time.monotonic()
+    for _ in range(iters):
+        out = thunk()
+    device_fence(out)
+    elapsed = _time.monotonic() - t0
+    t1 = _time.monotonic()
+    device_fence(out)
+    elapsed -= _time.monotonic() - t1
+    return max(elapsed, 1e-9) / iters, out
+
+
 def detect_tpu_gen(default: str = "v5e") -> str:
     """Chip generation from the live device's device_kind, with the
     PALLAS_AXON_TPU_GEN env var as fallback. Known kind strings:
