@@ -184,6 +184,15 @@ def _bench_checkpoint(state, step_ms: float, beat=None) -> dict:
 
             device_fence(restored)
             restore_probe = time.monotonic() - t0
+            # the fence itself costs one round trip per leaf (plus
+            # first-use gather compiles) — measure it on the now-
+            # complete tree and subtract, or the per-leaf cost gets
+            # multiplied by `scale` into the full-state estimate
+            t1 = time.monotonic()
+            device_fence(restored)
+            restore_probe = max(
+                restore_probe - (time.monotonic() - t1), 1e-9
+            )
         finally:
             eng2.close()  # client-only: eng owns the IPC server
         out["restore_stall_measured_s"] = round(restore_probe, 2)

@@ -1,0 +1,145 @@
+"""Small convolutional classifier family (mnist-class vision), pjit-ready.
+
+Reference parity: the mnist CNN is the reference's vision acceptance
+workload and the body of its chaos/fault-tolerance experiments
+(examples/pytorch/mnist/cnn_train.py, chaos_test_job.yaml;
+docs/tech_report/fault_tolerance_exps.md:85). TPU redesign rather than
+a torch translation:
+
+- NHWC activation layout and HWIO kernels — the TPU-native conv
+  layout; XLA lowers `lax.conv_general_dilated` onto the MXU as an
+  implicit GEMM, so channels stay the minor (lane) dimension.
+- bf16 compute / f32 params, f32 loss reductions (same recipe as
+  models/{llama,gpt,bert}.py).
+- stride-2 convs instead of max-pool layers: one fused conv op per
+  downsample instead of conv+reduce-window, fewer HBM round trips.
+- global average pool before the head — keeps the classifier a pair
+  of clean [C, D]/[D, K] matmuls whose D axis carries the `tensor`
+  mesh axis, so the same partition-rule machinery as the language
+  models applies.
+"""
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.parallel.sharding import constrain
+
+Params = Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class CnnConfig:
+    image_size: int = 28
+    in_channels: int = 1
+    channels: Tuple[int, ...] = (16, 32, 64)  # stride-2 after stage 0
+    kernel: int = 3
+    dense_dim: int = 128
+    n_classes: int = 10
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def mnist(cls, **kw) -> "CnnConfig":
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw) -> "CnnConfig":
+        d = dict(image_size=8, channels=(8, 16), dense_dim=32)
+        d.update(kw)
+        return cls(**d)
+
+
+def init_params(cfg: CnnConfig, key: jax.Array) -> Params:
+    pd = cfg.param_dtype
+    ks = jax.random.split(key, len(cfg.channels) + 2)
+    params: Params = {}
+    cin = cfg.in_channels
+    for i, cout in enumerate(cfg.channels):
+        fan_in = cfg.kernel * cfg.kernel * cin
+        params[f"conv{i}_w"] = jax.random.normal(
+            ks[i], (cfg.kernel, cfg.kernel, cin, cout), pd
+        ) / math.sqrt(fan_in)
+        params[f"conv{i}_b"] = jnp.zeros((cout,), pd)
+        cin = cout
+    params["dense_w"] = jax.random.normal(
+        ks[-2], (cin, cfg.dense_dim), pd
+    ) / math.sqrt(cin)
+    params["dense_b"] = jnp.zeros((cfg.dense_dim,), pd)
+    params["head_w"] = jax.random.normal(
+        ks[-1], (cfg.dense_dim, cfg.n_classes), pd
+    ) / math.sqrt(cfg.dense_dim)
+    params["head_b"] = jnp.zeros((cfg.n_classes,), pd)
+    return params
+
+
+def partition_rules(cfg: CnnConfig):
+    """Conv kernels are tiny — replicate them; the head matmuls carry
+    the tensor axis (column then row parallel, the Megatron pairing)."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"conv\d+_w$", P(None, None, None, None)),
+        (r"conv\d+_b$", P(None)),
+        (r"dense_w$", P(None, "tensor")),
+        (r"dense_b$", P("tensor")),
+        (r"head_w$", P("tensor", None)),
+        (r"head_b$", P(None)),
+    ]
+
+
+def apply(
+    cfg: CnnConfig, params: Params, images: jax.Array, mesh=None
+) -> jax.Array:
+    """images [B, H, W, Cin] (NHWC) → logits [B, n_classes] (f32)."""
+    x = images.astype(cfg.dtype)
+    x = constrain(x, mesh, ("data", "fsdp"), None, None, None)
+    for i in range(len(cfg.channels)):
+        stride = 1 if i == 0 else 2
+        x = jax.lax.conv_general_dilated(
+            x,
+            params[f"conv{i}_w"].astype(cfg.dtype),
+            window_strides=(stride, stride),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        x = jax.nn.relu(x + params[f"conv{i}_b"].astype(cfg.dtype))
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global avg pool
+    x = x.astype(cfg.dtype)
+    h = jax.nn.relu(
+        x @ params["dense_w"].astype(cfg.dtype)
+        + params["dense_b"].astype(cfg.dtype)
+    )
+    h = constrain(h, mesh, ("data", "fsdp"), "tensor")
+    logits = (
+        h @ params["head_w"].astype(cfg.dtype)
+        + params["head_b"].astype(cfg.dtype)
+    )
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(cfg: CnnConfig, params: Params, batch: Dict, mesh=None):
+    """batch = {"images": [B,H,W,C], "labels": [B] int} → (loss, metrics)."""
+    logits = apply(cfg, params, batch["images"], mesh=mesh)
+    labels = batch["labels"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    loss = jnp.mean(nll)
+    acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def num_params(cfg: CnnConfig) -> int:
+    params = jax.eval_shape(
+        lambda k: init_params(cfg, k), jax.random.PRNGKey(0)
+    )
+    total = 0
+    for x in jax.tree_util.tree_leaves(params):
+        n = 1
+        for s in x.shape:
+            n *= s
+        total += n
+    return total
