@@ -1,0 +1,106 @@
+"""Batch-16 probe under FULL remat (save only layer inputs).
+
+r3/r4 sweeps hit compile OOM at batch 16 with the "proj" policy (saves
+[B,S,dim] projection outputs per layer) both fused and unfused; nobody
+tried the minimum-HBM "full" policy, which recomputes the whole layer
+body in the backward. If batch 16 compiles under "full" + fused CE and
+its tokens/s beats batch 8 + "proj", bench.py's config should flip —
+the extra recompute FLOPs trade against better MXU occupancy.
+
+Run: python benchmarks/remat_b16_probe.py   (CPU smoke: tiny shapes)
+One JSON line per config; OOM is a recorded result, not a failure.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.utils.platform import ensure_cpu_if_forced  # noqa: E402
+
+ensure_cpu_if_forced()
+
+
+def main():
+    import jax
+    import optax
+
+    from dlrover_tpu.models import llama
+    from dlrover_tpu.parallel.accelerate import Strategy, accelerate
+    from dlrover_tpu.parallel.mesh import MeshSpec
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    n_dev = jax.local_device_count()
+
+    def cfg_for(policy, fused):
+        if on_tpu:
+            return llama.LlamaConfig(
+                vocab_size=32000, dim=1024, n_layers=24, n_heads=8,
+                n_kv_heads=8, mlp_dim=4096, max_seq_len=2048,
+                remat=True, remat_policy=policy, attn_impl="auto",
+                fused_ce=fused,
+            )
+        return llama.LlamaConfig.tiny(fused_ce=fused)
+
+    seq = 2048 if on_tpu else 64
+    warmup, iters = (3, 10) if on_tpu else (1, 2)
+    # (name, batch, remat_policy, fused_ce)
+    configs = (
+        [
+            ("b8_full_fused", 8, "full", True),
+            ("b16_full_fused", 16, "full", True),
+            ("b16_full_unfused", 16, "full", False),
+            ("b12_full_fused", 12, "full", True),
+        ]
+        if on_tpu
+        else [("b4_full_fused", 4, "full", True)]
+    )
+
+    for name, batch, policy, fused in configs:
+        row = {"metric": f"remat_probe.{name}", "unit": "tok/s/chip",
+               "batch": batch, "remat_policy": policy, "fused": fused,
+               "backend": jax.default_backend()}
+        try:
+            cfg = cfg_for(policy, fused)
+            acc = accelerate(
+                init_params=lambda k, c=cfg: llama.init_params(c, k),
+                loss_fn=lambda p, b, m, c=cfg: llama.loss_fn(
+                    c, p, b, mesh=m
+                ),
+                rules=llama.partition_rules(cfg),
+                optimizer=optax.adamw(1e-4),
+                strategy=Strategy(mesh=MeshSpec.fit(n_dev)),
+            )
+            state = acc.init(jax.random.PRNGKey(0))
+            tokens = jax.random.randint(
+                jax.random.PRNGKey(1), (batch, seq + 1), 0,
+                cfg.vocab_size,
+            )
+            b = acc.shard_batch({"tokens": tokens})
+            t_c0 = time.monotonic()
+            for _ in range(warmup):
+                state, m = acc.train_step(state, b)
+            float(jax.device_get(m["loss"]))
+            row["compile_plus_warmup_s"] = round(
+                time.monotonic() - t_c0, 1
+            )
+            t0 = time.monotonic()
+            for _ in range(iters):
+                state, m = acc.train_step(state, b)
+            float(jax.device_get(m["loss"]))
+            dt = time.monotonic() - t0
+            row["value"] = round(batch * seq * iters / dt / n_dev, 1)
+            row["step_ms"] = round(dt / iters * 1e3, 1)
+            del state, acc, b
+        except Exception as e:  # noqa: BLE001 — OOM is a RESULT here
+            row["value"] = 0.0
+            row["error"] = str(e)[:160]
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

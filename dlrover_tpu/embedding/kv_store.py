@@ -86,10 +86,11 @@ def _build_so() -> str:
                 # baseline ISA only (no -march): the .so may be
                 # prebuilt into an image or land in a shared ~/.cache
                 # crossing heterogeneous hosts, where newer ISA
-                # extensions SIGILL with no diagnostic. Measured cost
-                # of forgoing AVX2 here: none — the batched update is
-                # memory-latency bound, not vector-ALU bound
-                # (benchmarks/RESULTS.md).
+                # extensions SIGILL with no diagnostic. The ALU-bound
+                # hot kernels still get AVX2/FMA: the .cc dispatches
+                # per-host at load time (target_clones + a
+                # __builtin_cpu_supports-guarded NR adam kernel — see
+                # benchmarks/RESULTS.md), so no -march is needed HERE.
                 cmd = ["g++"] + _CXX_FLAGS + ["-o", tmp, _SRC]
                 logger.info(
                     "building kv_embedding native lib: %s", " ".join(cmd)

@@ -23,6 +23,12 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
+#if defined(__x86_64__)
+#include <immintrin.h>  // must precede the anonymous namespace: a
+// system header included inside `namespace {` would re-declare libc
+// symbols with internal linkage on toolchains whose include guards
+// don't already short-circuit it
+#endif
 #include <cstring>
 #include <functional>
 #include <mutex>
@@ -39,6 +45,200 @@ double now_sec() {
              std::chrono::steady_clock::now().time_since_epoch())
       .count();
 }
+
+// phase counters for batched_update, filled only under KV_PROF=1 and
+// read/reset through kv_prof_report() (atomic: shard workers add
+// concurrently)
+std::atomic<uint64_t> prof_group_ns{0}, prof_dedup_ns{0},
+    prof_resolve_ns{0}, prof_apply_ns{0};
+
+uint64_t ns_since(std::chrono::steady_clock::time_point t0) {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now() - t0)
+          .count());
+}
+
+// ---- row kernels (runtime-dispatched ISA clones) --------------------
+// The batched-update profile (KV_PROF) put 68% of wall in the apply
+// loop, and the working set of a repeated batch fits in LLC — i.e. the
+// loop is vector-ALU bound (sqrtps/divps on 4 lanes), not DRAM bound.
+// The build deliberately ships baseline ISA (-O3, no -march: a cached
+// .so can cross heterogeneous hosts, where AVX2 code SIGILLs with no
+// diagnostic); target_clones sidesteps that safely — gcc emits an
+// AVX2+FMA clone AND a baseline clone and picks per-host at load time
+// via the glibc IFUNC resolver. Measured: adam row 2.34 -> ~1.1 ms per
+// 8k x 64 batch on an AVX2 host, identical results on any other host.
+
+// x86-only clone lists are a hard compile error on other arches (gcc
+// rejects unknown ISA names), and this .cc is built by g++ on the
+// importing host — keep non-x86 builds working with plain functions
+#if defined(__x86_64__)
+#define DLROVER_ISA_CLONES \
+  __attribute__((target_clones("avx2,fma", "default")))
+#else
+#define DLROVER_ISA_CLONES
+#endif
+
+DLROVER_ISA_CLONES void axpy_row(float* __restrict__ w,
+                                 const float* __restrict__ v,
+                                 float alpha, int64_t dim) {
+  for (int64_t d = 0; d < dim; ++d) w[d] += alpha * v[d];
+}
+
+DLROVER_ISA_CLONES void adagrad_row(float* __restrict__ w,
+                                    float* __restrict__ acc,
+                                    const float* __restrict__ g,
+                                    float lr, float eps, int64_t dim) {
+  for (int64_t d = 0; d < dim; ++d) {
+    acc[d] += g[d] * g[d];
+    w[d] -= lr * g[d] / (std::sqrt(acc[d]) + eps);
+  }
+}
+
+void adam_row_generic(float* __restrict__ w, float* __restrict__ m,
+                      float* __restrict__ v,
+                      const float* __restrict__ gr, float lr, float b1,
+                      float b2, float eps, float mscale, float vscale,
+                      int64_t dim) {
+  for (int64_t d = 0; d < dim; ++d) {
+    m[d] = b1 * m[d] + (1 - b1) * gr[d];
+    v[d] = b2 * v[d] + (1 - b2) * gr[d] * gr[d];
+    const float mh = m[d] * mscale;
+    const float vh = v[d] * vscale;
+    w[d] -= lr * mh / (std::sqrt(vh) + eps);
+  }
+}
+
+// The adam update is vector-ALU bound on the sqrt+div chain (the
+// KV_PROF profile is flat across L1/L2/LLC working sets), and
+// target_clones alone doesn't change the chain — vsqrtps+vdivps have
+// the same ~14-cycle throughput at any width on this core family. The
+// win is replacing them with rsqrt/rcp estimates + one Newton-Raphson
+// step each (~24-bit, ~3e-7 relative — indistinguishable at adam's
+// noise floor): all cheap fma/mul ops. Guarded by __builtin_cpu_
+// supports at dispatch time, so the baseline-ISA build stays portable.
+#if defined(__x86_64__)
+__attribute__((target("avx2,fma"))) void adam_row_avx2(
+    float* __restrict__ w, float* __restrict__ m,
+    float* __restrict__ v, const float* __restrict__ gr, float lr,
+    float b1, float b2, float eps, float mscale, float vscale,
+    int64_t dim) {
+  const __m256 b1v = _mm256_set1_ps(b1);
+  const __m256 ib1 = _mm256_set1_ps(1.0f - b1);
+  const __m256 b2v = _mm256_set1_ps(b2);
+  const __m256 ib2 = _mm256_set1_ps(1.0f - b2);
+  const __m256 msv = _mm256_set1_ps(mscale);
+  const __m256 vsv = _mm256_set1_ps(vscale);
+  const __m256 epv = _mm256_set1_ps(eps);
+  const __m256 lrv = _mm256_set1_ps(lr);
+  const __m256 c15 = _mm256_set1_ps(1.5f);
+  const __m256 c05 = _mm256_set1_ps(0.5f);
+  const __m256 c20 = _mm256_set1_ps(2.0f);
+  // floor vh at FLT_MIN: rsqrt(0) = inf would turn s = vh*r into NaN
+  // (exact path has sqrt(0)+eps = eps; with the floor, s ~ 1e-19 and
+  // the denominator is eps again). Ceiling at FLT_MAX for the same
+  // reason from the other side: vh = inf (g*g overflow) gives
+  // rsqrt = 0 and the NR step computes inf*0 = NaN, silently
+  // poisoning w forever — where the exact path's 1/(sqrt(inf)+eps)
+  // is a finite no-op update. Clamped, the update is ~0 as well.
+  const __m256 tiny = _mm256_set1_ps(1.17549435e-38f);
+  const __m256 huge = _mm256_set1_ps(3.40282347e38f);
+  int64_t d = 0;
+  for (; d + 8 <= dim; d += 8) {
+    const __m256 g = _mm256_loadu_ps(gr + d);
+    const __m256 mm = _mm256_fmadd_ps(
+        b1v, _mm256_loadu_ps(m + d), _mm256_mul_ps(ib1, g));
+    _mm256_storeu_ps(m + d, mm);
+    const __m256 vv = _mm256_fmadd_ps(
+        b2v, _mm256_loadu_ps(v + d),
+        _mm256_mul_ps(ib2, _mm256_mul_ps(g, g)));
+    _mm256_storeu_ps(v + d, vv);
+    const __m256 mh = _mm256_mul_ps(mm, msv);
+    const __m256 vh = _mm256_min_ps(
+        _mm256_max_ps(_mm256_mul_ps(vv, vsv), tiny), huge);
+    // s = sqrt(vh) via rsqrt + one NR step: r1 = r*(1.5 - 0.5*vh*r^2)
+    __m256 r = _mm256_rsqrt_ps(vh);
+    r = _mm256_mul_ps(
+        r, _mm256_fnmadd_ps(
+               _mm256_mul_ps(c05, vh), _mm256_mul_ps(r, r), c15));
+    const __m256 s = _mm256_mul_ps(vh, r);
+    const __m256 den = _mm256_add_ps(s, epv);
+    // u = 1/den via rcp + one NR step: u1 = u*(2 - den*u)
+    __m256 u = _mm256_rcp_ps(den);
+    u = _mm256_mul_ps(u, _mm256_fnmadd_ps(den, u, c20));
+    const __m256 upd = _mm256_mul_ps(lrv, _mm256_mul_ps(mh, u));
+    _mm256_storeu_ps(w + d, _mm256_sub_ps(_mm256_loadu_ps(w + d), upd));
+  }
+  if (d < dim) {
+    adam_row_generic(w + d, m + d, v + d, gr + d, lr, b1, b2, eps,
+                     mscale, vscale, dim - d);
+  }
+}
+#endif  // __x86_64__
+
+using AdamRowFn = void (*)(float*, float*, float*, const float*, float,
+                           float, float, float, float, float, int64_t);
+
+AdamRowFn resolve_adam_row() {
+#if defined(__x86_64__)
+  if (__builtin_cpu_supports("avx2") && __builtin_cpu_supports("fma")) {
+    return adam_row_avx2;
+  }
+#endif
+  return adam_row_generic;
+}
+
+const AdamRowFn adam_row = resolve_adam_row();
+
+// Reusable open-addressing dedup table (linear probing, generation-
+// stamped so clearing between calls is one counter bump). Replaces a
+// fresh std::unordered_map per shard per batched_update call, whose
+// construction+rehash was ~14% of the update's wall clock.
+// thread_local: shard groups fan out across WorkPool threads.
+struct DedupTable {
+  std::vector<int64_t> keys;
+  std::vector<int64_t> vals;
+  // 64-bit generation: a 32-bit counter can wrap within a weeks-long
+  // PS run (one bump per shard per update), after which a stale slot
+  // would alias a live one and return an out-of-range batch index
+  std::vector<uint64_t> gens;
+  uint64_t gen = 0;
+  size_t mask = 0;
+
+  void begin(size_t n) {
+    size_t cap = 16;
+    while (cap < n * 2) cap <<= 1;
+    if (cap > keys.size()) {
+      keys.assign(cap, 0);
+      vals.assign(cap, 0);
+      gens.assign(cap, 0);
+      gen = 0;
+    }
+    mask = keys.size() - 1;
+    ++gen;
+  }
+
+  // returns the slot's value; `fresh` reports whether it was inserted
+  int64_t find_or_insert(int64_t key, int64_t val, bool* fresh) {
+    size_t h = static_cast<size_t>(key) * 0x9E3779B97F4A7C15ull;
+    size_t i = h & mask;
+    for (;;) {
+      if (gens[i] != gen) {
+        gens[i] = gen;
+        keys[i] = key;
+        vals[i] = val;
+        *fresh = true;
+        return val;
+      }
+      if (keys[i] == key) {
+        *fresh = false;
+        return vals[i];
+      }
+      i = (i + 1) & mask;
+    }
+  }
+};
 
 struct Slot {
   std::vector<float> data;  // [value(dim) | m(dim) | v(dim)] lazily sized
@@ -216,8 +416,7 @@ class KvTable {
                    float alpha) {
     const uint64_t ver = ++version_;
     batched_update(keys, n, vals, 1, [&](const float* v, Slot& slot) {
-      float* w = slot.data.data();
-      for (int64_t d = 0; d < dim_; ++d) w[d] += alpha * v[d];
+      axpy_row(slot.data.data(), v, alpha, dim_);
       slot.version = ver;
     });
   }
@@ -232,14 +431,9 @@ class KvTable {
   void apply_adagrad(const int64_t* keys, int64_t n, const float* grads,
                      float lr, float eps) {
     const uint64_t ver = ++version_;
-    batched_update(keys, n, grads, 2, [&](const float* g2_, Slot& slot) {
-      const float* __restrict__ g2 = g2_;
-      float* __restrict__ w = slot.data.data();
-      float* __restrict__ acc = w + dim_;
-      for (int64_t d = 0; d < dim_; ++d) {
-        acc[d] += g2[d] * g2[d];
-        w[d] -= lr * g2[d] / (std::sqrt(acc[d]) + eps);
-      }
+    batched_update(keys, n, grads, 2, [&](const float* g2, Slot& slot) {
+      float* w = slot.data.data();
+      adagrad_row(w, w + dim_, g2, lr, eps, dim_);
       slot.version = ver;
     });
   }
@@ -259,21 +453,14 @@ class KvTable {
     // per row instead of two per element
     const float mscale = 1.0f / bc1;
     const float vscale = 1.0f / bc2;
-    batched_update(keys, n, grads, 3, [&](const float* gr_, Slot& slot) {
-      // __restrict__ lets the compiler vectorize the hot loop (sqrtps/
-      // divps): w/m/v are disjoint dim_-sized segments of slot.data and
-      // gr lives in the dedup accumulator, never aliasing them
-      const float* __restrict__ gr = gr_;
-      float* __restrict__ w = slot.data.data();
-      float* __restrict__ m = w + dim_;
-      float* __restrict__ v = w + 2 * dim_;
-      for (int64_t d = 0; d < dim_; ++d) {
-        m[d] = b1 * m[d] + (1 - b1) * gr[d];
-        v[d] = b2 * v[d] + (1 - b2) * gr[d] * gr[d];
-        const float mh = m[d] * mscale;
-        const float vh = v[d] * vscale;
-        w[d] -= lr * mh / (std::sqrt(vh) + eps);
-      }
+    batched_update(keys, n, grads, 3, [&](const float* gr, Slot& slot) {
+      // w/m/v are disjoint dim_-sized segments of slot.data and gr
+      // lives in the dedup accumulator, never aliasing them; the row
+      // kernel is an ISA-dispatched clone (see adam_row)
+      float* w = slot.data.data();
+      float* m = w + dim_;
+      float* v = w + 2 * dim_;
+      adam_row(w, m, v, gr, lr, b1, b2, eps, mscale, vscale, dim_);
       if (l2 > 0.f) {
         const float shrink = 1.0f / (1.0f + lr * l2);
         for (int64_t d = 0; d < dim_; ++d) w[d] *= shrink;
@@ -706,22 +893,37 @@ class KvTable {
   template <typename F>
   void batched_update(const int64_t* keys, int64_t n,
                       const float* grads, int state_mult, F&& row_fn) {
+    // KV_PROF=1: accumulate per-phase ns into process-wide counters,
+    // dumped by kv_prof_report() — a measurement aid, off by default
+    static const bool kProf = std::getenv("KV_PROF") != nullptr;
+    using TimePoint = std::chrono::steady_clock::time_point;
+    // clock reads only when profiling: ~20 ns each, and the off path
+    // is the exact hot path this function exists to keep fast
+    auto tick = [&]() -> TimePoint {
+      return kProf ? std::chrono::steady_clock::now() : TimePoint{};
+    };
+    auto t_start = tick();
     std::vector<std::vector<int64_t>> by_shard(kNumShards);
     for (int64_t i = 0; i < n; ++i)
       by_shard[shard_index(keys[i])].push_back(i);
+    if (kProf) prof_group_ns += ns_since(t_start);
     const size_t need = static_cast<size_t>(dim_) * state_mult;
     const int64_t dim = dim_;
     auto run_shard = [&](size_t s) {
       const auto& rows = by_shard[s];
       if (rows.empty()) return;
+      auto t_shard = tick();
       // dedup + accumulate OUTSIDE the lock: writers in other threads
       // own other shards, readers only need the lock for the apply.
       // Common case (callers already dedup'd / few collisions): no
       // copy at all — each unique points at its grads row; the first
       // duplicate triggers a copy into `acc` (reserved upfront, so
-      // row pointers stay stable) and sums there.
-      std::unordered_map<int64_t, int64_t> uidx;
-      uidx.reserve(rows.size() * 2);
+      // row pointers stay stable) and sums there. The dedup index is
+      // a reused thread_local flat table (DedupTable): constructing a
+      // std::unordered_map per shard per call was ~14% of the
+      // update's wall clock (KV_PROF profile, benchmarks/RESULTS.md).
+      static thread_local DedupTable uidx;
+      uidx.begin(rows.size());
       std::vector<int64_t> ukeys;
       std::vector<const float*> gsrc;
       std::vector<int64_t> accpos;  // offset into acc, -1 = none
@@ -733,14 +935,14 @@ class KvTable {
       for (int64_t i : rows) {
         const int64_t key = keys[i];
         const float* g = grads + i * dim;
-        auto [it, fresh] = uidx.try_emplace(
-            key, static_cast<int64_t>(ukeys.size()));
+        bool fresh = false;
+        const int64_t u = uidx.find_or_insert(
+            key, static_cast<int64_t>(ukeys.size()), &fresh);
         if (fresh) {
           ukeys.push_back(key);
           gsrc.push_back(g);
           accpos.push_back(-1);
         } else {
-          const int64_t u = it->second;
           if (accpos[u] < 0) {
             // first dup for this key: materialize the accumulator
             accpos[u] = static_cast<int64_t>(acc.size());
@@ -751,6 +953,8 @@ class KvTable {
           for (int64_t d = 0; d < dim; ++d) a[d] += g[d];
         }
       }
+      if (kProf) prof_dedup_ns += ns_since(t_shard);
+      auto t_resolve = tick();
       Shard& sh = shards_[s];
       std::lock_guard<std::mutex> g(sh.mu);
       // resolve all slots first, then apply with the NEXT rows
@@ -773,18 +977,35 @@ class KvTable {
         }
         slots[u] = &it->second;
       }
+      // apply in ascending PAYLOAD-ADDRESS order: slot payloads are
+      // heap-scattered, and the apply loop is DRAM-latency bound, so
+      // visiting them in address order converts random-page walks
+      // into mostly-monotonic ones (TLB hits + the hardware stream
+      // prefetcher engage). Order within a shard is free to permute:
+      // keys are unique after dedup, so updates commute.
+      if (kProf) prof_resolve_ns += ns_since(t_resolve);
+      auto t_apply = tick();
+      std::vector<uint32_t> order(slots.size());
+      for (uint32_t u = 0; u < order.size(); ++u) order[u] = u;
+      std::sort(order.begin(), order.end(),
+                [&](uint32_t a, uint32_t b) {
+                  return slots[a]->data.data() <
+                         slots[b]->data.data();
+                });
       constexpr size_t kAhead = 8;
-      for (size_t u = 0; u < slots.size(); ++u) {
-        if (u + kAhead < slots.size()) {
-          const float* p = slots[u + kAhead]->data.data();
+      for (size_t i = 0; i < order.size(); ++i) {
+        if (i + kAhead < order.size()) {
+          const float* p = slots[order[i + kAhead]]->data.data();
           for (size_t b = 0; b < need * sizeof(float);
                b += 64) {
             __builtin_prefetch(
                 reinterpret_cast<const char*>(p) + b, 1);
           }
         }
+        const uint32_t u = order[i];
         row_fn(gsrc[u], *slots[u]);
       }
+      if (kProf) prof_apply_ns += ns_since(t_apply);
     };
     // parallelism only pays off on big batches; below the threshold
     // the pool handoff overhead beats the win
@@ -882,6 +1103,15 @@ void kv_apply_adam(void* t, const int64_t* keys, int64_t n,
                    float eps, int64_t step, float l1, float l2) {
   static_cast<KvTable*>(t)->apply_adam(keys, n, grads, lr, b1, b2, eps,
                                        step, l1, l2);
+}
+
+// batched_update phase totals since the last call (ns): [group, dedup,
+// resolve, apply]. Populated only when KV_PROF=1; reading resets.
+void kv_prof_report(uint64_t* out4) {
+  out4[0] = prof_group_ns.exchange(0);
+  out4[1] = prof_dedup_ns.exchange(0);
+  out4[2] = prof_resolve_ns.exchange(0);
+  out4[3] = prof_apply_ns.exchange(0);
 }
 
 int64_t kv_evict(void* t, uint32_t min_freq, double max_idle_sec) {
