@@ -57,33 +57,65 @@ def _dequant_kernel(q_ref, s_ref, x_ref, *, out_dtype):
     x_ref[...] = (q * s_ref[...]).astype(out_dtype)
 
 
+# Mosaic requires every block's last two dims be (8,128)-divisible OR
+# equal to the whole array's dims. The natural [m, n]-tiled layout
+# gives the scales a (bm, 1) block over [m, n/block] — illegal on real
+# TPU (it only ever lowered in CPU interpret mode). So the kernels run
+# in ROW FORM: x reshaped to [rows, block] (one quant block per row),
+# scales [rows, 1] — last dim EQUAL to the array's, q/x blocks
+# (bm, block) with block a multiple of 128. The reshapes and the
+# row-count pad to a bm multiple happen outside pallas in XLA, where
+# they're layout no-ops.
+_ROW_BM = 1024  # bm*block*4B = 1 MB of VMEM per instance at block 256
+
+
+def _row_tile(rows: int) -> int:
+    """Row-block size for `rows` total rows: small inputs get ONE grid
+    instance padded only to the 8-row sublane multiple (padding a
+    16-row layernorm param to 1024 rows would be ~64x wasted work on
+    every quantized-optimizer step); large inputs tile at _ROW_BM."""
+    if rows >= _ROW_BM:
+        return _ROW_BM
+    return rows + ((-rows) % 8)
+
+
+def _row_pad(rows2d: jax.Array, bm: int) -> Tuple[jax.Array, int]:
+    pad = (-rows2d.shape[0]) % bm
+    if pad:
+        rows2d = jnp.pad(rows2d, ((0, pad), (0, 0)))
+    return rows2d, pad
+
+
 def quantize_int8(
     x: jax.Array, block: int = DEFAULT_BLOCK, block_m: int = 256
 ) -> Tuple[jax.Array, jax.Array]:
     """Symmetric per-block int8 quantization along the last dim.
 
     x: [m, n] with n % block == 0 → (q int8 [m, n], scales f32 [m, n/block]).
+    `block_m` is accepted for API compat; tiling is chosen internally.
     """
     m, n = x.shape
     assert n % block == 0, (n, block)
-    bm = min(block_m, m)
-    assert m % bm == 0, (m, bm)
-    grid = (m // bm, n // block)
+    rows = m * (n // block)
+    bm = _row_tile(rows)
+    xr, pad = _row_pad(x.reshape(rows, block), bm)
     q, s = pl.pallas_call(
         _quant_kernel,
-        grid=grid,
-        in_specs=[pl.BlockSpec((bm, block), lambda i, j: (i, j))],
+        grid=(xr.shape[0] // bm,),
+        in_specs=[pl.BlockSpec((bm, block), lambda i: (i, 0))],
         out_specs=[
-            pl.BlockSpec((bm, block), lambda i, j: (i, j)),
-            pl.BlockSpec((bm, 1), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, block), lambda i: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((m, n), jnp.int8),
-            jax.ShapeDtypeStruct((m, n // block), jnp.float32),
+            jax.ShapeDtypeStruct((xr.shape[0], block), jnp.int8),
+            jax.ShapeDtypeStruct((xr.shape[0], 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(x)
-    return q, s
+    )(xr)
+    if pad:
+        q, s = q[:rows], s[:rows]
+    return q.reshape(m, n), s.reshape(m, n // block)
 
 
 def dequantize_int8(
@@ -94,20 +126,24 @@ def dequantize_int8(
 ) -> jax.Array:
     m, n = q.shape
     block = n // scales.shape[1]
-    bm = min(block_m, m)
-    assert m % bm == 0, (m, bm)
-    grid = (m // bm, n // block)
-    return pl.pallas_call(
+    rows = m * (n // block)
+    bm = _row_tile(rows)
+    qr, pad = _row_pad(q.reshape(rows, block), bm)
+    sr, _ = _row_pad(scales.reshape(rows, 1), bm)
+    x = pl.pallas_call(
         functools.partial(_dequant_kernel, out_dtype=out_dtype),
-        grid=grid,
+        grid=(qr.shape[0] // bm,),
         in_specs=[
-            pl.BlockSpec((bm, block), lambda i, j: (i, j)),
-            pl.BlockSpec((bm, 1), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, block), lambda i: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((bm, block), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        out_specs=pl.BlockSpec((bm, block), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((qr.shape[0], block), out_dtype),
         interpret=_interpret(),
-    )(q, scales)
+    )(qr, sr)
+    if pad:
+        x = x[:rows]
+    return x.reshape(m, n)
 
 
 def quantize_any(x: jax.Array, block: int = DEFAULT_BLOCK):
