@@ -12,9 +12,11 @@ the bottleneck they were meant to remove.
 Measures on whatever backend is live (single chip):
   - quantize_int8 / dequantize_int8 GB/s across sizes
   - quantize->dequantize round-trip error (sanity, printed not timed)
-  - the single-chip shard_map path of quantized_reduce_scatter (1-dev
-    ring degenerates to quant+dequant, so this times kernel overhead
-    in the real collective's program shape)
+  - the single-chip shard_map path of quantized_all_reduce_tree (on
+    one device the gather is local, so this times the quantize_any +
+    all_gather + dequant-sum program shape, not the wire; the ring
+    reduce-scatter's ppermute hops need >1 chip and are covered by
+    the 8-device CPU-mesh tests)
 
 Run:  python benchmarks/quantization_bench.py   (CPU: interpret mode,
 smoke only — Pallas interpret is orders slower and not reported as
@@ -37,7 +39,6 @@ ensure_cpu_if_forced()
 def main():
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from dlrover_tpu.ops import quantization as q
     from dlrover_tpu.utils.prof import timed_with_fence
@@ -100,11 +101,10 @@ def main():
     # the quantized reduce-scatter program on a 1-device mesh: the ring
     # degenerates, but the compiled program exercises the exact
     # shard_map + quant/dequant composition the multi-chip path runs
+    import numpy as np
     from jax.sharding import Mesh
 
-    import numpy as _np
-
-    mesh = Mesh(_np.array(jax.devices()[:1]), ("x",))
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
     # leaves carry a leading per-rank axis of size n (= mesh size 1)
     g = jax.random.normal(
         jax.random.PRNGKey(1), (1, 4 * 1024 * 1024), jnp.float32

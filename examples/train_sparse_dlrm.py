@@ -134,7 +134,9 @@ def main():
         f"rows={len(emb.table)}"
     )
     emb.close()
-    if not (last < first * 0.8):
+    # the memorization rule needs a few dozen steps to bite; a short
+    # smoke run (< 20 steps) only checks the plumbing end to end
+    if args.steps >= 20 and not (last < first * 0.8):
         print("loss did not fall enough", file=sys.stderr)
         return 1
     return 0

@@ -19,6 +19,10 @@ import argparse
 import os
 import sys
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 from dlrover_tpu.utils.platform import ensure_cpu_if_forced
 
 ensure_cpu_if_forced()
