@@ -98,9 +98,10 @@ def main():
         row["roundtrip_max_rel_err"] = round(err, 5)
         print(json.dumps(row), flush=True)
 
-    # the quantized reduce-scatter program on a 1-device mesh: the ring
-    # degenerates, but the compiled program exercises the exact
-    # shard_map + quant/dequant composition the multi-chip path runs
+    # the one-shot all-reduce tree on a 1-device mesh: the gather is
+    # local, so this times the quantize_any + all_gather + dequant-sum
+    # program shape (the ring reduce-scatter's ppermute hops need >1
+    # chip; CPU-mesh tests cover them)
     import numpy as np
     from jax.sharding import Mesh
 
@@ -109,20 +110,20 @@ def main():
     g = jax.random.normal(
         jax.random.PRNGKey(1), (1, 4 * 1024 * 1024), jnp.float32
     )  # 16 MB
-    rs = jax.jit(
+    ar = jax.jit(
         lambda g: q.quantized_all_reduce_tree(
             g, mesh=mesh, axis_name="x"
         )
     )
     try:
-        out = rs(g)
+        out = ar(g)
         row = {
             "metric": "quant.all_reduce_1dev",
             "size_mb": 16,
             "backend": jax.default_backend(),
         }
         if on_tpu:
-            t, _ = timed_with_fence(lambda: rs(g), iters=10)
+            t, _ = timed_with_fence(lambda: ar(g), iters=10)
             row["ms"] = round(t * 1e3, 3)
             row["gbps"] = round(16 / 1024 / t, 2)
         rel = float(

@@ -40,6 +40,12 @@ TARGET = 3
 
 
 def main():
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=10,
+                   help="PPO update steps")
+    args = p.parse_args()
     cfg = llama.LlamaConfig.tiny(
         vocab_size=32, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
         mlp_dim=64, max_seq_len=MAX_LEN,
@@ -105,7 +111,7 @@ def main():
         )
 
     print(f"target-token rate before: {target_rate(jax.random.PRNGKey(99)):.3f}")
-    for i in range(10):
+    for i in range(args.steps):
         metrics = trainer.step(prompts, lens, jax.random.PRNGKey(i))
         shown = {
             k: round(v, 4)

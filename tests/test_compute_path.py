@@ -142,6 +142,34 @@ class TestQuantize:
         assert y.shape == x.shape
         assert float(jnp.abs(y - x).max()) < float(jnp.abs(x).max()) / 100
 
+    def test_row_tiling_satisfies_mosaic_rule(self):
+        # the TPU lowering rule the r4 hardware run tripped over: every
+        # pallas block's last two dims must be (8,128)-divisible or
+        # equal to the whole array's. The row-form wrappers guarantee
+        # it by construction — pin that invariant across shapes,
+        # including sub-8-row inputs and non-multiple-of-_ROW_BM rows.
+        from dlrover_tpu.ops.quantization import _ROW_BM, _row_tile
+
+        for rows in (1, 5, 8, 16, 1000, 1024, 1025, 5000, 65536):
+            bm = _row_tile(rows)
+            padded = rows + ((-rows) % bm)
+            assert bm % 8 == 0 or bm == padded, (rows, bm)
+            assert padded % bm == 0, (rows, bm, padded)
+            assert bm <= _ROW_BM or bm == padded
+            # waste bounded: never more than one tile of padding
+            assert padded - rows < max(bm, 8), (rows, bm, padded)
+
+    def test_quantize_small_and_odd_shapes(self):
+        # shapes below/straddling the row-tile: 1 block row, sub-8
+        # rows, and a rows-count not divisible by the 1024-row tile
+        for m, n, block in ((1, 256, 256), (3, 512, 256), (9, 1024, 128)):
+            x = jax.random.normal(jax.random.PRNGKey(5), (m, n))
+            q, s = quantize_int8(x, block=block)
+            assert q.shape == (m, n) and s.shape == (m, n // block)
+            y = dequantize_int8(q, s)
+            bound = float(jnp.abs(x).max()) / 127.0
+            assert float(jnp.abs(y - x).max()) <= bound * 1.01
+
     def test_stochastic_rounding_unbiased(self):
         x = jnp.full((1, 256), 0.5)  # falls between int levels
         total = jnp.zeros((1, 256))
