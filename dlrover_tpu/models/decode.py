@@ -261,6 +261,41 @@ def decode_step(
     return logits[:, 0], cache
 
 
+def _mask_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k highest logits per row; the rest become -inf. Static
+    k, so the top_k + threshold compare stays one fused XLA program.
+    Value-threshold semantics: tokens exactly TIED with the k-th logit
+    all survive (HF's TopKLogitsWarper masks with the same `scores <
+    kth` compare, so ties behave identically there)."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _mask_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest prefix of the
+    probability-sorted vocab whose mass reaches `p` (the top token
+    always survives, even when its mass alone exceeds `p`). Tokens
+    tied with the boundary logit all survive — degenerate flat rows
+    widen the nucleus rather than picking a sort-order-dependent
+    subset."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # a sorted position is kept while the mass BEFORE it is < p
+    keep = jnp.concatenate(
+        [
+            jnp.ones_like(cum[..., :1], bool),
+            cum[..., :-1] < p,
+        ],
+        axis=-1,
+    )
+    # threshold = smallest kept logit, mapped back to vocab order
+    kth = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
 def generate(
     cfg: LlamaConfig,
     params: Params,
@@ -269,15 +304,26 @@ def generate(
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Greedy / temperature sampling with the KV cache; one compiled
-    scan drives all steps. Returns [B, P + max_new_tokens]."""
+    scan drives all steps. Returns [B, P + max_new_tokens].
+
+    `top_k > 0` and/or `top_p < 1.0` filter the distribution before a
+    temperature draw (vLLM-style knobs — reference inference backend:
+    atorch/rl/inference_backend/vllm_backend.py); both are ignored for
+    greedy decoding (temperature <= 0)."""
     b, p = prompt.shape
     m = max_len or (p + max_new_tokens)
     if m < p + max_new_tokens:
         raise ValueError(
             f"max_len {m} < prompt {p} + new {max_new_tokens}"
         )
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
     # positions actually used reach p + max_new_tokens - 1; the cache
     # buffer (m) may be padded larger for static-shape reuse
     _check_positional_capacity(cfg, p + max_new_tokens)
@@ -291,9 +337,16 @@ def generate(
     def sample(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(
-            key, logits / temperature
-        ).astype(prompt.dtype)
+        # HF/vLLM warp order: temperature first, then the filters (the
+        # nucleus set is computed on the TEMPERED distribution)
+        logits = logits / temperature
+        if top_k > 0 and top_k < logits.shape[-1]:
+            logits = _mask_top_k(logits, top_k)
+        if top_p < 1.0:
+            logits = _mask_top_p(logits, top_p)
+        return jax.random.categorical(key, logits).astype(
+            prompt.dtype
+        )
 
     # single-use key discipline: the first draw gets its own subkey,
     # never the key the scan derives the rest from

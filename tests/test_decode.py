@@ -100,6 +100,60 @@ class TestGenerate:
         assert out.shape == (1, 9)
         assert int(out.max()) < cfg.vocab_size
 
+    def test_top_k_one_matches_greedy(self):
+        # top_k=1 at any temperature collapses to argmax: only the
+        # best token survives the filter
+        cfg = _cfg()
+        params, prompt = _setup(cfg, b=2, p=4)
+        greedy = generate(cfg, params, prompt, 5, temperature=0.0)
+        k1 = generate(
+            cfg, params, prompt, 5, temperature=1.5,
+            key=jax.random.PRNGKey(3), top_k=1,
+        )
+        assert (k1 == greedy).all()
+
+    def test_top_k_filter_masks_everything_else(self):
+        from dlrover_tpu.models.decode import _mask_top_k
+
+        logits = jnp.array([[3.0, 1.0, 2.0, 0.5]])
+        out = _mask_top_k(logits, 2)
+        assert out[0, 0] == 3.0 and out[0, 2] == 2.0
+        assert jnp.isneginf(out[0, 1]) and jnp.isneginf(out[0, 3])
+
+    def test_top_p_filter_keeps_nucleus(self):
+        from dlrover_tpu.models.decode import _mask_top_p
+
+        # probs ~ [0.64, 0.24, 0.09, 0.03]: p=0.7 keeps the top two
+        # (mass before #2 is 0.64 < 0.7; before #3 is 0.87 >= 0.7)
+        logits = jnp.log(jnp.array([[0.64, 0.24, 0.09, 0.03]]))
+        out = _mask_top_p(logits, 0.7)
+        assert jnp.isfinite(out[0, 0]) and jnp.isfinite(out[0, 1])
+        assert jnp.isneginf(out[0, 2]) and jnp.isneginf(out[0, 3])
+        # the top token survives even when its own mass exceeds p
+        out_tiny = _mask_top_p(logits, 0.1)
+        assert jnp.isfinite(out_tiny[0, 0])
+        assert jnp.isneginf(out_tiny[0, 1:]).all()
+
+    def test_top_p_sampling_runs_and_is_in_vocab(self):
+        cfg = _cfg()
+        params, prompt = _setup(cfg, b=2, p=4)
+        out = generate(
+            cfg, params, prompt, 5, temperature=0.9,
+            key=jax.random.PRNGKey(11), top_p=0.8, top_k=8,
+        )
+        assert out.shape == (2, 9)
+        assert int(out.max()) < cfg.vocab_size
+
+    def test_bad_sampling_knobs_rejected(self):
+        import pytest
+
+        cfg = _cfg()
+        params, prompt = _setup(cfg, b=1, p=4)
+        with pytest.raises(ValueError, match="top_p"):
+            generate(cfg, params, prompt, 2, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            generate(cfg, params, prompt, 2, top_k=-1)
+
     def test_moe_decode_smoke(self):
         cfg = _cfg(n_experts=2)
         params, prompt = _setup(cfg, b=2, p=4)
