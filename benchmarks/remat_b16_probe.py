@@ -41,20 +41,27 @@ def main():
             return llama.LlamaConfig(
                 vocab_size=32000, dim=1024, n_layers=24, n_heads=8,
                 n_kv_heads=8, mlp_dim=4096, max_seq_len=2048,
-                remat=True, remat_policy=policy, attn_impl="auto",
-                fused_ce=fused,
+                remat=policy != "none", remat_policy=policy,
+                attn_impl="auto", fused_ce=fused,
             )
         return llama.LlamaConfig.tiny(fused_ce=fused)
 
     seq = 2048 if on_tpu else 64
     warmup, iters = (3, 10) if on_tpu else (1, 2)
-    # (name, batch, remat_policy, fused_ce)
+    # (name, batch, remat_policy, fused_ce). The "none" rows answer
+    # the question the earlier sweeps skipped: does the flagship batch
+    # fit with NO remat (zero recompute tax) — only batch 16 remat-off
+    # was ever tried (compile OOM).
     configs = (
         [
             ("b8_full_fused", 8, "full", True),
             ("b16_full_fused", 16, "full", True),
             ("b16_full_unfused", 16, "full", False),
             ("b12_full_fused", 12, "full", True),
+            ("b8_none_fused", 8, "none", True),
+            ("b8_none_unfused", 8, "none", False),
+            ("b6_none_fused", 6, "none", True),
+            ("b4_none_fused", 4, "none", True),
         ]
         if on_tpu
         else [("b4_full_fused", 4, "full", True)]
