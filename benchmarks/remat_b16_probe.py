@@ -1,11 +1,14 @@
-"""Batch-16 probe under FULL remat (save only layer inputs).
+"""Remat x batch sweep at the edges the main sweeps skipped.
 
-r3/r4 sweeps hit compile OOM at batch 16 with the "proj" policy (saves
-[B,S,dim] projection outputs per layer) both fused and unfused; nobody
-tried the minimum-HBM "full" policy, which recomputes the whole layer
-body in the backward. If batch 16 compiles under "full" + fused CE and
-its tokens/s beats batch 8 + "proj", bench.py's config should flip —
-the extra recompute FLOPs trade against better MXU occupancy.
+Two questions, one probe:
+1. Batch 16 under FULL remat (save only layer inputs): r3/r4 sweeps
+   hit compile OOM at batch 16 with the "proj" policy both fused and
+   unfused; "full" recomputes the whole layer body in the backward.
+   (Answered on chip 2026-07-31: compiles, but loses to b8+proj.)
+2. Batch 8/6/4 with NO remat at all (zero recompute tax): only batch
+   16 remat-off was ever tried (OOM) — if the flagship batch fits
+   without remat, the recompute overhead disappears entirely.
+Whichever row wins on tokens/s should be bench.py's config.
 
 Run: python benchmarks/remat_b16_probe.py   (CPU smoke: tiny shapes)
 One JSON line per config; OOM is a recorded result, not a failure.
@@ -102,10 +105,19 @@ def main():
             dt = time.monotonic() - t0
             row["value"] = round(batch * seq * iters / dt / n_dev, 1)
             row["step_ms"] = round(dt / iters * 1e3, 1)
-            del state, acc, b
         except Exception as e:  # noqa: BLE001 — OOM is a RESULT here
             row["value"] = 0.0
             row["error"] = str(e)[:160]
+        finally:
+            # free THIS config's device buffers even on the OOM path:
+            # a failed b16 row otherwise leaves params+opt state alive
+            # in HBM and fails every subsequent fit/no-fit verdict
+            # (plain assignment: `del locals()[...]` is a no-op in
+            # CPython)
+            state = acc = b = m = tokens = None  # noqa: F841
+            import gc
+
+            gc.collect()
         print(json.dumps(row), flush=True)
 
 

@@ -1,0 +1,237 @@
+"""Hardware conformance sweep: jit-lower and RUN every TPU-sensitive
+code path on the live chip, one JSON verdict line each.
+
+Motivation (r4): the Pallas int8 quantize kernel passed every CPU test
+for three rounds and failed its first real-TPU lowering — interpret
+mode does not check Mosaic tiling rules, XLA's CPU backend does not
+check fp8 support, and so on. This sweep is the antidote: a cheap,
+rerunnable pass/fail matrix over the paths whose TPU behavior differs
+from the CPU test tier. Run it whenever the kernel/surface set grows:
+
+    python benchmarks/tpu_conformance.py        # on the chip
+    DLROVER_TPU_FORCE_CPU=1 python ...          # CPU smoke of the harness
+
+Each line: {"path": ..., "ok": bool, "ms": float | "error": ...}.
+Exit code = number of failed paths (0 = fully conformant).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.utils.platform import ensure_cpu_if_forced  # noqa: E402
+
+ensure_cpu_if_forced()
+
+FAILS = 0
+
+
+def check(name):
+    """Decorator: run the thunk, time it, print one verdict line."""
+
+    def deco(fn):
+        global FAILS
+        row = {"path": name}
+        t0 = time.monotonic()
+        try:
+            fn()
+            row["ok"] = True
+            row["ms"] = round((time.monotonic() - t0) * 1e3, 1)
+        except Exception as e:  # noqa: BLE001 — failure IS the datum
+            row["ok"] = False
+            row["error"] = str(e)[:200]
+            FAILS += 1
+        print(json.dumps(row), flush=True)
+        return fn
+
+    return deco
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+
+    @check("flash_attention.fwd_bwd")
+    def _flash():
+        from dlrover_tpu.ops.attention import dot_product_attention
+
+        q = jax.random.normal(key, (2, 512, 4, 128), jnp.bfloat16)
+
+        def loss(q):
+            return (
+                dot_product_attention(q, q, q, causal=True, impl="auto")
+                .astype(jnp.float32)
+                .sum()
+            )
+
+        jax.block_until_ready(jax.jit(jax.grad(loss))(q))
+
+    @check("flash_attention.head_dim_64_seq_odd_blocks")
+    def _flash64():
+        from dlrover_tpu.ops.attention import dot_product_attention
+
+        q = jax.random.normal(key, (1, 384, 8, 64), jnp.bfloat16)
+        jax.block_until_ready(
+            jax.jit(
+                lambda q: dot_product_attention(
+                    q, q, q, causal=True, impl="auto"
+                )
+            )(q)
+        )
+
+    @check("quantization.int8_roundtrip")
+    def _quant():
+        from dlrover_tpu.ops.quantization import (
+            dequantize_int8,
+            quantize_int8,
+        )
+
+        x = jax.random.normal(key, (512, 1024), jnp.float32)
+        q, s = jax.jit(quantize_int8)(x)
+        y = jax.jit(dequantize_int8)(q, s)
+        jax.block_until_ready(y)
+        assert float(jnp.abs(y - x).max()) < float(
+            jnp.abs(x).max()
+        ), "roundtrip diverged"
+
+    @check("quantization.small_odd_shapes")
+    def _quant_small():
+        from dlrover_tpu.ops.quantization import (
+            dequantize_int8,
+            quantize_int8,
+        )
+
+        for m, n, b in ((1, 256, 256), (3, 512, 256), (9, 1024, 128)):
+            x = jax.random.normal(key, (m, n), jnp.float32)
+            q, s = quantize_int8(x, block=b)
+            jax.block_until_ready(dequantize_int8(q, s))
+
+    @check("quantization.stochastic_round")
+    def _stoch():
+        from dlrover_tpu.ops.quantization import stochastic_round_int8
+
+        x = jax.random.normal(key, (64, 512), jnp.float32)
+        q, s = jax.jit(stochastic_round_int8)(x, key)
+        jax.block_until_ready(q)
+
+    @check("amp.bf16_policy_train_step")
+    def _amp_bf16():
+        from dlrover_tpu.parallel.amp import get_policy
+
+        pol = get_policy("bf16")
+        w = {"w": jnp.ones((256, 256), jnp.float32)}
+
+        def loss(p, x):
+            pc = pol.cast_to_compute(p)
+            return (x @ pc["w"]).astype(jnp.float32).sum()
+
+        x = jax.random.normal(key, (8, 256), jnp.bfloat16)
+        jax.block_until_ready(jax.jit(jax.grad(loss))(w, x))
+
+    @check("amp.fp8_dot_e4m3")
+    def _fp8():
+        from dlrover_tpu.parallel.amp import fp8_dot, init_fp8_state
+
+        st = init_fp8_state()
+        a = jax.random.normal(key, (128, 256), jnp.bfloat16)
+        b = jax.random.normal(key, (256, 128), jnp.bfloat16)
+        out, _ = jax.jit(fp8_dot)(a, b, st)
+        jax.block_until_ready(out)
+
+    @check("optim.int8_adam_step")
+    def _int8_adam():
+        import optax
+
+        from dlrover_tpu.optim.low_precision import int8_adam
+
+        opt = int8_adam(1e-3)
+        p = {"w": jax.random.normal(key, (256, 512))}
+        st = opt.init(p)
+        g = jax.tree_util.tree_map(jnp.ones_like, p)
+        up, st2 = jax.jit(opt.update)(g, st, p)
+        jax.block_until_ready(optax.apply_updates(p, up))
+
+    @check("moe.topk_gating_fwd_bwd")
+    def _moe():
+        from dlrover_tpu.models import moe
+
+        cfg = moe.MoeConfig(n_experts=4, top_k=2)
+        params = moe.init_moe_mlp(key, cfg, dim=128, mlp_dim=256)
+        x = jax.random.normal(key, (2, 64, 128), jnp.bfloat16)
+
+        def loss(p):
+            out, metrics = moe.moe_mlp(cfg, p, x)
+            return out.astype(jnp.float32).sum() + metrics["moe_aux_loss"]
+
+        jax.block_until_ready(jax.jit(jax.grad(loss))(params))
+
+    @check("fused_ce.chunked_fwd_bwd")
+    def _fce():
+        from dlrover_tpu.ops.fused_ce import fused_cross_entropy
+
+        x = jax.random.normal(key, (2, 255, 128), jnp.bfloat16)
+        head = jax.random.normal(key, (128, 1024), jnp.bfloat16)
+        t = jax.random.randint(key, (2, 255), 0, 1024)
+
+        def loss(x, h):
+            nll, w = fused_cross_entropy(x, h, t, None)
+            return nll / w
+
+        jax.block_until_ready(jax.jit(jax.grad(loss))(x, head))
+
+    @check("decode.sampled_generate")
+    def _decode():
+        from dlrover_tpu.models import decode, llama
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, key)
+        prompt = jax.random.randint(key, (2, 8), 0, cfg.vocab_size)
+        out = decode.generate(
+            cfg, params, prompt, 8, temperature=0.9, top_k=8,
+            top_p=0.9, key=key,
+        )
+        jax.block_until_ready(out)
+
+    @check("remat.proj_policy_train_step")
+    def _remat():
+        import optax
+
+        from dlrover_tpu.models import llama
+        from dlrover_tpu.parallel.accelerate import (
+            Strategy,
+            accelerate,
+        )
+        from dlrover_tpu.parallel.mesh import MeshSpec
+
+        cfg = llama.LlamaConfig.tiny(remat=True, remat_policy="proj")
+        acc = accelerate(
+            init_params=lambda k: llama.init_params(cfg, k),
+            loss_fn=lambda p, b, m: llama.loss_fn(cfg, p, b, mesh=m),
+            rules=llama.partition_rules(cfg),
+            optimizer=optax.adamw(1e-4),
+            strategy=Strategy(mesh=MeshSpec.fit(1)),
+        )
+        state = acc.init(key)
+        toks = jax.random.randint(key, (2, 65), 0, cfg.vocab_size)
+        batch = acc.shard_batch({"tokens": toks})
+        state, m = acc.train_step(state, batch)
+        float(jax.device_get(m["loss"]))
+
+    print(
+        json.dumps(
+            {"path": "TOTAL", "failed": FAILS}
+        ),
+        flush=True,
+    )
+    return FAILS
+
+
+if __name__ == "__main__":
+    sys.exit(main())
