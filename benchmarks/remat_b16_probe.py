@@ -71,11 +71,16 @@ def main():
     )
 
     for name, batch, policy, fused in configs:
+        cfg = cfg_for(policy, fused)
+        # label from the ACTUAL config: the CPU smoke ignores the
+        # requested policy (tiny model, remat off), and the row must
+        # say so rather than claim a remat that never ran
         row = {"metric": f"remat_probe.{name}", "unit": "tok/s/chip",
-               "batch": batch, "remat_policy": policy, "fused": fused,
+               "batch": batch,
+               "remat_policy": cfg.remat_policy if cfg.remat else "none",
+               "fused": fused,
                "backend": jax.default_backend()}
         try:
-            cfg = cfg_for(policy, fused)
             acc = accelerate(
                 init_params=lambda k, c=cfg: llama.init_params(c, k),
                 loss_fn=lambda p, b, m, c=cfg: llama.loss_fn(

@@ -97,9 +97,11 @@ def main():
         q, s = jax.jit(quantize_int8)(x)
         y = jax.jit(dequantize_int8)(q, s)
         jax.block_until_ready(y)
-        assert float(jnp.abs(y - x).max()) < float(
-            jnp.abs(x).max()
-        ), "roundtrip diverged"
+        # per-block symmetric int8: error bounded by half a step,
+        # amax/254 per block <= global amax/254 — allow 2x slack, which
+        # still catches any systematic scale/lowering error
+        bound = float(jnp.abs(x).max()) / 127.0
+        assert float(jnp.abs(y - x).max()) <= bound, "roundtrip diverged"
 
     @check("quantization.small_odd_shapes")
     def _quant_small():

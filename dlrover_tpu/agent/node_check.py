@@ -22,12 +22,29 @@ from dlrover_tpu.common.log import default_logger as logger
 
 
 def matmul_collective_bench(
-    size: int = 1024, iters: int = 8
+    size: int = 0, iters: int = 8
 ) -> Tuple[bool, float]:
-    """(healthy, elapsed_seconds). Runs on whatever backend is live."""
+    """(healthy, elapsed_seconds). Runs on whatever backend is live.
+
+    size=0 picks per backend: 1024 exercises the MXU properly on TPU,
+    but bf16 matmuls are EMULATED on the CPU backend — at 1024^3 the
+    pre-flight check there takes minutes and reads as a hang (the CPU
+    tier is a plumbing smoke, not a hardware bench)."""
     try:
+        # the check runs in the LAUNCHER process (launch_agent), which
+        # otherwise never touches jax — honor DLROVER_TPU_FORCE_CPU
+        # here or the bench dials the TPU backend the workers were
+        # explicitly kept off (JAX_PLATFORMS alone does not stop the
+        # axon plugin; this config.update does)
+        from dlrover_tpu.utils.platform import ensure_cpu_if_forced
+
+        ensure_cpu_if_forced()
+
         import jax
         import jax.numpy as jnp
+
+        if size == 0:
+            size = 1024 if jax.default_backend() != "cpu" else 256
 
         n_local = jax.local_device_count()
 
@@ -41,9 +58,14 @@ def matmul_collective_bench(
         chain(x).block_until_ready()  # compile outside the timed region
 
         if n_local > 1:
-            mesh_devices = jax.local_devices()
+            import functools
 
-            @jax.pmap
+            # axis_name MUST be declared on the pmap: without it the
+            # all_gather raises "unbound axis name" on every
+            # multi-device host, making the pre-flight check mark
+            # healthy nodes faulty (caught by TestNodeCheck — the
+            # single-device path never enters this branch)
+            @functools.partial(jax.pmap, axis_name="i")
             def allgather(y):
                 return jax.lax.all_gather(y, axis_name="i")
 

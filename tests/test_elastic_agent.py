@@ -163,3 +163,77 @@ class TestElasticRunCLI:
 
         assert parse_nnodes("4") == (4, 4)
         assert parse_nnodes("2:8") == (2, 8)
+
+
+class TestNodeCheck:
+    """Pre-flight health check (agent/node_check.py) — previously the
+    one agent module with no direct test (PARITY listed this file as
+    its prover; now it is)."""
+
+    def test_bench_reports_healthy_and_elapsed(self):
+        from dlrover_tpu.agent.node_check import matmul_collective_bench
+
+        ok, elapsed = matmul_collective_bench(size=128, iters=2)
+        assert ok is True
+        assert elapsed > 0.0
+
+    def test_mock_error_rank_forces_unhealthy_report(self, monkeypatch):
+        from dlrover_tpu.agent import node_check
+        from dlrover_tpu.common.constants import NodeEnv
+
+        monkeypatch.setenv(NodeEnv.MOCK_ERR_RANK, "3")
+        monkeypatch.setenv(NodeEnv.NODE_ID, "3")
+        assert node_check._mock_error() is True
+        monkeypatch.setenv(NodeEnv.NODE_ID, "1")
+        assert node_check._mock_error() is False
+
+    def test_health_check_flow_against_fake_client(self, monkeypatch):
+        from dlrover_tpu.agent import node_check
+
+        class FakeClient:
+            node_id = 0
+
+            def __init__(self):
+                self.reports = []
+
+            def report_network_check(self, normal, elapsed):
+                self.reports.append((normal, elapsed))
+
+            def check_fault_nodes(self):
+                return []
+
+            def check_stragglers(self):
+                return []
+
+        # avoid re-running the real bench twice in a unit test
+        monkeypatch.setattr(
+            node_check,
+            "matmul_collective_bench",
+            lambda: (True, 0.01),
+        )
+        c = FakeClient()
+        assert node_check.node_health_check(c) is True
+        assert len(c.reports) == 2  # two check rounds
+        assert all(normal for normal, _ in c.reports)
+
+    def test_health_check_false_when_marked_faulty(self, monkeypatch):
+        from dlrover_tpu.agent import node_check
+
+        class FaultyClient:
+            node_id = 2
+
+            def report_network_check(self, normal, elapsed):
+                pass
+
+            def check_fault_nodes(self):
+                return [2]
+
+            def check_stragglers(self):  # pragma: no cover
+                return []
+
+        monkeypatch.setattr(
+            node_check,
+            "matmul_collective_bench",
+            lambda: (True, 0.01),
+        )
+        assert node_check.node_health_check(FaultyClient()) is False
