@@ -96,11 +96,47 @@ def _mock_error() -> bool:
     return bool(mock) and mock == node_id
 
 
+def run_bench_isolated(timeout_s: float = 300.0) -> Tuple[bool, float]:
+    """Run the bench in a SHORT-LIVED subprocess and parse its verdict.
+
+    The caller is the long-lived launcher/agent process, and libtpu is
+    exclusive per process (the same invariant agent/collector.py keeps:
+    the agent must never import jax or it steals the TPU from the
+    training process it supervises). In-process jax init here would
+    hold the chip past the check and starve the workers launched next;
+    the subprocess acquires it, benches, and RELEASES it on exit."""
+    import json
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "dlrover_tpu.agent.node_check"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                verdict = json.loads(line)
+                return bool(verdict["ok"]), float(verdict["elapsed"])
+        logger.error(
+            "node check subprocess produced no verdict (rc=%d): %s",
+            proc.returncode,
+            proc.stderr[-500:],
+        )
+        return False, 0.0
+    except Exception:  # noqa: BLE001 — timeout/spawn error = unhealthy
+        logger.exception("node check subprocess failed")
+        return False, 0.0
+
+
 def node_health_check(client: MasterClient, config=None) -> bool:
     """Two check rounds against the network-check rendezvous; returns
     False if the master marks this node faulty."""
     for round_idx in range(2):
-        normal, elapsed = matmul_collective_bench()
+        normal, elapsed = run_bench_isolated()
         if _mock_error():
             normal, elapsed = False, 0.0
         client.report_network_check(normal=normal, elapsed=elapsed)
@@ -117,3 +153,11 @@ def node_health_check(client: MasterClient, config=None) -> bool:
     if client.node_id in stragglers:
         logger.warning("this node is a straggler (continuing)")
     return True
+
+
+if __name__ == "__main__":
+    # subprocess entry for run_bench_isolated: bench, print verdict
+    import json as _json
+
+    _ok, _t = matmul_collective_bench()
+    print(_json.dumps({"ok": _ok, "elapsed": _t}), flush=True)

@@ -306,6 +306,8 @@ def generate(
     max_len: Optional[int] = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    eos_id: Optional[int] = None,
+    pad_id: int = 0,
 ) -> jax.Array:
     """Greedy / temperature sampling with the KV cache; one compiled
     scan drives all steps. Returns [B, P + max_new_tokens].
@@ -313,7 +315,13 @@ def generate(
     `top_k > 0` and/or `top_p < 1.0` filter the distribution before a
     temperature draw (vLLM-style knobs — reference inference backend:
     atorch/rl/inference_backend/vllm_backend.py); both are ignored for
-    greedy decoding (temperature <= 0)."""
+    greedy decoding (temperature <= 0).
+
+    `eos_id` enables early stopping per sequence: the eos token is
+    emitted, every later position is `pad_id` (same semantics as
+    rl/generate's done mask). Shapes stay static — finished rows keep
+    stepping cheaply through the compiled scan — so the output is
+    always [B, P + max_new_tokens] with a pad tail."""
     b, p = prompt.shape
     m = max_len or (p + max_new_tokens)
     if m < p + max_new_tokens:
@@ -324,6 +332,11 @@ def generate(
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k < 0:
         raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if eos_id is not None and eos_id == pad_id:
+        raise ValueError(
+            f"eos_id and pad_id must differ (both {eos_id}): the pad "
+            "tail would re-trigger the done mask's eos detection"
+        )
     # positions actually used reach p + max_new_tokens - 1; the cache
     # buffer (m) may be padded larger for static-shape reuse
     _check_positional_capacity(cfg, p + max_new_tokens)
@@ -348,26 +361,35 @@ def generate(
             prompt.dtype
         )
 
+    def emit(raw, done):
+        """Apply the done mask: finished rows emit pad; a fresh eos
+        marks the row done AFTER being emitted itself."""
+        if eos_id is None:
+            return raw, done
+        tok = jnp.where(done, jnp.asarray(pad_id, raw.dtype), raw)
+        return tok, done | (tok == eos_id)
+
     # single-use key discipline: the first draw gets its own subkey,
     # never the key the scan derives the rest from
     key, first_key = jax.random.split(key)
-    first = sample(logits, first_key)
+    done0 = jnp.zeros((b,), jnp.bool_)
+    first, done0 = emit(sample(logits, first_key), done0)
 
     def step(carry, t):
-        token, cache, key = carry
+        token, cache, key, done = carry
         key, sub = jax.random.split(key)
         logits, cache = decode_step(
             cfg, params, token, cache, p + t
         )
-        nxt = sample(logits, sub)
-        return (nxt, cache, key), token
+        nxt, done = emit(sample(logits, sub), done)
+        return (nxt, cache, key, done), token
 
     # N-1 steps: `first` is token #1 (from the prefill logits); each
     # step feeds the previous sample and emits it, and the final carry
     # is token #N — no wasted trailing forward whose sample would be
     # dropped
-    (last_tok, _, _), out_tokens = jax.lax.scan(
-        step, (first, cache, key), jnp.arange(max_new_tokens - 1)
+    (last_tok, _, _, _), out_tokens = jax.lax.scan(
+        step, (first, cache, key, done0), jnp.arange(max_new_tokens - 1)
     )
     gen = jnp.concatenate(
         [out_tokens.swapaxes(0, 1), last_tok[:, None]], axis=1

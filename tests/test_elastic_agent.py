@@ -177,6 +177,17 @@ class TestNodeCheck:
         assert ok is True
         assert elapsed > 0.0
 
+    def test_isolated_bench_subprocess_roundtrip(self):
+        # the real subprocess path: spawn, bench, parse verdict — the
+        # launcher process itself must never init jax (libtpu is
+        # exclusive per process; in-process init would starve the
+        # workers launched right after the check)
+        from dlrover_tpu.agent.node_check import run_bench_isolated
+
+        ok, elapsed = run_bench_isolated(timeout_s=280.0)
+        assert ok is True
+        assert elapsed > 0.0
+
     def test_mock_error_rank_forces_unhealthy_report(self, monkeypatch):
         from dlrover_tpu.agent import node_check
         from dlrover_tpu.common.constants import NodeEnv
@@ -205,10 +216,10 @@ class TestNodeCheck:
             def check_stragglers(self):
                 return []
 
-        # avoid re-running the real bench twice in a unit test
+        # avoid spawning the real bench subprocess twice in a unit test
         monkeypatch.setattr(
             node_check,
-            "matmul_collective_bench",
+            "run_bench_isolated",
             lambda: (True, 0.01),
         )
         c = FakeClient()
@@ -233,7 +244,7 @@ class TestNodeCheck:
 
         monkeypatch.setattr(
             node_check,
-            "matmul_collective_bench",
+            "run_bench_isolated",
             lambda: (True, 0.01),
         )
         assert node_check.node_health_check(FaultyClient()) is False
