@@ -44,4 +44,13 @@ run flash_attn 3600 python benchmarks/flash_attention_bench.py
 # 4. decode/KV-cache: prefill + per-token + cached-vs-uncached
 run decode 2400 python benchmarks/decode_bench.py
 
+# 5. hardware conformance: every TPU-sensitive path lowers AND runs
+run conformance 2400 python benchmarks/tpu_conformance.py
+
+# 6. int8 quantize/dequantize kernel throughput
+run quantization 1200 python benchmarks/quantization_bench.py
+
+# 7. remat x batch sweep edges (incl. remat-off rows)
+run remat_sweep 3600 python benchmarks/remat_b16_probe.py
+
 echo "== done: $OUT =="
