@@ -41,10 +41,12 @@ class MasterClient:
 
     # ---- plumbing --------------------------------------------------------
 
-    def _call(self, kind: str, payload, timeout=None) -> ReplyEnvelope:
+    def _call(
+        self, kind: str, payload, timeout=None, retries=None
+    ) -> ReplyEnvelope:
         fn = self._stub.get if kind == "get" else self._stub.report
         last_err = None
-        for attempt in range(self.max_retries):
+        for attempt in range(retries or self.max_retries):
             try:
                 reply = fn(
                     payload,
@@ -74,8 +76,8 @@ class MasterClient:
             logger.debug("get(%s) -> %s", type(payload).__name__, reply.reason)
         return reply.payload
 
-    def report(self, payload, timeout=None) -> ReplyEnvelope:
-        return self._call("report", payload, timeout)
+    def report(self, payload, timeout=None, retries=None) -> ReplyEnvelope:
+        return self._call("report", payload, timeout, retries)
 
     def close(self):
         self._stub.close()
@@ -89,14 +91,25 @@ class MasterClient:
             )
         )
 
-    def report_node_status(self, status: str, exit_reason: str = ""):
+    def report_node_status(
+        self,
+        status: str,
+        exit_reason: str = "",
+        timeout=None,
+        retries=None,
+    ):
+        # timeout/retries: the SIGTERM leave path reports with a short
+        # single attempt — an unreachable master must not burn the
+        # eviction grace period ahead of the checkpoint persist
         return self.report(
             msg.NodeStatusReport(
                 node_id=self.node_id,
                 node_type=self.node_type,
                 status=status,
                 exit_reason=exit_reason,
-            )
+            ),
+            timeout=timeout,
+            retries=retries,
         )
 
     def report_heart_beat(self) -> msg.HeartbeatResponse:

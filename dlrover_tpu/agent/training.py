@@ -60,6 +60,10 @@ class ElasticLaunchConfig:
             self.network_check = False
 
 
+class RendezvousAborted(Exception):
+    """The agent is stopping (leave/preemption) — abandon the poll."""
+
+
 class MasterRendezvousHandler:
     """Join the master rendezvous and block for the comm world.
 
@@ -74,17 +78,25 @@ class MasterRendezvousHandler:
         rdzv_name: str = "training",
         timeout: float = JobConstant.RDZV_JOIN_TIMEOUT_DEFAULT,
         poll_interval: float = 0.5,
+        should_stop=None,
     ):
         self.client = client
         self.rdzv_name = rdzv_name
         self.timeout = timeout
         self.poll_interval = poll_interval
+        # callable checked each poll: a SIGTERM/leave() arriving while
+        # the main thread is blocked HERE must abort the poll promptly
+        # (after a DELETED report this node can never join a world, so
+        # without the check the loop burns the whole rdzv timeout and
+        # the eviction grace period with it)
+        self.should_stop = should_stop or (lambda: False)
 
     def next_rendezvous(
         self, local_world_size: int = 1, node_addr: str = ""
     ) -> Tuple[int, int, CommWorld]:
         """Returns (round, node_rank, world). Blocks until the round
-        forms or raises TimeoutError."""
+        forms; raises TimeoutError on timeout or RendezvousAborted
+        when `should_stop` fires mid-poll."""
         self.client.join_rendezvous(
             local_world_size=local_world_size,
             rdzv_name=self.rdzv_name,
@@ -92,6 +104,11 @@ class MasterRendezvousHandler:
         )
         deadline = time.monotonic() + self.timeout
         while time.monotonic() < deadline:
+            if self.should_stop():
+                raise RendezvousAborted(
+                    f"rendezvous {self.rdzv_name!r} aborted: agent "
+                    "stopping (leave/preemption)"
+                )
             rnd, _, world = self.client.get_comm_world(self.rdzv_name)
             if world:
                 for rank, (nid, _, _) in world.items():
@@ -155,7 +172,9 @@ class ElasticTrainingAgent:
         self.client = client or MasterClient.singleton()
         self.host_addr = host_addr
         self.rdzv = MasterRendezvousHandler(
-            self.client, timeout=config.rdzv_timeout
+            self.client,
+            timeout=config.rdzv_timeout,
+            should_stop=lambda: self._stop.is_set(),
         )
         self.worker: Optional[WorkerProcess] = None
         self.restart_count = 0
@@ -374,9 +393,15 @@ class ElasticTrainingAgent:
         self._start_heartbeats()
         self.collectors.start()
         self.client.register_node()
-        rnd, world = self._start_worker()
         try:
+            rnd, world = self._start_worker()
             return self._monitor_loop()
+        except RendezvousAborted:
+            # leave()/SIGTERM landed while blocked in a rendezvous
+            # poll — a graceful exit, not a failure; the finally below
+            # still persists any staged shm
+            logger.info("agent stopping during rendezvous — exiting")
+            return 0
         finally:
             self._stop.set()
             self.collectors.stop()
@@ -456,20 +481,28 @@ class ElasticTrainingAgent:
 
     def leave(self):
         """Graceful departure (preemption notice / scale-down): stop
-        supervising, then tell the master this node is gone so it
-        invalidates the rendezvous world — survivors re-rendezvous
-        instead of hanging on our collectives. The TPU analogue of a
-        SIGTERM-with-grace pod eviction. Order matters: stop first so
-        the monitor loop cannot re-join the rendezvous after the
-        DELETED report cleaned us out of it. The worker stops here so
-        staging is final; run()'s teardown then persists the staged
-        shm (this host's final MEMORY-only step may exist nowhere
-        else) before the saver/IPC go down."""
+        supervising, persist the staged checkpoint, then tell the
+        master this node is gone so it invalidates the rendezvous
+        world — survivors re-rendezvous instead of hanging on our
+        collectives. The TPU analogue of a SIGTERM-with-grace pod
+        eviction. Order matters twice over: stop first so the monitor
+        loop cannot re-join the rendezvous after the DELETED report
+        cleaned us out of it, and PERSIST BEFORE REPORTING — the
+        eviction grace is finite, and a blackholed master (whole-job
+        eviction) must not burn it ahead of the one action that makes
+        this host's final MEMORY-only step durable. The report itself
+        is a single short attempt for the same reason; run()'s
+        teardown re-persists harmlessly (the saver skips stale
+        steps)."""
         self.stop()
         self._stop_worker()
         try:
+            self.ckpt_saver.save_shm_to_storage()
+        except Exception:  # noqa: BLE001
+            logger.exception("leave-path checkpoint persist failed")
+        try:
             self.client.report_node_status(
-                NodeStatus.DELETED, "preempted"
+                NodeStatus.DELETED, "preempted", timeout=5.0, retries=1
             )
         except Exception:  # noqa: BLE001 — master may be gone too
             logger.warning("leave report failed", exc_info=True)
@@ -497,4 +530,22 @@ def launch_agent(
     agent = ElasticTrainingAgent(
         config, entrypoint, client, host_addr=host_addr
     )
+
+    # pod eviction / preemption notice arrives as SIGTERM-with-grace:
+    # route it to leave() so the monitor loop exits and run()'s
+    # teardown persists the staged shm checkpoint (this host's final
+    # MEMORY-only step may exist nowhere else) before the process
+    # dies. Without the handler the default action kills the agent
+    # mid-supervision and survivors stall until heartbeat timeout.
+    # Reference: --save_at_breakpoint / torch agent shutdown path.
+    def _graceful_leave(signum, frame):  # noqa: ARG001
+        logger.info("SIGTERM — graceful leave (preemption notice)")
+        agent.leave()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful_leave)
+    except ValueError:
+        # not the main thread (embedded/test callers) — skip wiring;
+        # such callers drive leave() themselves
+        logger.warning("not main thread; SIGTERM leave not installed")
     return agent.run()

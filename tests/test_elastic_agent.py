@@ -248,3 +248,108 @@ class TestNodeCheck:
             lambda: (True, 0.01),
         )
         assert node_check.node_health_check(FaultyClient()) is False
+
+
+class TestSigtermGracefulLeave:
+    def test_sigterm_mid_training_leaves_and_exits_zero(self, tmp_path):
+        """A real pod eviction is SIGTERM-with-grace to the launcher:
+        the handler must route it to agent.leave() so the run exits
+        cleanly (staged shm persisted by run()'s teardown) instead of
+        dying mid-supervision."""
+        import signal as sig
+        import subprocess
+        import sys
+        import time
+
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import time\n"
+            "print('training-started', flush=True)\n"
+            "time.sleep(120)\n"
+        )
+        import os
+
+        env = {**os.environ, "DLROVER_TPU_FORCE_CPU": "1"}
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "dlrover_tpu.trainer.elastic_run",
+                "--nnodes",
+                "1",
+                "--max-restarts",
+                "1",
+                str(script),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        try:
+            # wait for the worker to actually start training. A reader
+            # thread drains stdout so the deadline below actually
+            # fires even when the launcher hangs producing NO output
+            # (a blocking readline would wait forever).
+            import threading
+
+            lines = []
+            started = threading.Event()
+
+            def _drain():
+                for line in proc.stdout:
+                    lines.append(line)
+                    if "training-started" in line:
+                        started.set()
+
+            t = threading.Thread(target=_drain, daemon=True)
+            t.start()
+            if not started.wait(timeout=120):
+                raise AssertionError(
+                    "worker never started: " + "".join(lines)[-2000:]
+                )
+            proc.send_signal(sig.SIGTERM)
+            proc.wait(timeout=90)
+            t.join(timeout=10)
+            full = "".join(lines)
+            assert "graceful leave" in full, full[-2000:]
+            assert proc.returncode == 0, (proc.returncode, full[-2000:])
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+class TestRendezvousAbort:
+    def test_should_stop_aborts_poll_promptly(self):
+        """leave()/SIGTERM during a rendezvous poll must abort the
+        loop immediately — after the DELETED report this node can
+        never join a world, so waiting out rdzv_timeout would burn
+        the whole eviction grace period."""
+        import time as _time
+
+        import pytest
+
+        from dlrover_tpu.agent.training import (
+            MasterRendezvousHandler,
+            RendezvousAborted,
+        )
+
+        class NeverFormsClient:
+            node_id = 0
+
+            def join_rendezvous(self, **kw):
+                return 0
+
+            def get_comm_world(self, name):
+                return 0, 0, {}
+
+        h = MasterRendezvousHandler(
+            NeverFormsClient(),
+            timeout=30.0,
+            poll_interval=0.05,
+            should_stop=lambda: True,
+        )
+        t0 = _time.monotonic()
+        with pytest.raises(RendezvousAborted):
+            h.next_rendezvous()
+        assert _time.monotonic() - t0 < 5.0
