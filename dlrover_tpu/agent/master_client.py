@@ -46,7 +46,8 @@ class MasterClient:
     ) -> ReplyEnvelope:
         fn = self._stub.get if kind == "get" else self._stub.report
         last_err = None
-        for attempt in range(retries or self.max_retries):
+        n = retries if retries is not None else self.max_retries
+        for attempt in range(n):
             try:
                 reply = fn(
                     payload,
@@ -57,6 +58,8 @@ class MasterClient:
                 return reply
             except grpc.RpcError as e:  # master restarting / net blip
                 last_err = e
+                if attempt + 1 >= n:
+                    break  # no retry follows — don't sleep the backoff
                 wait = min(2.0 * (attempt + 1), 10.0)
                 logger.warning(
                     "master RPC %s(%s) failed (%s); retry in %.1fs",
@@ -67,7 +70,7 @@ class MasterClient:
                 )
                 time.sleep(wait)
         raise ConnectionError(
-            f"master unreachable after {self.max_retries} tries"
+            f"master unreachable after {n} tries"
         ) from last_err
 
     def get(self, payload, timeout=None):

@@ -180,6 +180,7 @@ class ElasticTrainingAgent:
         self.restart_count = 0
         self._current_round = 0
         self._stop = threading.Event()
+        self._leave_requested = threading.Event()
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._coordinator_port = find_free_port()
         # flash-checkpoint plumbing: the agent owns the IPC server, the
@@ -415,6 +416,21 @@ class ElasticTrainingAgent:
                 self.ckpt_saver.save_shm_to_storage()
             except Exception:  # noqa: BLE001
                 logger.exception("teardown checkpoint persist failed")
+            if self._leave_requested.is_set():
+                # signal-requested leave: the handler only set flags
+                # (anything heavier could deadlock on locks its own
+                # interrupted frame holds); the DELETED report happens
+                # here, AFTER the persist above, with one short
+                # attempt so a blackholed master cannot eat the grace
+                try:
+                    self.client.report_node_status(
+                        NodeStatus.DELETED,
+                        "preempted",
+                        timeout=5.0,
+                        retries=1,
+                    )
+                except Exception:  # noqa: BLE001
+                    logger.warning("leave report failed", exc_info=True)
             self.ckpt_saver.stop()
             self._ipc.stop()
 
@@ -423,7 +439,10 @@ class ElasticTrainingAgent:
             self._stop.wait(self.config.monitor_interval)
             if self._stop.is_set():
                 break
-            code = self.worker.poll() if self.worker else None
+            # snapshot: leave() (another thread / in-process E2E
+            # callers) nulls self.worker concurrently
+            w = self.worker
+            code = w.poll() if w else None
             if code is None:
                 if self._membership_changed():
                     logger.info(
@@ -477,6 +496,16 @@ class ElasticTrainingAgent:
         return 0
 
     def stop(self):
+        self._stop.set()
+
+    def request_leave(self):
+        """Async-signal-safe leave trigger: ONLY sets flags. The
+        monitor loop wakes, run() unwinds, and the teardown persists
+        the staged shm then reports DELETED. A signal handler must not
+        call leave() directly — its persist would deadlock on the
+        saver's commit lock if the signal interrupted a persist
+        already running on this same (main) thread."""
+        self._leave_requested.set()
         self._stop.set()
 
     def leave(self):
@@ -540,7 +569,7 @@ def launch_agent(
     # Reference: --save_at_breakpoint / torch agent shutdown path.
     def _graceful_leave(signum, frame):  # noqa: ARG001
         logger.info("SIGTERM — graceful leave (preemption notice)")
-        agent.leave()
+        agent.request_leave()
 
     try:
         signal.signal(signal.SIGTERM, _graceful_leave)

@@ -1,0 +1,155 @@
+"""HuggingFace → dlrover_tpu weight conversion (Llama family).
+
+A user migrating from the reference stack starts from HF checkpoints
+(the reference's 7B acceptance workload loads one:
+examples/pytorch/llama2/fine_tuning.py:26). This maps an HF
+`LlamaForCausalLM` state dict onto this framework's stacked-layer param
+pytree. It is pure layout work — no numerics change:
+
+- HF `nn.Linear` stores [out, in]; our matmuls are `h @ W` with W
+  [in, out] → transpose every projection.
+- Per-layer HF weights stack along a leading n_layers axis (our layer
+  scan consumes it).
+- RoPE needs NO weight permutation: both sides use the rotate-half
+  convention (llama.py `_rope` == HF's `q*cos + rotate_half(q)*sin`).
+
+Numerical equivalence against `transformers` is pinned by
+tests/test_hf_convert.py (logit parity on a random tiny model).
+"""
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from dlrover_tpu.models.llama import LlamaConfig
+
+
+def config_from_hf(hf_config, **overrides) -> LlamaConfig:
+    """LlamaConfig from a transformers LlamaConfig(-like) object."""
+    fields = dict(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config,
+            "num_key_value_heads",
+            hf_config.num_attention_heads,
+        ),
+        mlp_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        tie_embeddings=getattr(
+            hf_config, "tie_word_embeddings", False
+        ),
+    )
+    fields.update(overrides)
+    return LlamaConfig(**fields)
+
+
+def _to_numpy(t) -> np.ndarray:
+    """torch tensor / numpy array → float32 numpy — per TENSOR, so
+    the peak extra host memory is one layer's weight, not the whole
+    model (a 7B import already holds the torch model; a second f32
+    full-model copy would OOM common hosts)."""
+    if hasattr(t, "detach"):  # torch tensor
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def params_from_hf_state_dict(
+    state_dict: Dict[str, Any], cfg: LlamaConfig
+) -> Dict:
+    """HF LlamaForCausalLM state dict → our param pytree.
+
+    Accepts torch tensors or numpy arrays as values; keys may carry
+    the usual `model.` prefix or not. Raises KeyError naming the
+    missing HF key if the dict is incomplete."""
+    import jax.numpy as jnp
+
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def get(key: str) -> np.ndarray:
+        if key not in sd:
+            raise KeyError(
+                f"HF checkpoint is missing {key!r} — is this a "
+                "LlamaForCausalLM state dict?"
+            )
+        return _to_numpy(sd[key])
+
+    pd = cfg.param_dtype
+
+    def _as_param(a: np.ndarray):
+        import jax.numpy as _jnp
+
+        return _jnp.asarray(a, pd)
+
+    def stack_t(fmt: str):
+        """Per-layer [out, in] weights → stacked [L, in, out], each
+        layer converted to param_dtype individually so the f32
+        intermediate never exceeds one layer."""
+        return jnp.stack(
+            [
+                _as_param(get(fmt.format(i=i)).T)
+                for i in range(cfg.n_layers)
+            ]
+        )
+
+    def stack(fmt: str):
+        return jnp.stack(
+            [
+                _as_param(get(fmt.format(i=i)))
+                for i in range(cfg.n_layers)
+            ]
+        )
+    layers = {
+        "attn_norm": stack("layers.{i}.input_layernorm.weight"),
+        "wq": stack_t("layers.{i}.self_attn.q_proj.weight"),
+        "wk": stack_t("layers.{i}.self_attn.k_proj.weight"),
+        "wv": stack_t("layers.{i}.self_attn.v_proj.weight"),
+        "wo": stack_t("layers.{i}.self_attn.o_proj.weight"),
+        "mlp_norm": stack(
+            "layers.{i}.post_attention_layernorm.weight"
+        ),
+        "w_gate": stack_t("layers.{i}.mlp.gate_proj.weight"),
+        "w_up": stack_t("layers.{i}.mlp.up_proj.weight"),
+        "w_down": stack_t("layers.{i}.mlp.down_proj.weight"),
+    }
+    params = {
+        "embed": {
+            "weight": jnp.asarray(get("embed_tokens.weight"), pd)
+        },
+        "layers": layers,
+        "final_norm": {"scale": jnp.asarray(get("norm.weight"), pd)},
+    }
+    if not cfg.tie_embeddings:
+        # lm_head lives OUTSIDE the `model.` prefix in HF checkpoints
+        head = sd.get("lm_head.weight")
+        if head is None:
+            raise KeyError(
+                "HF checkpoint has no lm_head.weight and "
+                "cfg.tie_embeddings is False"
+            )
+        params["lm_head"] = {
+            "weight": jnp.asarray(_to_numpy(head).T, pd)
+        }
+    return params
+
+
+def from_hf(model_or_path, **cfg_overrides) -> Tuple[LlamaConfig, Dict]:
+    """One-call import: a transformers model instance OR a local
+    pretrained path → (LlamaConfig, params).
+
+    `cfg_overrides` pass through to `config_from_hf` (e.g. dtype=...,
+    remat=..., attn_impl=...) so the imported model can adopt this
+    framework's training/runtime knobs directly."""
+    if isinstance(model_or_path, str):
+        from transformers import LlamaForCausalLM
+
+        model_or_path = LlamaForCausalLM.from_pretrained(model_or_path)
+    cfg = config_from_hf(model_or_path.config, **cfg_overrides)
+    params = params_from_hf_state_dict(
+        model_or_path.state_dict(), cfg
+    )
+    return cfg, params
