@@ -153,3 +153,43 @@ def from_hf(model_or_path, **cfg_overrides) -> Tuple[LlamaConfig, Dict]:
         model_or_path.state_dict(), cfg
     )
     return cfg, params
+
+
+def to_hf_state_dict(cfg: LlamaConfig, params: Dict) -> Dict[str, Any]:
+    """Our pytree → an HF LlamaForCausalLM state dict (numpy float32
+    values, standard `model.` prefix) — the reverse of
+    `params_from_hf_state_dict`, so a model trained here can be served
+    by any HF/vLLM stack. Load with
+    `hf_model.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})`.
+    """
+    layers = params["layers"]
+    sd: Dict[str, Any] = {
+        "model.embed_tokens.weight": _to_numpy(
+            params["embed"]["weight"]
+        ),
+        "model.norm.weight": _to_numpy(params["final_norm"]["scale"]),
+    }
+    per_layer = {
+        "input_layernorm.weight": ("attn_norm", False),
+        "self_attn.q_proj.weight": ("wq", True),
+        "self_attn.k_proj.weight": ("wk", True),
+        "self_attn.v_proj.weight": ("wv", True),
+        "self_attn.o_proj.weight": ("wo", True),
+        "post_attention_layernorm.weight": ("mlp_norm", False),
+        "mlp.gate_proj.weight": ("w_gate", True),
+        "mlp.up_proj.weight": ("w_up", True),
+        "mlp.down_proj.weight": ("w_down", True),
+    }
+    for i in range(cfg.n_layers):
+        for hf_name, (ours, transpose) in per_layer.items():
+            w = _to_numpy(layers[ours][i])
+            sd[f"model.layers.{i}.{hf_name}"] = (
+                w.T if transpose else w
+            )
+    if cfg.tie_embeddings:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    else:
+        sd["lm_head.weight"] = _to_numpy(
+            params["lm_head"]["weight"]
+        ).T
+    return sd
