@@ -48,6 +48,22 @@ def config_from_hf(hf_config, **overrides) -> LlamaConfig:
     return LlamaConfig(**fields)
 
 
+# hf per-layer name -> (our layers key, transposed?): consumed by BOTH
+# conversion directions so they cannot drift (a rename/addition lands
+# in import and export together or a KeyError surfaces in tests)
+_PER_LAYER = {
+    "input_layernorm.weight": ("attn_norm", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+}
+
+
 def _to_numpy(t) -> np.ndarray:
     """torch tensor / numpy array → float32 numpy — per TENSOR, so
     the peak extra host memory is one layer's weight, not the whole
@@ -104,17 +120,10 @@ def params_from_hf_state_dict(
             ]
         )
     layers = {
-        "attn_norm": stack("layers.{i}.input_layernorm.weight"),
-        "wq": stack_t("layers.{i}.self_attn.q_proj.weight"),
-        "wk": stack_t("layers.{i}.self_attn.k_proj.weight"),
-        "wv": stack_t("layers.{i}.self_attn.v_proj.weight"),
-        "wo": stack_t("layers.{i}.self_attn.o_proj.weight"),
-        "mlp_norm": stack(
-            "layers.{i}.post_attention_layernorm.weight"
-        ),
-        "w_gate": stack_t("layers.{i}.mlp.gate_proj.weight"),
-        "w_up": stack_t("layers.{i}.mlp.up_proj.weight"),
-        "w_down": stack_t("layers.{i}.mlp.down_proj.weight"),
+        ours: (stack_t if transpose else stack)(
+            "layers.{i}." + hf_name
+        )
+        for hf_name, (ours, transpose) in _PER_LAYER.items()
     }
     params = {
         "embed": {
@@ -169,20 +178,18 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Dict) -> Dict[str, Any]:
         ),
         "model.norm.weight": _to_numpy(params["final_norm"]["scale"]),
     }
-    per_layer = {
-        "input_layernorm.weight": ("attn_norm", False),
-        "self_attn.q_proj.weight": ("wq", True),
-        "self_attn.k_proj.weight": ("wk", True),
-        "self_attn.v_proj.weight": ("wv", True),
-        "self_attn.o_proj.weight": ("wo", True),
-        "post_attention_layernorm.weight": ("mlp_norm", False),
-        "mlp.gate_proj.weight": ("w_gate", True),
-        "mlp.up_proj.weight": ("w_up", True),
-        "mlp.down_proj.weight": ("w_down", True),
-    }
-    for i in range(cfg.n_layers):
-        for hf_name, (ours, transpose) in per_layer.items():
-            w = _to_numpy(layers[ours][i])
+    for hf_name, (ours, transpose) in _PER_LAYER.items():
+        # one device->host transfer per param name (not per layer).
+        # NOTE the memory contract: the returned dict's values are
+        # views into per-weight f32 arrays, so the export holds ONE
+        # full f32 copy of the model alongside the live params — for
+        # a 7B that is ~28 GB of host RAM. Fine for serving-side
+        # export boxes; a dtype-preserving variant would need
+        # ml_dtypes-aware torch interop and is deliberately not
+        # attempted here.
+        stacked = _to_numpy(layers[ours])
+        for i in range(cfg.n_layers):
+            w = stacked[i]
             sd[f"model.layers.{i}.{hf_name}"] = (
                 w.T if transpose else w
             )

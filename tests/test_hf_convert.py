@@ -86,18 +86,21 @@ class TestHfLogitParity:
 
 
 class TestHfExport:
-    def test_roundtrip_through_hf_model(self):
+    def _assert_export_roundtrip(self, tie: bool, seed: int):
         """Export our randomly initialized params INTO a fresh HF
         model and compare logits — proves the reverse mapping, so
         models trained here serve on any HF/vLLM stack."""
         from dlrover_tpu.models.convert import to_hf_state_dict
 
-        hf = _tiny_hf_model(n_heads=4, n_kv_heads=2)
+        hf = _tiny_hf_model(n_heads=4, n_kv_heads=2, tie=tie)
         cfg = config_from_hf(
             hf.config, dtype=jnp.float32, param_dtype=jnp.float32,
             remat=False, attn_impl="reference",
         )
-        params = llama.init_params(cfg, jax.random.PRNGKey(3))
+        assert cfg.tie_embeddings == tie
+        params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+        if tie:
+            assert "lm_head" not in params
         sd = to_hf_state_dict(cfg, params)
         hf.load_state_dict(
             {k: torch.tensor(v) for k, v in sd.items()}
@@ -114,29 +117,8 @@ class TestHfExport:
             ours, hf_logits, atol=2e-4, rtol=2e-3
         )
 
-    def test_tied_embeddings_roundtrip(self):
-        from dlrover_tpu.models.convert import to_hf_state_dict
+    def test_roundtrip_through_hf_model(self):
+        self._assert_export_roundtrip(tie=False, seed=3)
 
-        hf = _tiny_hf_model(n_heads=4, n_kv_heads=2, tie=True)
-        cfg = config_from_hf(
-            hf.config, dtype=jnp.float32, param_dtype=jnp.float32,
-            remat=False, attn_impl="reference",
-        )
-        assert cfg.tie_embeddings
-        params = llama.init_params(cfg, jax.random.PRNGKey(5))
-        assert "lm_head" not in params
-        sd = to_hf_state_dict(cfg, params)
-        hf.load_state_dict(
-            {k: torch.tensor(v) for k, v in sd.items()}
-        )
-        tokens = np.array([[5, 9, 77, 31]], np.int32)
-        with torch.no_grad():
-            hf_logits = hf(
-                torch.tensor(tokens, dtype=torch.long)
-            ).logits.numpy()
-        ours = np.asarray(
-            llama.apply(cfg, params, jnp.asarray(tokens)), np.float32
-        )
-        np.testing.assert_allclose(
-            ours, hf_logits, atol=2e-4, rtol=2e-3
-        )
+    def test_tied_embeddings_roundtrip(self):
+        self._assert_export_roundtrip(tie=True, seed=5)

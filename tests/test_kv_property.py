@@ -1,0 +1,172 @@
+"""Property-based testing of the C++ KvEmbedding store.
+
+A hypothesis state machine drives random op sequences (lookup-insert,
+scatter_add with duplicate keys, sgd updates, deletes, full export)
+against a plain-dict Python model and checks the table agrees after
+every step. This is the robustness net for the native code path the
+unit tests can't enumerate — r4 alone found three latent bugs in
+hand-written cases (NR kernel edge, dedup-table generation wrap,
+Mosaic tiling), all of the shape "a state/op combination nobody wrote
+down".
+
+Float tolerance: the C++ batched update pre-accumulates duplicate
+keys before one vectorized apply while the model sums per-occurrence —
+same math, different association order — so comparisons are allclose
+at f32 resolution, not byte equality.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import settings
+from hypothesis import strategies as st
+from hypothesis.stateful import (
+    RuleBasedStateMachine,
+    initialize,
+    invariant,
+    rule,
+)
+
+from dlrover_tpu.embedding.kv_store import KvEmbeddingTable
+
+DIM = 4
+KEYS = st.integers(min_value=0, max_value=40)  # small space → collisions
+BATCH = st.lists(KEYS, min_size=1, max_size=8)
+
+
+class KvTableMachine(RuleBasedStateMachine):
+    @initialize()
+    def setup(self):
+        self.table = KvEmbeddingTable(DIM, initializer="zeros")
+        self.model = {}  # key -> np.ndarray [DIM]
+
+    def teardown(self):
+        # free the C++ table between examples
+        self.table = None
+
+    # ---- ops -------------------------------------------------------------
+
+    @rule(keys=BATCH)
+    def lookup_insert(self, keys):
+        out = self.table.lookup(np.asarray(keys, np.int64))
+        for i, k in enumerate(keys):
+            if k not in self.model:
+                self.model[k] = np.zeros(DIM, np.float32)
+            np.testing.assert_allclose(
+                out[i], self.model[k], rtol=1e-5, atol=1e-6
+            )
+
+    @rule(keys=BATCH)
+    def lookup_no_insert(self, keys):
+        out = self.table.lookup(
+            np.asarray(keys, np.int64), insert_missing=False
+        )
+        for i, k in enumerate(keys):
+            expect = self.model.get(k, np.zeros(DIM, np.float32))
+            np.testing.assert_allclose(
+                out[i], expect, rtol=1e-5, atol=1e-6
+            )
+
+    @rule(keys=BATCH, data=st.data())
+    def scatter_add(self, keys, data):
+        vals = np.asarray(
+            data.draw(
+                st.lists(
+                    st.lists(
+                        st.floats(-4.0, 4.0, width=32),
+                        min_size=DIM,
+                        max_size=DIM,
+                    ),
+                    min_size=len(keys),
+                    max_size=len(keys),
+                )
+            ),
+            np.float32,
+        )
+        self.table.scatter_add(np.asarray(keys, np.int64), vals, alpha=0.5)
+        for k, v in zip(keys, vals):
+            row = self.model.setdefault(k, np.zeros(DIM, np.float32))
+            self.model[k] = row + 0.5 * v
+
+    @rule(keys=BATCH, data=st.data())
+    def sgd(self, keys, data):
+        grads = np.asarray(
+            data.draw(
+                st.lists(
+                    st.lists(
+                        st.floats(-2.0, 2.0, width=32),
+                        min_size=DIM,
+                        max_size=DIM,
+                    ),
+                    min_size=len(keys),
+                    max_size=len(keys),
+                )
+            ),
+            np.float32,
+        )
+        self.table.apply_sgd(np.asarray(keys, np.int64), grads, lr=0.1)
+        for k, g in zip(keys, grads):
+            row = self.model.setdefault(k, np.zeros(DIM, np.float32))
+            self.model[k] = row - 0.1 * g
+
+    @rule(keys=BATCH)
+    def delete(self, keys):
+        uniq = sorted(set(keys))
+        removed = self.table.delete(np.asarray(uniq, np.int64))
+        expect_removed = sum(1 for k in uniq if k in self.model)
+        assert removed == expect_removed, (removed, expect_removed)
+        for k in uniq:
+            self.model.pop(k, None)
+
+    # ---- invariants ------------------------------------------------------
+
+    @invariant()
+    def sizes_agree(self):
+        if getattr(self, "table", None) is None:
+            return
+        assert len(self.table) == len(self.model)
+
+    @invariant()
+    def full_export_matches_model(self):
+        if getattr(self, "table", None) is None:
+            return
+        keys, vals, _freq, _mult = self.table.export_full()
+        got = {
+            int(k): np.asarray(v, np.float32)
+            for k, v in zip(keys, vals)
+        }
+        assert set(got) == set(self.model)
+        for k, row in self.model.items():
+            np.testing.assert_allclose(
+                got[k][:DIM], row, rtol=1e-5, atol=1e-6
+            )
+
+
+KvTableMachine.TestCase.settings = settings(
+    max_examples=25, stateful_step_count=30, deadline=None
+)
+TestKvTableProperties = KvTableMachine.TestCase
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_dup_heavy_adam_against_presummed_model(seed):
+    """Directed fuzz of the batched adam dedup across many steps:
+    dup-heavy batches must track a model applying pre-summed unique
+    gradients (the invariant the C++ dedup accumulator maintains)."""
+    rng = np.random.default_rng(seed)
+    t_dup = KvEmbeddingTable(DIM, initializer="zeros")
+    t_ref = KvEmbeddingTable(DIM, initializer="zeros")
+    for step in range(1, 8):
+        ids = rng.integers(0, 6, size=32).astype(np.int64)  # heavy dups
+        grads = rng.normal(size=(32, DIM)).astype(np.float32)
+        t_dup.apply_adam(ids, grads, lr=0.01, step=step)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        summed = np.zeros((uniq.size, DIM), np.float32)
+        np.add.at(summed, inv, grads)
+        t_ref.apply_adam(uniq, summed, lr=0.01, step=step)
+    uniq_all = np.arange(6, dtype=np.int64)
+    np.testing.assert_allclose(
+        t_dup.lookup(uniq_all, insert_missing=False),
+        t_ref.lookup(uniq_all, insert_missing=False),
+        rtol=2e-5,
+        atol=1e-6,
+    )
