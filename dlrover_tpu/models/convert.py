@@ -74,6 +74,45 @@ def _to_numpy(t) -> np.ndarray:
     return np.asarray(t, dtype=np.float32)
 
 
+def _sd_tools(state_dict, prefix: str, model_name: str, pd, n_layers):
+    """(get, stack, stack_t) over a prefix-stripped state dict — the
+    shared machinery of both family converters. Each layer converts to
+    param_dtype individually so the f32 intermediate never exceeds one
+    layer."""
+    import jax.numpy as jnp
+
+    sd = {
+        k.removeprefix(prefix): v for k, v in state_dict.items()
+    }
+
+    def get(key: str) -> np.ndarray:
+        if key not in sd:
+            raise KeyError(
+                f"HF checkpoint is missing {key!r} — is this a "
+                f"{model_name} state dict?"
+            )
+        return _to_numpy(sd[key])
+
+    def _as_param(a: np.ndarray):
+        return jnp.asarray(a, pd)
+
+    def stack(fmt: str):
+        return jnp.stack(
+            [_as_param(get(fmt.format(i=i))) for i in range(n_layers)]
+        )
+
+    def stack_t(fmt: str):
+        # per-layer [out, in] weights → stacked [L, in, out]
+        return jnp.stack(
+            [
+                _as_param(get(fmt.format(i=i)).T)
+                for i in range(n_layers)
+            ]
+        )
+
+    return sd, get, stack, stack_t
+
+
 def params_from_hf_state_dict(
     state_dict: Dict[str, Any], cfg: LlamaConfig
 ) -> Dict:
@@ -84,41 +123,10 @@ def params_from_hf_state_dict(
     missing HF key if the dict is incomplete."""
     import jax.numpy as jnp
 
-    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
-
-    def get(key: str) -> np.ndarray:
-        if key not in sd:
-            raise KeyError(
-                f"HF checkpoint is missing {key!r} — is this a "
-                "LlamaForCausalLM state dict?"
-            )
-        return _to_numpy(sd[key])
-
     pd = cfg.param_dtype
-
-    def _as_param(a: np.ndarray):
-        import jax.numpy as _jnp
-
-        return _jnp.asarray(a, pd)
-
-    def stack_t(fmt: str):
-        """Per-layer [out, in] weights → stacked [L, in, out], each
-        layer converted to param_dtype individually so the f32
-        intermediate never exceeds one layer."""
-        return jnp.stack(
-            [
-                _as_param(get(fmt.format(i=i)).T)
-                for i in range(cfg.n_layers)
-            ]
-        )
-
-    def stack(fmt: str):
-        return jnp.stack(
-            [
-                _as_param(get(fmt.format(i=i)))
-                for i in range(cfg.n_layers)
-            ]
-        )
+    sd, get, stack, stack_t = _sd_tools(
+        state_dict, "model.", "LlamaForCausalLM", pd, cfg.n_layers
+    )
     layers = {
         ours: (stack_t if transpose else stack)(
             "layers.{i}." + hf_name
@@ -200,3 +208,96 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Dict) -> Dict[str, Any]:
             params["lm_head"]["weight"]
         ).T
     return sd
+
+
+# ---------------------------------------------------------------------------
+# GPT-2 family
+# ---------------------------------------------------------------------------
+
+def gpt_config_from_hf(hf_config, **overrides):
+    """GptConfig from a transformers GPT2Config. HF's Conv1D layers
+    store weights [in, out] — OUR orientation — so the GPT-2 mapping
+    has no transposes at all.
+
+    Raises on GPT2-architecture checkpoints this model can't express:
+    a silent import with a different activation or MLP width would
+    produce wrong logits with no error."""
+    from dlrover_tpu.models.gpt import GptConfig
+
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise ValueError(
+            f"unsupported activation_function {act!r}: gpt.py "
+            "hardcodes tanh-approx gelu (== HF gelu_new)"
+        )
+    n_inner = getattr(hf_config, "n_inner", None)
+    if n_inner is not None and n_inner != 4 * hf_config.n_embd:
+        raise ValueError(
+            f"unsupported n_inner {n_inner}: GptConfig.mlp_dim is "
+            f"fixed at 4*dim ({4 * hf_config.n_embd})"
+        )
+    fields = dict(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.n_embd,
+        n_layers=hf_config.n_layer,
+        n_heads=hf_config.n_head,
+        max_seq_len=hf_config.n_positions,
+        norm_eps=hf_config.layer_norm_epsilon,
+    )
+    fields.update(overrides)
+    return GptConfig(**fields)
+
+
+# hf per-layer suffix -> our layers key (GPT-2 Conv1D: no transposes)
+_GPT_PER_LAYER = {
+    "ln_1.weight": "ln1_g",
+    "ln_1.bias": "ln1_b",
+    "attn.c_attn.weight": "wqkv",
+    "attn.c_attn.bias": "b_qkv",
+    "attn.c_proj.weight": "wo",
+    "attn.c_proj.bias": "b_o",
+    "ln_2.weight": "ln2_g",
+    "ln_2.bias": "ln2_b",
+    "mlp.c_fc.weight": "w_up",
+    "mlp.c_fc.bias": "b_up",
+    "mlp.c_proj.weight": "w_down",
+    "mlp.c_proj.bias": "b_down",
+}
+
+
+def gpt_params_from_hf_state_dict(state_dict: Dict[str, Any], cfg):
+    """HF GPT2LMHeadModel state dict → our GPT param pytree. The LM
+    head is tied to wte on both sides, so only the transformer weights
+    map."""
+    import jax.numpy as jnp
+
+    pd = cfg.param_dtype
+    _, get, stack, _ = _sd_tools(
+        state_dict, "transformer.", "GPT2LMHeadModel", pd,
+        cfg.n_layers,
+    )
+
+    return {
+        "wte": jnp.asarray(get("wte.weight"), pd),
+        "wpe": jnp.asarray(get("wpe.weight"), pd),
+        "layers": {
+            ours: stack("h.{i}." + hf_name)
+            for hf_name, ours in _GPT_PER_LAYER.items()
+        },
+        "lnf_g": jnp.asarray(get("ln_f.weight"), pd),
+        "lnf_b": jnp.asarray(get("ln_f.bias"), pd),
+    }
+
+
+def gpt_from_hf(model_or_path, **cfg_overrides):
+    """One-call GPT-2 import: transformers model or local path →
+    (GptConfig, params)."""
+    if isinstance(model_or_path, str):
+        from transformers import GPT2LMHeadModel
+
+        model_or_path = GPT2LMHeadModel.from_pretrained(model_or_path)
+    cfg = gpt_config_from_hf(model_or_path.config, **cfg_overrides)
+    params = gpt_params_from_hf_state_dict(
+        model_or_path.state_dict(), cfg
+    )
+    return cfg, params

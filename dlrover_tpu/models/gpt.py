@@ -95,7 +95,9 @@ def init_params(cfg: GptConfig, key: jax.Array) -> Params:
             "ln1_g": jnp.ones((L, D), pd),
             "ln1_b": jnp.zeros((L, D), pd),
             "wqkv": dense(ks[2], (L, D, 3 * D), D),
+            "b_qkv": jnp.zeros((L, 3 * D), pd),
             "wo": dense(ks[3], (L, D, D), D, scale=res),
+            "b_o": jnp.zeros((L, D), pd),
             "ln2_g": jnp.ones((L, D), pd),
             "ln2_b": jnp.zeros((L, D), pd),
             "w_up": dense(ks[4], (L, D, M), D),
@@ -116,7 +118,9 @@ def partition_rules(cfg: GptConfig):
         (r"wte$", P("tensor", None)),
         (r"wpe$", P(None, None)),
         (r"layers/wqkv$", P(None, None, "tensor")),
+        (r"layers/b_qkv$", P(None, "tensor")),
         (r"layers/wo$", P(None, "tensor", None)),
+        (r"layers/b_o$", P(None, None)),
         (r"layers/w_up$", P(None, None, "tensor")),
         (r"layers/b_up$", P(None, "tensor")),
         (r"layers/w_down$", P(None, "tensor", None)),
@@ -134,7 +138,9 @@ def _attn_qkv(cfg: GptConfig, x, lp):
     B, S, _ = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
     h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
-    qkv = h @ lp["wqkv"].astype(cfg.dtype)
+    qkv = h @ lp["wqkv"].astype(cfg.dtype) + lp["b_qkv"].astype(
+        cfg.dtype
+    )
     q, k, v = jnp.split(qkv, 3, axis=-1)
     return (
         q.reshape(B, S, H, hd),
@@ -145,7 +151,10 @@ def _attn_qkv(cfg: GptConfig, x, lp):
 
 def _attn_residual(cfg: GptConfig, x, attn, lp):
     B, S, _ = x.shape
-    return x + attn.reshape(B, S, cfg.dim) @ lp["wo"].astype(cfg.dtype)
+    return x + (
+        attn.reshape(B, S, cfg.dim) @ lp["wo"].astype(cfg.dtype)
+        + lp["b_o"].astype(cfg.dtype)
+    )
 
 
 def _mlp_residual(cfg: GptConfig, x, lp):
@@ -227,9 +236,11 @@ def loss_fn(
 
 def num_params(cfg: GptConfig) -> int:
     D, L, M = cfg.dim, cfg.n_layers, cfg.mlp_dim
-    per_layer = 2 * D + (D * 3 * D) + D * D + 2 * D + D * M + M + (
-        M * D
-    ) + D
+    # ln1(g+b) + wqkv + b_qkv + wo + b_o + ln2(g+b) + w_up + b_up
+    # + w_down + b_down
+    per_layer = 2 * D + (D * 3 * D) + 3 * D + D * D + D + 2 * D + (
+        D * M
+    ) + M + (M * D) + D
     return (
         cfg.vocab_size * D
         + cfg.max_seq_len * D

@@ -198,3 +198,24 @@ class TestIciMonitor:
         mon = IciMonitor(mesh)
         mon._history["data"] = [10.0, 10.0, 10.0, 2.0]
         assert mon.degraded_axes() == ["data"]
+
+
+def test_num_params_exact():
+    # exact-count contract (the llama counterpart has the same test):
+    # init_params' leaf sizes must sum to num_params, incl. the r4
+    # attention biases
+    import jax
+
+    from dlrover_tpu.models import gpt
+
+    cfg = gpt.GptConfig(
+        vocab_size=96, dim=48, n_layers=2, n_heads=4, max_seq_len=32
+    )
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    actual = sum(
+        x.size for x in jax.tree_util.tree_leaves(params)
+    )
+    assert actual == gpt.num_params(cfg), (
+        actual,
+        gpt.num_params(cfg),
+    )

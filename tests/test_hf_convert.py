@@ -122,3 +122,47 @@ class TestHfExport:
 
     def test_tied_embeddings_roundtrip(self):
         self._assert_export_roundtrip(tie=True, seed=5)
+
+
+class TestGpt2Import:
+    def test_gpt2_logits_match(self):
+        from dlrover_tpu.models import gpt
+        from dlrover_tpu.models.convert import gpt_from_hf
+
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=96,
+            n_positions=32,
+            n_embd=48,
+            n_layer=2,
+            n_head=4,
+            attn_pdrop=0.0,
+            embd_pdrop=0.0,
+            resid_pdrop=0.0,
+        )
+        torch.manual_seed(11)
+        hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+        cfg, params = gpt_from_hf(
+            hf, dtype=jnp.float32, param_dtype=jnp.float32,
+            remat=False,
+        )
+        tokens = np.array([[3, 17, 42, 9, 77], [1, 2, 3, 4, 5]], np.int32)
+        with torch.no_grad():
+            hf_logits = hf(
+                torch.tensor(tokens, dtype=torch.long)
+            ).logits.numpy()
+        ours = np.asarray(
+            gpt.apply(cfg, params, jnp.asarray(tokens)), np.float32
+        )
+        np.testing.assert_allclose(
+            ours, hf_logits, atol=2e-4, rtol=2e-3
+        )
+
+    def test_unsupported_activation_rejected(self):
+        from dlrover_tpu.models.convert import gpt_config_from_hf
+
+        hf_cfg = transformers.GPT2Config(
+            n_embd=48, n_layer=2, n_head=4,
+            activation_function="relu",
+        )
+        with pytest.raises(ValueError, match="activation_function"):
+            gpt_config_from_hf(hf_cfg)
