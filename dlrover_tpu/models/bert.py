@@ -82,7 +82,9 @@ def init_params(cfg: BertConfig, key: jax.Array) -> Params:
         "emb_ln_b": jnp.zeros((D,), pd),
         "layers": {
             "wqkv": dense(ks[3], (L, D, 3 * D), D),
+            "b_qkv": jnp.zeros((L, 3 * D), pd),
             "wo": dense(ks[4], (L, D, D), D),
+            "b_o": jnp.zeros((L, D), pd),
             "ln1_g": jnp.ones((L, D), pd),
             "ln1_b": jnp.zeros((L, D), pd),
             "w_up": dense(ks[5], (L, D, M), D),
@@ -94,6 +96,7 @@ def init_params(cfg: BertConfig, key: jax.Array) -> Params:
         },
         # MLM head: transform + LN; decoder tied to tok_emb
         "mlm_dense": dense(ks[7], (D, D), D),
+        "mlm_dense_b": jnp.zeros((D,), pd),
         "mlm_ln_g": jnp.ones((D,), pd),
         "mlm_ln_b": jnp.zeros((D,), pd),
         "mlm_bias": jnp.zeros((cfg.vocab_size,), pd),
@@ -110,6 +113,8 @@ def partition_rules(cfg: BertConfig):
         (r"tok_emb$", P("tensor", None)),
         (r"(pos|seg)_emb$", P(None, None)),
         (r"layers/wqkv$", P(None, None, "tensor")),
+        (r"layers/b_qkv$", P(None, "tensor")),
+        (r"layers/b_o$", P(None, None)),
         (r"layers/wo$", P(None, "tensor", None)),
         (r"layers/w_up$", P(None, None, "tensor")),
         (r"layers/b_up$", P(None, "tensor")),
@@ -118,6 +123,7 @@ def partition_rules(cfg: BertConfig):
         (r"layers/b_down$", P(None, None)),
         (r"(emb|mlm)_ln_", P(None)),
         (r"mlm_dense$", P(None, None)),
+        (r"mlm_dense_b$", P(None)),
         (r"mlm_bias$", P("tensor")),
         (r"pool_w$", P(None, None)),
         (r"pool_b$", P(None)),
@@ -134,7 +140,7 @@ def _block(cfg: BertConfig, mesh, x, lp, pad_mask):
     H, hd = cfg.n_heads, cfg.head_dim
     b, s, d = x.shape
     cd = cfg.dtype
-    qkv = x @ lp["wqkv"].astype(cd)
+    qkv = x @ lp["wqkv"].astype(cd) + lp["b_qkv"].astype(cd)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, s, H, hd)
     k = k.reshape(b, s, H, hd)
@@ -146,11 +152,14 @@ def _block(cfg: BertConfig, mesh, x, lp, pad_mask):
     )
     attn = attn.reshape(b, s, H * hd)
     x = _layer_norm(
-        x + attn @ lp["wo"].astype(cd),
+        x + (attn @ lp["wo"].astype(cd) + lp["b_o"].astype(cd)),
         lp["ln1_g"], lp["ln1_b"], cfg.norm_eps,
     )
+    # exact (erf) gelu — BERT's convention (HF hidden_act="gelu"),
+    # unlike GPT-2's tanh approximation
     h = jax.nn.gelu(
-        x @ lp["w_up"].astype(cd) + lp["b_up"].astype(cd)
+        x @ lp["w_up"].astype(cd) + lp["b_up"].astype(cd),
+        approximate=False,
     )
     h = constrain(h, mesh, ("data", "fsdp"), None, "tensor")
     x = _layer_norm(
@@ -174,6 +183,12 @@ def apply(
     x = x + params["pos_emb"].astype(cfg.dtype)[None, :s]
     if segments is not None:
         x = x + params["seg_emb"].astype(cfg.dtype)[segments]
+    else:
+        # HF adds token_type_embeddings[0] when token_type_ids are
+        # omitted; a trained seg_emb[0] is nonzero, so skipping it
+        # would silently shift every hidden state of an imported
+        # checkpoint
+        x = x + params["seg_emb"].astype(cfg.dtype)[0]
     x = _layer_norm(
         x, params["emb_ln_g"], params["emb_ln_b"], cfg.norm_eps
     )
@@ -205,7 +220,11 @@ def mlm_logits(
     cfg: BertConfig, params: Params, hidden: jax.Array
 ) -> jax.Array:
     """Masked-LM head: transform + LN + tied decoder → [B, S, V] f32."""
-    h = jax.nn.gelu(hidden @ params["mlm_dense"].astype(cfg.dtype))
+    h = jax.nn.gelu(
+        hidden @ params["mlm_dense"].astype(cfg.dtype)
+        + params["mlm_dense_b"].astype(cfg.dtype),
+        approximate=False,
+    )
     h = _layer_norm(
         h, params["mlm_ln_g"], params["mlm_ln_b"], cfg.norm_eps
     )

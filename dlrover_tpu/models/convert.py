@@ -301,3 +301,165 @@ def gpt_from_hf(model_or_path, **cfg_overrides):
         model_or_path.state_dict(), cfg
     )
     return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# BERT family
+# ---------------------------------------------------------------------------
+
+def bert_config_from_hf(hf_config, **overrides):
+    """BertConfig from a transformers BertConfig. Rejects activations
+    our exact-gelu block can't express."""
+    from dlrover_tpu.models.bert import BertConfig
+
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act != "gelu":
+        raise ValueError(
+            f"unsupported hidden_act {act!r}: bert.py hardcodes "
+            "exact (erf) gelu (== HF 'gelu')"
+        )
+    pet = getattr(hf_config, "position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise ValueError(
+            f"unsupported position_embedding_type {pet!r}: bert.py "
+            "implements absolute learned positions only"
+        )
+    fields = dict(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        mlp_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        n_segments=hf_config.type_vocab_size,
+        norm_eps=hf_config.layer_norm_eps,
+    )
+    fields.update(overrides)
+    return BertConfig(**fields)
+
+
+def bert_params_from_hf_state_dict(state_dict: Dict[str, Any], cfg):
+    """HF BertForMaskedLM state dict → our BERT param pytree.
+
+    The separate HF q/k/v projections fuse into our wqkv columns
+    (transposed: HF Linear is [out, in]); the MLM decoder is tied to
+    the word embeddings on both sides. BertForMaskedLM carries no
+    pooler — pool_w/pool_b keep zero/identity-free init and only
+    matter for sequence-classification heads the checkpoint never
+    trained."""
+    import jax.numpy as jnp
+
+    pd = cfg.param_dtype
+    sd, get, stack, stack_t = _sd_tools(
+        state_dict, "bert.", "BertForMaskedLM", pd, cfg.n_layers
+    )
+
+    def fused_qkv():
+        # convert each layer to param_dtype as it is built, keeping
+        # the f32 intermediate at one layer (the _sd_tools contract)
+        per_layer = []
+        biases = []
+        for i in range(cfg.n_layers):
+            base = f"encoder.layer.{i}.attention.self"
+            per_layer.append(
+                jnp.asarray(
+                    np.concatenate(
+                        [
+                            get(f"{base}.query.weight").T,
+                            get(f"{base}.key.weight").T,
+                            get(f"{base}.value.weight").T,
+                        ],
+                        axis=1,
+                    ),
+                    pd,
+                )
+            )
+            biases.append(
+                jnp.asarray(
+                    np.concatenate(
+                        [
+                            get(f"{base}.query.bias"),
+                            get(f"{base}.key.bias"),
+                            get(f"{base}.value.bias"),
+                        ]
+                    ),
+                    pd,
+                )
+            )
+        return jnp.stack(per_layer), jnp.stack(biases)
+
+    wqkv, b_qkv = fused_qkv()
+    layers = {
+        "wqkv": wqkv,
+        "b_qkv": b_qkv,
+        "wo": stack_t(
+            "encoder.layer.{i}.attention.output.dense.weight"
+        ),
+        "b_o": stack("encoder.layer.{i}.attention.output.dense.bias"),
+        "ln1_g": stack(
+            "encoder.layer.{i}.attention.output.LayerNorm.weight"
+        ),
+        "ln1_b": stack(
+            "encoder.layer.{i}.attention.output.LayerNorm.bias"
+        ),
+        "w_up": stack_t("encoder.layer.{i}.intermediate.dense.weight"),
+        "b_up": stack("encoder.layer.{i}.intermediate.dense.bias"),
+        "w_down": stack_t("encoder.layer.{i}.output.dense.weight"),
+        "b_down": stack("encoder.layer.{i}.output.dense.bias"),
+        "ln2_g": stack("encoder.layer.{i}.output.LayerNorm.weight"),
+        "ln2_b": stack("encoder.layer.{i}.output.LayerNorm.bias"),
+    }
+    # the MLM head's cls.* keys carry no bert. prefix, so the
+    # prefix-stripped dict already serves them through get()
+    get_cls = get
+    D = cfg.dim
+    params = {
+        "tok_emb": jnp.asarray(
+            get("embeddings.word_embeddings.weight"), pd
+        ),
+        "pos_emb": jnp.asarray(
+            get("embeddings.position_embeddings.weight"), pd
+        ),
+        "seg_emb": jnp.asarray(
+            get("embeddings.token_type_embeddings.weight"), pd
+        ),
+        "emb_ln_g": jnp.asarray(get("embeddings.LayerNorm.weight"), pd),
+        "emb_ln_b": jnp.asarray(get("embeddings.LayerNorm.bias"), pd),
+        "layers": layers,
+        "mlm_dense": jnp.asarray(
+            get_cls("cls.predictions.transform.dense.weight").T, pd
+        ),
+        "mlm_dense_b": jnp.asarray(
+            get_cls("cls.predictions.transform.dense.bias"), pd
+        ),
+        "mlm_ln_g": jnp.asarray(
+            get_cls("cls.predictions.transform.LayerNorm.weight"), pd
+        ),
+        "mlm_ln_b": jnp.asarray(
+            get_cls("cls.predictions.transform.LayerNorm.bias"), pd
+        ),
+        "mlm_bias": jnp.asarray(get_cls("cls.predictions.bias"), pd),
+        # no pooler in BertForMaskedLM; zeros = untrained head
+        "pool_w": jnp.zeros((D, D), pd),
+        "pool_b": jnp.zeros((D,), pd),
+    }
+    if "pooler.dense.weight" in sd:
+        params["pool_w"] = jnp.asarray(
+            get("pooler.dense.weight").T, pd
+        )
+        params["pool_b"] = jnp.asarray(get("pooler.dense.bias"), pd)
+    return params
+
+
+def bert_from_hf(model_or_path, **cfg_overrides):
+    """One-call BERT import: transformers model or local path →
+    (BertConfig, params)."""
+    if isinstance(model_or_path, str):
+        from transformers import BertForMaskedLM
+
+        model_or_path = BertForMaskedLM.from_pretrained(model_or_path)
+    cfg = bert_config_from_hf(model_or_path.config, **cfg_overrides)
+    params = bert_params_from_hf_state_dict(
+        model_or_path.state_dict(), cfg
+    )
+    return cfg, params

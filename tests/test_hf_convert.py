@@ -166,3 +166,62 @@ class TestGpt2Import:
         )
         with pytest.raises(ValueError, match="activation_function"):
             gpt_config_from_hf(hf_cfg)
+
+
+class TestBertImport:
+    def test_bert_mlm_logits_match(self):
+        from dlrover_tpu.models import bert
+        from dlrover_tpu.models.convert import bert_from_hf
+
+        hf_cfg = transformers.BertConfig(
+            vocab_size=96,
+            hidden_size=48,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            intermediate_size=64,
+            max_position_embeddings=32,
+            type_vocab_size=2,
+            hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0,
+        )
+        torch.manual_seed(13)
+        hf = transformers.BertForMaskedLM(hf_cfg).eval()
+        cfg, params = bert_from_hf(
+            hf, dtype=jnp.float32, param_dtype=jnp.float32,
+            attn_impl="reference",
+        )
+        tokens = np.array(
+            [[3, 17, 42, 9, 77], [1, 2, 3, 4, 5]], np.int32
+        )
+        segs = np.zeros_like(tokens)
+        with torch.no_grad():
+            hf_logits = hf(
+                torch.tensor(tokens, dtype=torch.long),
+                token_type_ids=torch.tensor(segs, dtype=torch.long),
+            ).logits.numpy()
+        hidden = bert.apply(
+            cfg, params, jnp.asarray(tokens),
+            segments=jnp.asarray(segs),
+        )
+        ours = np.asarray(
+            bert.mlm_logits(cfg, params, hidden), np.float32
+        )
+        np.testing.assert_allclose(
+            ours, hf_logits, atol=3e-4, rtol=2e-3
+        )
+        # segments omitted must ALSO match (HF defaults
+        # token_type_ids to zeros; apply() adds seg_emb[0])
+        hidden2 = bert.apply(cfg, params, jnp.asarray(tokens))
+        ours2 = np.asarray(
+            bert.mlm_logits(cfg, params, hidden2), np.float32
+        )
+        np.testing.assert_allclose(
+            ours2, hf_logits, atol=3e-4, rtol=2e-3
+        )
+
+    def test_unsupported_activation_rejected(self):
+        from dlrover_tpu.models.convert import bert_config_from_hf
+
+        hf_cfg = transformers.BertConfig(hidden_act="relu")
+        with pytest.raises(ValueError, match="hidden_act"):
+            bert_config_from_hf(hf_cfg)
