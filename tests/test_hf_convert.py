@@ -225,3 +225,34 @@ class TestBertImport:
         hf_cfg = transformers.BertConfig(hidden_act="relu")
         with pytest.raises(ValueError, match="hidden_act"):
             bert_config_from_hf(hf_cfg)
+
+
+class TestConvertCli:
+    def test_cli_writes_loadable_flash_checkpoint(self, tmp_path):
+        """The migration entrypoint: HF dir → our flash checkpoint,
+        loadable by the Checkpointer at step 0."""
+        from dlrover_tpu.models import convert
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            Checkpointer,
+        )
+
+        hf_dir = tmp_path / "hf"
+        hf_cfg = transformers.GPT2Config(
+            vocab_size=96, n_positions=32, n_embd=48,
+            n_layer=2, n_head=4,
+        )
+        transformers.GPT2LMHeadModel(hf_cfg).save_pretrained(
+            str(hf_dir)
+        )
+        out = tmp_path / "ckpt"
+        rc = convert.main(
+            [str(hf_dir), "--out", str(out), "--family", "gpt2"]
+        )
+        assert rc == 0
+        ck = Checkpointer(str(out), job_name="test_cli_load")
+        try:
+            step, state = ck.load_checkpoint()
+        finally:
+            ck.close()
+        assert step == 0
+        assert "layers" in state and "wte" in state
