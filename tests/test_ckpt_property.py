@@ -1,0 +1,104 @@
+"""Property-style fuzz of the flash-checkpoint engine lifecycle.
+
+A random op sequence (memory save / disk save / load / fresh-engine
+respawn) against a model that tracks the latest staged and persisted
+steps. The E2Es exercise these paths macroscopically; this hammers
+the ORDER — the class of staleness bug r3/r4 actually hit (stale shm
+mapping after resize, tracker races) lives in op interleavings nobody
+writes down by hand.
+
+Deterministic seeds (no hypothesis here: each engine op costs real
+shm/IPC work, so a bounded random walk gives better coverage per
+second than minimized examples)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def _state(step: int):
+    """Pytree whose LEAF SHAPES grow with step: the walk must exercise
+    the shm segment-recreate/resize path (the r3/r4 staleness bug
+    class), which fixed-size states never would."""
+    rng = np.random.default_rng(step)
+    rows = 64 + 8 * step
+    return {
+        "w": rng.normal(size=(rows, 32)).astype(np.float32),
+        "opt": {
+            "m": np.full((rows, 32), float(step), np.float32),
+            "count": np.asarray(step, np.int32),
+        },
+    }
+
+
+def _assert_state(got, step):
+    expect = _state(step)
+    np.testing.assert_array_equal(
+        np.asarray(got["opt"]["count"]), expect["opt"]["count"]
+    )
+    assert np.asarray(got["w"]).shape == expect["w"].shape
+    np.testing.assert_allclose(got["w"], expect["w"], rtol=1e-6)
+    np.testing.assert_allclose(
+        got["opt"]["m"], expect["opt"]["m"], rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_random_save_load_respawn_walk(seed, tmp_path):
+    from dlrover_tpu.trainer.flash_checkpoint.engine import (
+        CheckpointEngine,
+    )
+
+    rng = np.random.default_rng(seed)
+    # unique job name: a fixed name would attach to a CONCURRENT
+    # run's IPC server/shm segment (flaky cross-talk) and leak
+    # /dev/shm segments across runs
+    job = f"ckpt_prop_{seed}_{time.time_ns()}"
+    eng = CheckpointEngine(str(tmp_path), job_name=job)
+    owner = eng  # first engine owns the IPC server
+    step = 0
+    last_saved = None  # step of the newest save (memory or disk)
+    try:
+        for _ in range(12):
+            op = rng.choice(["mem", "disk", "load", "respawn"])
+            if op == "mem":
+                step += 1
+                eng.save_to_memory(step, _state(step))
+                eng.wait_for_staging()
+                last_saved = step
+            elif op == "disk":
+                step += 1
+                eng.save_to_storage(step, _state(step))
+                assert eng.wait_for_persist(step, timeout=60.0)
+                last_saved = step
+            elif op == "load":
+                got_step, got = eng.load(target=_state(0))
+                if last_saved is None:
+                    assert got is None
+                else:
+                    assert got_step == last_saved, (
+                        got_step,
+                        last_saved,
+                    )
+                    _assert_state(got, last_saved)
+            elif op == "respawn":
+                # a respawned trainer gets a FRESH engine: new shm
+                # mapping, new meta read — the path the r4 stale-
+                # mapping fix hardened
+                if eng is not owner:
+                    eng.close()
+                eng = CheckpointEngine(str(tmp_path), job_name=job)
+                got_step, got = eng.load(target=_state(0))
+                if last_saved is None:
+                    assert got is None
+                else:
+                    assert got_step == last_saved, (
+                        got_step,
+                        last_saved,
+                    )
+                    _assert_state(got, last_saved)
+    finally:
+        if eng is not owner:
+            eng.close()
+        owner.close()
