@@ -63,8 +63,11 @@ def test_random_save_load_respawn_walk(seed, tmp_path):
         for _ in range(12):
             op = rng.choice(["mem", "disk", "load", "respawn"])
             if op == "mem":
+                # the ASYNC path: staging rides a background thread,
+                # so load/respawn ops that follow genuinely race it —
+                # the interleaving class this fuzz exists for
                 step += 1
-                eng.save_to_memory(step, _state(step))
+                eng.save_to_memory_async(step, _state(step))
                 eng.wait_for_staging()
                 last_saved = step
             elif op == "disk":
@@ -101,4 +104,10 @@ def test_random_save_load_respawn_walk(seed, tmp_path):
     finally:
         if eng is not owner:
             eng.close()
+        # unlink the uniquely-named shm segment — close() alone would
+        # abandon one /dev/shm file per run forever
+        try:
+            owner.shm_handler.close(unlink=True)
+        except Exception:  # noqa: BLE001
+            pass
         owner.close()
