@@ -265,24 +265,108 @@ class _Watchdog:
             idle = time.monotonic() - self._last
             if idle > self.timeout_s:
                 print(
-                    json.dumps(
-                        {
-                            "metric": "tokens_per_sec_per_chip",
-                            "value": 0.0,
-                            "unit": "tok/s/chip",
-                            "vs_baseline": 0.0,
-                            "detail": {
-                                "error": (
-                                    f"no progress for {idle:.0f}s "
-                                    f"during '{self._phase}' — "
-                                    "backend/tunnel unreachable"
-                                )
-                            },
-                        }
+                    _fail_json(
+                        f"no progress for {idle:.0f}s during "
+                        f"'{self._phase}' — backend/tunnel "
+                        "unreachable"
                     ),
                     flush=True,
                 )
                 os._exit(3)
+
+
+def _fail_json(error_msg: str) -> str:
+    """The zero-metric failure line, in the driver's parsed schema —
+    one copy, shared by the watchdog and the probe-retry path."""
+    return json.dumps(
+        {
+            "metric": "tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tok/s/chip",
+            "vs_baseline": 0.0,
+            "detail": {"error": error_msg},
+        }
+    )
+
+
+def _wait_for_backend(watchdog) -> float:
+    """Bounded probe-retry before dialing the backend for real.
+
+    BENCH_r03 (rc=1) and BENCH_r04 (rc=3) were both "tunnel dead at the
+    driver's capture moment" — the axon tunnel drops for hours and the
+    bench used to get exactly one dial. Instead: probe with a subprocess
+    matmul (a hung in-process dial can't be cancelled; a subprocess
+    can), retrying inside a budget (BENCH_TUNNEL_WAIT, default 1500 s)
+    so a flap shorter than ~25 min never costs the round its number.
+
+    Returns seconds spent waiting; raises SystemExit(3) with a
+    diagnosed JSON line if the budget runs out with no answer.
+    """
+    if os.environ.get("DLROVER_TPU_FORCE_CPU") == "1":
+        return 0.0  # CPU smoke mode: nothing to dial (platform.py:16
+        # treats exactly "1" as forced; mirror it so e.g. "0" probes)
+    import subprocess
+
+    # Is an accelerator even expected? The axon plugin advertises the
+    # tunnel via PALLAS_AXON_POOL_IPS; on a plain CPU box a cpu-backed
+    # probe is the correct answer, not a fallback to retry against.
+    tpu_expected = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    budget_s = float(os.environ.get("BENCH_TUNNEL_WAIT", "1500"))
+    probe_timeout = 90.0
+    retry_sleep = 45.0
+    code = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((1024, 1024), jnp.bfloat16);"
+        "(x @ x).block_until_ready();"
+        "print('BENCH_PROBE_OK', jax.default_backend())"
+    )
+    t_start = time.monotonic()
+    deadline = t_start + budget_s
+    attempt = 0
+    last_err = "probe never completed"
+    while True:
+        attempt += 1
+        watchdog.beat(f"backend probe attempt {attempt}")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=probe_timeout,
+            )
+            out = r.stdout or ""
+            if "BENCH_PROBE_OK" in out:
+                backend = out.split("BENCH_PROBE_OK", 1)[1].strip()
+                if backend != "cpu" or not tpu_expected:
+                    return time.monotonic() - t_start
+                # jax fell back to CPU while a TPU is advertised: a
+                # fast-fail flavor of the same dead tunnel — keep
+                # retrying the budget instead of silently benching CPU
+                last_err = (
+                    "accelerator advertised but probe answered "
+                    "backend=cpu (libtpu init fell back)"
+                )
+            else:
+                tail = ((r.stderr or "").strip())[-300:]
+                last_err = f"probe rc={r.returncode}: {tail}"
+        except subprocess.TimeoutExpired:
+            last_err = f"probe hung >{probe_timeout:.0f}s (killed)"
+        if time.monotonic() + retry_sleep + probe_timeout > deadline:
+            waited = time.monotonic() - t_start
+            print(
+                _fail_json(
+                    f"backend/tunnel unreachable after {attempt} "
+                    f"probes over {waited:.0f}s; last: {last_err}"
+                ),
+                flush=True,
+            )
+            raise SystemExit(3)
+        stop = time.monotonic() + retry_sleep
+        while time.monotonic() < stop:
+            watchdog.beat(
+                f"backend probe retry wait (attempt {attempt})"
+            )
+            time.sleep(5)
 
 
 def main():
@@ -293,6 +377,8 @@ def main():
     watchdog = _Watchdog(
         float(os.environ.get("BENCH_PROBE_TIMEOUT", "600"))
     )
+    waited_s = _wait_for_backend(watchdog)
+    watchdog.beat("backend init + first compile")
 
     import jax
     import jax.numpy as jnp
@@ -367,12 +453,22 @@ def main():
     tok_per_sec = tokens_per_step * iters / elapsed
     tok_per_sec_per_chip = tok_per_sec / n_dev
 
-    flops_per_tok = llama.flops_per_token(cfg, seq_len)
-    achieved_tflops = tok_per_sec_per_chip * flops_per_tok / 1e12
+    # headline = causal-accounted FLOPs (what the causal flash kernel
+    # actually computes); PaLM-style full-attention accounting reported
+    # alongside in detail (r4 VERDICT weak #5: the headline must ride
+    # the conservative convention, not the ~9%-flattering one)
+    flops_causal = llama.flops_per_token(cfg, seq_len, causal=True)
+    flops_palm = llama.flops_per_token(cfg, seq_len, causal=False)
     gen = detect_tpu_gen()
     peak = PEAK_TFLOPS.get(gen, PEAK_TFLOPS["v5e"])
-    mfu = achieved_tflops / peak if on_tpu else 0.0
-    suspect = on_tpu and mfu > 1.0  # >100% of peak = broken timing
+    tflops_causal = tok_per_sec_per_chip * flops_causal / 1e12
+    mfu = tflops_causal / peak if on_tpu else 0.0
+    mfu_palm = (
+        tok_per_sec_per_chip * flops_palm / 1e12 / peak
+        if on_tpu
+        else 0.0
+    )
+    suspect = on_tpu and mfu_palm > 1.0  # >100% of peak = broken timing
 
     # ---- checkpoint axes (reference: flash_checkpoint.md 362-408) ----
     # save-blocking ms of the async shm staging, restore stall from shm,
@@ -395,12 +491,15 @@ def main():
                         llama.num_params(cfg) / 1e6, 1
                     ),
                     "mfu": round(mfu, 4),
+                    "mfu_palm": round(mfu_palm, 4),
                     "mfu_convention": (
-                        "PaLM-style: full (non-causal) attention "
-                        "FLOPs credited; the causal flash kernel "
-                        "skips ~half the blocks, so ~9% flattering "
-                        "at seq 2048 vs causal accounting"
+                        "headline mfu/vs_baseline are causal-"
+                        "accounted (only the lower-triangular "
+                        "attention blocks the kernel computes are "
+                        "credited); mfu_palm credits the full "
+                        "S x S score matrix, ~9% higher at seq 2048"
                     ),
+                    "tunnel_wait_s": round(waited_s, 1),
                     "chip": gen,
                     "backend": jax.default_backend(),
                     "n_devices": n_dev,

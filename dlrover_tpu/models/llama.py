@@ -496,8 +496,17 @@ def num_params(cfg: LlamaConfig) -> int:
     return total
 
 
-def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
-    """Approx training FLOPs/token: 6*N + attention term (for MFU)."""
+def flops_per_token(
+    cfg: LlamaConfig, seq_len: int, causal: bool = False
+) -> float:
+    """Approx training FLOPs/token: 6*N + attention term (for MFU).
+
+    causal=False is the PaLM convention (full S x S score matrix
+    credited); causal=True credits only the lower-triangular blocks the
+    causal kernel actually computes (~(S+1)/2S of full — the
+    conservative accounting, used for the bench headline)."""
     n = num_params(cfg)
-    attn = 12 * cfg.n_layers * cfg.dim * seq_len
+    attn = 12.0 * cfg.n_layers * cfg.dim * seq_len
+    if causal:
+        attn *= (seq_len + 1) / (2.0 * seq_len)
     return 6.0 * n + attn
