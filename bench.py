@@ -175,30 +175,53 @@ def _bench_checkpoint(state, step_ms: float, beat=None) -> dict:
         eng2 = _Eng(ckpt_dir, job_name="benchjob")
         try:
             beat("checkpoint restore probe (shm read + H2D)")
+            # the two restore legs timed apart: the shm read is host
+            # memcpy (link-independent), the H2D leg rides whatever
+            # attaches the chip — the axon tunnel here, direct PCIe in
+            # production. Splitting them lets the full-state estimate
+            # be reported BOTH ways instead of letting the tunnel's
+            # 0.01-1 GB/s poison the only number.
             t0 = time.monotonic()
-            step, restored = eng2.load_from_memory(target=probe)
-            restored = restore_to_shardings(restored, probe)
+            step, host_state = eng2.load_from_memory(target=probe)
+            shm_read_s = max(time.monotonic() - t0, 1e-9)
+            t0 = time.monotonic()
+            restored = restore_to_shardings(host_state, probe)
             # NOT block_until_ready: the axon backend's returns early
             # for async buffers, which would under-report the stall
             from dlrover_tpu.utils.prof import device_fence
 
             device_fence(restored)
-            restore_probe = time.monotonic() - t0
+            h2d_s = time.monotonic() - t0
             # the fence itself costs one round trip per leaf (plus
             # first-use gather compiles) — measure it on the now-
             # complete tree and subtract, or the per-leaf cost gets
             # multiplied by `scale` into the full-state estimate
             t1 = time.monotonic()
             device_fence(restored)
-            restore_probe = max(
-                restore_probe - (time.monotonic() - t1), 1e-9
-            )
+            h2d_s = max(h2d_s - (time.monotonic() - t1), 1e-9)
+            restore_probe = shm_read_s + h2d_s
         finally:
             eng2.close()  # client-only: eng owns the IPC server
         out["restore_stall_measured_s"] = round(restore_probe, 2)
+        out["restore_shm_read_s"] = round(shm_read_s, 3)
+        out["restore_h2d_s"] = round(h2d_s, 3)
         out["restore_measured_gb"] = out["ckpt_probe_gb"]
         out["restore_stall_full_est_s"] = round(
             restore_probe * scale, 2
+        )
+        # PCIe-modeled full-state restore: measured shm read scaled by
+        # bytes + the H2D leg priced at a directly-attached v5e link
+        # (~16 GB/s PCIe Gen4 x16) instead of the tunnel. Both numbers
+        # are reported; neither replaces the other.
+        pcie_gbps = float(os.environ.get("BENCH_PCIE_GBPS", "16"))
+        restore_pcie = (
+            shm_read_s * scale + (nbytes / 1e9) / pcie_gbps
+        )
+        out["restore_stall_pcie_model_s"] = round(restore_pcie, 2)
+        out["restore_pcie_model"] = (
+            f"measured shm read x{scale:.1f} + "
+            f"{nbytes / 1e9:.2f} GB / {pcie_gbps:.0f} GB/s H2D "
+            "(directly-attached v5e; tunnel-measured alongside)"
         )
         out["ckpt_roundtrip_ok"] = bool(
             step == 2 and restored is not None
@@ -216,9 +239,15 @@ def _bench_checkpoint(state, step_ms: float, beat=None) -> dict:
         )
         goodput = (1.0 - ckpt_frac) * mtbf_s / (mtbf_s + per_failure)
         out["goodput_pct"] = round(goodput * 100, 2)
+        per_failure_pcie = restore_pcie + respawn_s + interval_s / 2
+        goodput_pcie = (
+            (1.0 - ckpt_frac) * mtbf_s / (mtbf_s + per_failure_pcie)
+        )
+        out["goodput_pct_pcie_model"] = round(goodput_pcie * 100, 2)
         out["goodput_assumptions"] = (
             "ckpt@10steps; stall measured (fresh-engine restore, "
-            "byte-scaled to full state); modeled: MTBF 1h, respawn 20s"
+            "byte-scaled to full state); modeled: MTBF 1h, respawn "
+            "20s; _pcie_model variant prices H2D at the direct link"
         )
     except Exception as e:  # noqa: BLE001
         out["ckpt_error"] = str(e)[:200]

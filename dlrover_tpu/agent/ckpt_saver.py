@@ -42,6 +42,10 @@ from dlrover_tpu.common.storage import (
 CKPT_META_NAME = "ckpt_meta"
 CKPT_QUEUE_NAME = "ckpt_save_events"
 CKPT_LOCK_NAME = "ckpt_shm_lock"
+# restore-path fan-out (shm leaf copies, storage shard reads): the
+# stall a recovering trainer pays is read + H2D, and both legs
+# parallelize (reference: megatron parallel load, 242→156 s)
+RESTORE_THREADS = int(os.environ.get("DLROVER_TPU_RESTORE_THREADS", "8"))
 
 
 class ShmIntegrityError(RuntimeError):
@@ -186,18 +190,39 @@ class SharedMemoryHandler:
                 f"{meta.step} claims {meta.total_bytes}"
             )
         buf = self._segment.buf
-        flat = {}
+        seg_size = self._segment.size
         for tm in meta.tensors:
-            raw = bytes(buf[tm.offset : tm.offset + tm.nbytes])
-            if len(raw) != tm.nbytes:
+            if tm.offset + tm.nbytes > seg_size:
                 raise ShmIntegrityError(
-                    f"truncated read of {tm.path}: got {len(raw)} of "
-                    f"{tm.nbytes} bytes (segment size "
-                    f"{self._segment.size})"
+                    f"truncated read of {tm.path}: needs bytes "
+                    f"[{tm.offset}, {tm.offset + tm.nbytes}) but "
+                    f"segment size is {seg_size}"
                 )
-            flat[tm.path] = np.frombuffer(
-                raw, dtype=np.dtype(tm.dtype)
-            ).reshape(tm.shape)
+
+        def _copy(tm):
+            # zero-copy view of the mmap, then an owned .copy() — the
+            # numpy memcpy releases the GIL, so the pool below overlaps
+            # per-leaf copies (the restore stall is exactly this read +
+            # H2D; reference parallel-load blog: megatron_flash_
+            # checkpoint.md:160 cuts 242→156 s the same way)
+            dt = np.dtype(tm.dtype)
+            view = np.frombuffer(
+                buf, dtype=dt, count=tm.nbytes // dt.itemsize,
+                offset=tm.offset,
+            )
+            return tm.path, view.reshape(tm.shape).copy()
+
+        # NOT gated on cpu_count: memcpy releases the GIL so extra
+        # threads are harmless on small hosts, and gating would leave
+        # the pool path forever untested on the 1-CPU CI container
+        n_workers = min(RESTORE_THREADS, len(meta.tensors))
+        if n_workers > 1 and meta.total_bytes > (64 << 20):
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(n_workers) as pool:
+                flat = dict(pool.map(_copy, meta.tensors))
+        else:
+            flat = dict(_copy(tm) for tm in meta.tensors)
         return meta, flat
 
     def close(self, unlink: bool = False):

@@ -110,6 +110,19 @@ class CkptReplicaManager:
 
     # -- restore -----------------------------------------------------------
 
+    def peek_step(self, node_rank: Optional[int] = None) -> int:
+        """Step held by the stored replica — meta read only, no chunk
+        I/O. Lets the engine decide replica-vs-storage ordering before
+        paying for either transfer."""
+        rank = self.node_rank if node_rank is None else node_rank
+        raw_meta = self._mc.kv_get(self._key(rank, "meta"))
+        if not raw_meta:
+            return -1
+        try:
+            return int(pickle.loads(raw_meta)["step"])
+        except Exception:  # noqa: BLE001 — torn meta = no replica
+            return -1
+
     def restore(
         self, node_rank: Optional[int] = None
     ) -> Tuple[int, Optional[dict], Optional[bytes]]:
@@ -120,15 +133,28 @@ class CkptReplicaManager:
         if not raw_meta:
             return -1, None, None
         meta = pickle.loads(raw_meta)
-        parts: List[bytes] = []
-        for i in range(meta["n_chunks"]):
-            chunk = self._mc.kv_get(self._key(rank, f"chunk{i}"))
+        # chunk fetches fan out over the (thread-safe) gRPC channel —
+        # restore is the recovery stall, and the per-frame round trips
+        # otherwise serialize on the network latency
+        def _get(i: int):
+            return self._mc.kv_get(self._key(rank, f"chunk{i}"))
+
+        n = meta["n_chunks"]
+        if n > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            from dlrover_tpu.agent.ckpt_saver import RESTORE_THREADS
+
+            with ThreadPoolExecutor(min(RESTORE_THREADS, n)) as pool:
+                parts: List[bytes] = list(pool.map(_get, range(n)))
+        else:
+            parts = [_get(0)] if n else []
+        for i, chunk in enumerate(parts):
             if not chunk:
                 logger.warning(
                     "replica chunk %d missing for node %d", i, rank
                 )
                 return -1, None, None
-            parts.append(chunk)
         blob = b"".join(parts)
         if (
             len(blob) != meta["size"]

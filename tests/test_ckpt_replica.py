@@ -149,3 +149,149 @@ class TestOrbaxCheckpointer:
             restored["params"]["w"], state["params"]["w"]
         )
         ck.close()
+
+
+class TestReplicaFirstRestore:
+    """r5: the respawn path consults the survivor-held replica BEFORE
+    the storage round-trip when the replica is at least as fresh
+    (reference replica.py:193 — peer shm first, storage is the slow
+    path)."""
+
+    def test_peek_step(self, client):
+        rm = CkptReplicaManager(master_client=client, node_rank=0)
+        assert rm.peek_step() == -1
+        flat, aux = flatten_state(_state(6))
+        rm.backup(21, flat, aux)
+        assert rm.peek_step() == 21
+
+    def test_fresh_replica_beats_storage(self, client, tmp_path):
+        # storage holds step 5 (state A); replica holds step 9 (B).
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"rf-{os.getpid()}"
+        ckpt_dir = str(tmp_path / "ckpt")
+        eng = CheckpointEngine(ckpt_dir)
+        state_a = _state(7)
+        try:
+            eng.save_to_storage(5, state_a)
+            assert eng.wait_for_persist(5, timeout=30)
+        finally:
+            eng.close()
+        state_b = _state(8)
+        rm = CkptReplicaManager(master_client=client, node_rank=0)
+        flat, aux = flatten_state(state_b)
+        rm.backup(9, flat, aux)
+        # a respawned node: NEW job name -> empty shm, same ckpt dir
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"rf2-{os.getpid()}"
+        eng2 = CheckpointEngine(ckpt_dir, replica_manager=rm)
+        try:
+            step, restored = eng2.load()
+            assert step == 9  # replica, not storage's step 5
+            np.testing.assert_allclose(
+                restored["params"]["w"],
+                np.asarray(jax.device_get(state_b["params"]["w"])),
+            )
+        finally:
+            eng2.close()
+
+    def test_stale_replica_loses_to_storage(self, client, tmp_path):
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"rs-{os.getpid()}"
+        ckpt_dir = str(tmp_path / "ckpt")
+        eng = CheckpointEngine(ckpt_dir)
+        state_a = _state(9)
+        try:
+            eng.save_to_storage(5, state_a)
+            assert eng.wait_for_persist(5, timeout=30)
+        finally:
+            eng.close()
+        rm = CkptReplicaManager(master_client=client, node_rank=0)
+        flat, aux = flatten_state(_state(10))
+        rm.backup(3, flat, aux)  # older than storage
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"rs2-{os.getpid()}"
+        eng2 = CheckpointEngine(ckpt_dir, replica_manager=rm)
+        try:
+            step, restored = eng2.load()
+            assert step == 5  # storage wins over the stale replica
+            np.testing.assert_allclose(
+                restored["params"]["w"],
+                np.asarray(jax.device_get(state_a["params"]["w"])),
+            )
+        finally:
+            eng2.close()
+
+
+class TestParallelRestorePaths:
+    """r5: restore fans leaf reads over a thread pool above 64 MB
+    (shm) / 32 MB (npz); these states cross the thresholds so the
+    pooled paths are actually exercised, not just the serial ones."""
+
+    def _big_state(self):
+        # 24 leaves x 4 MB = ~96 MB: crosses both pool thresholds
+        ks = jax.random.split(jax.random.PRNGKey(0), 24)
+        return {
+            f"w{i}": jax.random.normal(k, (1024, 1024))
+            for i, k in enumerate(ks)
+        }
+
+    def test_big_shm_roundtrip(self, tmp_path):
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"big-{os.getpid()}"
+        eng = CheckpointEngine(str(tmp_path / "ckpt"))
+        state = self._big_state()
+        try:
+            eng.save_to_memory(1, state)
+            step, restored = eng.load_from_memory()
+            assert step == 1
+            for k, v in state.items():
+                np.testing.assert_array_equal(
+                    restored[k], np.asarray(jax.device_get(v))
+                )
+        finally:
+            eng.close()
+
+    def test_big_storage_roundtrip(self, tmp_path):
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"bigs-{os.getpid()}"
+        ckpt_dir = str(tmp_path / "ckpt")
+        eng = CheckpointEngine(ckpt_dir)
+        state = self._big_state()
+        try:
+            eng.save_to_storage(2, state)
+            assert eng.wait_for_persist(2, timeout=60)
+        finally:
+            eng.close()
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"bigs2-{os.getpid()}"
+        eng2 = CheckpointEngine(ckpt_dir)
+        try:
+            step, restored = eng2.load()
+            assert step == 2
+            for k, v in state.items():
+                np.testing.assert_array_equal(
+                    restored[k], np.asarray(jax.device_get(v))
+                )
+        finally:
+            eng2.close()
+
+
+def test_broken_fresh_replica_falls_back_to_storage(
+    client, tmp_path
+):
+    """A fresher replica whose flat no longer covers the tree (e.g.
+    saved on a since-resized mesh) must NOT crash-loop load() — the
+    storage checkpoint, whose merged shards re-shard fully, wins."""
+    os.environ["DLROVER_TPU_JOB_NAME"] = f"bk-{os.getpid()}"
+    ckpt_dir = str(tmp_path / "ckpt")
+    eng = CheckpointEngine(ckpt_dir)
+    state_a = _state(11)
+    try:
+        eng.save_to_storage(5, state_a)
+        assert eng.wait_for_persist(5, timeout=30)
+    finally:
+        eng.close()
+    rm = CkptReplicaManager(master_client=client, node_rank=0)
+    flat, aux = flatten_state(_state(12))
+    del flat["params/w"]  # aux still lists it -> KeyError on unflatten
+    rm.backup(9, flat, aux)
+    os.environ["DLROVER_TPU_JOB_NAME"] = f"bk2-{os.getpid()}"
+    eng2 = CheckpointEngine(ckpt_dir, replica_manager=rm)
+    try:
+        step, restored = eng2.load()
+        assert step == 5 and restored is not None
+    finally:
+        eng2.close()
