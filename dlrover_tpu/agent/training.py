@@ -174,13 +174,20 @@ class ElasticTrainingAgent:
         self.rdzv = MasterRendezvousHandler(
             self.client,
             timeout=config.rdzv_timeout,
-            should_stop=lambda: self._stop.is_set(),
+            should_stop=lambda: self._stop.is_set()
+            or self._leave_flag,
         )
         self.worker: Optional[WorkerProcess] = None
         self.restart_count = 0
         self._current_round = 0
         self._stop = threading.Event()
         self._leave_requested = threading.Event()
+        # plain bool written by the SIGTERM handler: Event.set()
+        # acquires a non-reentrant lock, so a signal landing while the
+        # main thread is inside its own _stop bookkeeping could
+        # deadlock — the handler stores this flag and the loops
+        # promote it to the Events (_promote_signal_flags)
+        self._leave_flag = False
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._coordinator_port = find_free_port()
         # flash-checkpoint plumbing: the agent owns the IPC server, the
@@ -245,7 +252,7 @@ class ElasticTrainingAgent:
                     master_session = session
             except Exception:  # noqa: BLE001
                 logger.warning("heartbeat failed", exc_info=True)
-            self._stop.wait(JobConstant.HEARTBEAT_INTERVAL_SECS)
+            self._wait_stop(JobConstant.HEARTBEAT_INTERVAL_SECS)
 
     def _on_master_restart(self):
         """Re-establish this agent's state on a fresh master: node
@@ -404,6 +411,7 @@ class ElasticTrainingAgent:
             logger.info("agent stopping during rendezvous — exiting")
             return 0
         finally:
+            self._promote_signal_flags()  # a late SIGTERM only set the bool
             self._stop.set()
             self.collectors.stop()
             self._stop_worker()
@@ -436,7 +444,7 @@ class ElasticTrainingAgent:
 
     def _monitor_loop(self) -> int:
         while not self._stop.is_set():
-            self._stop.wait(self.config.monitor_interval)
+            self._wait_stop(self.config.monitor_interval)
             if self._stop.is_set():
                 break
             # snapshot: leave() (another thread / in-process E2E
@@ -499,14 +507,36 @@ class ElasticTrainingAgent:
         self._stop.set()
 
     def request_leave(self):
-        """Async-signal-safe leave trigger: ONLY sets flags. The
-        monitor loop wakes, run() unwinds, and the teardown persists
-        the staged shm then reports DELETED. A signal handler must not
-        call leave() directly — its persist would deadlock on the
-        saver's commit lock if the signal interrupted a persist
-        already running on this same (main) thread."""
-        self._leave_requested.set()
-        self._stop.set()
+        """Async-signal-safe leave trigger: stores ONE plain bool and
+        returns. The monitor loop promotes it to the Events, run()
+        unwinds, and the teardown persists the staged shm then reports
+        DELETED. A signal handler must not call leave() directly (its
+        persist would deadlock on the saver's commit lock if the
+        signal interrupted a persist on this same thread) and must not
+        touch threading.Event either — Event.set() acquires a
+        non-reentrant condition lock the interrupted frame may already
+        hold."""
+        self._leave_flag = True
+
+    def _promote_signal_flags(self):
+        """Thread-context half of request_leave: lift the bool the
+        signal handler stored into the Events every loop tick."""
+        if self._leave_flag and not self._leave_requested.is_set():
+            self._leave_requested.set()
+            self._stop.set()
+
+    def _wait_stop(self, timeout: float) -> bool:
+        """_stop.wait(timeout) in sub-second slices, promoting signal
+        flags between slices so a SIGTERM interrupts the wait within
+        ~0.2 s instead of a full interval."""
+        deadline = time.monotonic() + timeout
+        while True:
+            self._promote_signal_flags()
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return self._stop.is_set()
+            if self._stop.wait(min(0.2, left)):
+                return True
 
     def leave(self):
         """Graceful departure (preemption notice / scale-down): stop

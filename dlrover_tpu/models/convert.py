@@ -25,7 +25,38 @@ from dlrover_tpu.models.llama import LlamaConfig
 
 
 def config_from_hf(hf_config, **overrides) -> LlamaConfig:
-    """LlamaConfig from a transformers LlamaConfig(-like) object."""
+    """LlamaConfig from a transformers LlamaConfig(-like) object.
+
+    Raises ValueError for HF fields this architecture does not model —
+    importing those checkpoints would produce silently wrong logits
+    (same guard pattern as the GPT-2/BERT converters below)."""
+    rope_scaling = getattr(hf_config, "rope_scaling", None)
+    if rope_scaling not in (None, {}) and (
+        not isinstance(rope_scaling, dict)
+        or rope_scaling.get("rope_type", rope_scaling.get("type"))
+        != "default"
+    ):
+        raise ValueError(
+            f"unsupported rope_scaling={rope_scaling!r}: only plain "
+            "RoPE is modeled (Llama-3.1-style long-context scaling "
+            "would silently change positional numerics)"
+        )
+    if getattr(hf_config, "attention_bias", False):
+        raise ValueError(
+            "attention_bias=True is not modeled for Llama imports "
+            "(Qwen-style bias tensors would be silently dropped)"
+        )
+    if getattr(hf_config, "mlp_bias", False):
+        raise ValueError(
+            "mlp_bias=True is not modeled for Llama imports (the "
+            "gate/up/down bias tensors would be silently dropped)"
+        )
+    hidden_act = getattr(hf_config, "hidden_act", "silu")
+    if hidden_act not in ("silu", "swish"):
+        raise ValueError(
+            f"unsupported hidden_act={hidden_act!r}: the SwiGLU MLP "
+            "hard-codes silu"
+        )
     fields = dict(
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
@@ -180,6 +211,12 @@ def to_hf_state_dict(cfg: LlamaConfig, params: Dict) -> Dict[str, Any]:
     `hf_model.load_state_dict({k: torch.tensor(v) for k, v in sd.items()})`.
     """
     layers = params["layers"]
+    if any("_lora_" in k for k in layers):
+        raise ValueError(
+            "params still carry LoRA adapter leaves; export would "
+            "silently drop the fine-tuned deltas — call "
+            "lora.merge(cfg, params) first"
+        )
     sd: Dict[str, Any] = {
         "model.embed_tokens.weight": _to_numpy(
             params["embed"]["weight"]

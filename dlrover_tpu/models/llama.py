@@ -71,6 +71,10 @@ class LlamaConfig:
     # GPipe microbatch count when the mesh has a live "pipe" axis
     # (0 → default to the pipe degree)
     pipeline_microbatches: int = 0
+    # LoRA delta scale (alpha; rank comes from the adapter shape).
+    # Only read when adapter leaves are present — models/lora.py
+    # injects them and `lora.configure` sets this to match.
+    lora_alpha: float = 16.0
 
     @property
     def moe(self):
@@ -203,6 +207,11 @@ def partition_rules(cfg: LlamaConfig):
         from dlrover_tpu.models.moe import moe_partition_rules
 
         moe_rules = moe_partition_rules()
+    from dlrover_tpu.models.lora import lora_partition_rules
+
+    # adapter rules FIRST: `layers/wq_lora_a` would otherwise match
+    # the broader `layers/wq` rule with the wrong axis count
+    moe_rules = moe_rules + lora_partition_rules()
     return moe_rules + [
         # D-axis sharding ONLY for the embedding: a vocab-sharded
         # table turns `weight[tokens]` into an involuntary full
@@ -254,12 +263,28 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 def _compute_weights(cfg: LlamaConfig, layer_params) -> Dict:
     """Matmul weights cast to the compute dtype; norms stay in param
-    dtype (_rms_norm does its own f32 math)."""
-    return {
-        k: v.astype(cfg.dtype)
-        for k, v in layer_params.items()
-        if not k.endswith("_norm")
-    }
+    dtype (_rms_norm does its own f32 math).
+
+    LoRA merge site (models/lora.py): when `{k}_lora_a/b` leaves are
+    present the effective weight W + (alpha/r) A@B is formed here, in
+    compute dtype, per scanned layer. Every consumer — training layer,
+    pipeline stage, KV-cache decoder — flows through this function, so
+    adapters apply uniformly. The merge matmul is r*in*out FLOPs,
+    ~r/(B*S) of the projection itself."""
+    out = {}
+    for k, v in layer_params.items():
+        if k.endswith("_norm") or "_lora_" in k:
+            continue
+        w = v.astype(cfg.dtype)
+        a = layer_params.get(k + "_lora_a")
+        if a is not None:
+            b = layer_params[k + "_lora_b"]
+            scale = jnp.asarray(
+                cfg.lora_alpha / a.shape[-1], cfg.dtype
+            )
+            w = w + scale * (a.astype(cfg.dtype) @ b.astype(cfg.dtype))
+        out[k] = w
+    return out
 
 
 def _attn_qkv(cfg: LlamaConfig, mesh, h, lp, positions):

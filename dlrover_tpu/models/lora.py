@@ -1,0 +1,204 @@
+"""LoRA adapters for the stacked-layer transformer families.
+
+Reference parity: the reference's flagship acceptance workload is
+Llama-2 LoRA fine-tuning via peft
+(examples/pytorch/llama2/fine_tuning.py:18,123-131 — `LoraConfig`,
+`get_peft_model`, adapter-only `state_dict` handed to the flash
+checkpointer). This module is the TPU-first equivalent:
+
+- adapters are extra stacked leaves in the SAME param pytree
+  (`layers/wq_lora_a` [L, in, r], `layers/wq_lora_b` [L, r, out]),
+  consumed by the existing `lax.scan` layer body — no module
+  wrapping, no graph rewrite;
+- the effective weight `W + (alpha/r) * A @ B` is formed inside
+  `_compute_weights` (llama.py), the one chokepoint shared by the
+  training layer, the pipeline stages, and the KV-cache decoder —
+  so LoRA'd training, eval, and generation all come from one merge
+  site. The per-layer merge matmul is rank * in * out FLOPs,
+  ~r/(B*S) of the forward projection itself: noise on the MXU;
+- freezing is an optimizer concern, not a graph one:
+  `lora_optimizer` wraps any optax optimizer in multi_transform so
+  base weights get `set_to_zero` updates and moment state exists
+  ONLY for adapter leaves (the actual memory win of LoRA);
+- adapter-only checkpointing is just saving the adapter sub-pytree
+  through the ordinary flash-checkpoint engine.
+
+PEFT semantics kept: A ~ N(0, 1/r), B = 0 (delta starts at exactly
+zero), effective delta scaled by alpha/rank. lora_dropout is NOT
+implemented — the weight-level merge has no activation hook; pass 0
+(the regularizer changes optimization, not model semantics).
+"""
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dlrover_tpu.models.llama import LlamaConfig
+
+Params = Dict[str, Any]
+
+LORA_A = "_lora_a"
+LORA_B = "_lora_b"
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """Mirrors peft.LoraConfig's knobs (fine_tuning.py:123-131)."""
+
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+    dropout: float = 0.0
+
+    def __post_init__(self):
+        if self.rank <= 0:
+            raise ValueError(f"lora rank must be positive: {self.rank}")
+        if self.dropout:
+            raise NotImplementedError(
+                "lora_dropout is not supported by the weight-level "
+                "merge; use 0.0"
+            )
+
+
+def configure(cfg: LlamaConfig, lora: LoraConfig) -> LlamaConfig:
+    """Model config carrying the adapter scale (the merge site reads
+    alpha from the config, rank from the adapter shape)."""
+    return dataclasses.replace(cfg, lora_alpha=lora.alpha)
+
+
+def inject(
+    params: Params, lora: LoraConfig, key: jax.Array,
+    param_dtype=jnp.float32,
+) -> Params:
+    """Add adapter leaves next to each target weight.
+
+    Targets are keys of params["layers"] with shape [L, in, out]
+    (wq/wk/wv/wo, and w_gate/w_up/w_down if listed). Base weights are
+    untouched — freezing happens in the optimizer."""
+    layers = dict(params["layers"])
+    keys = jax.random.split(key, len(lora.targets))
+    for t, k in zip(lora.targets, keys):
+        if t not in layers:
+            raise KeyError(
+                f"lora target {t!r} not in params['layers'] "
+                f"(have {sorted(layers)})"
+            )
+        w = layers[t]
+        if w.ndim != 3:
+            raise ValueError(
+                f"lora target {t!r} must be stacked [L, in, out], "
+                f"got shape {w.shape}"
+            )
+        L, d_in, d_out = w.shape
+        layers[t + LORA_A] = (
+            jax.random.normal(k, (L, d_in, lora.rank), param_dtype)
+            / jnp.sqrt(jnp.asarray(lora.rank, param_dtype))
+        )
+        layers[t + LORA_B] = jnp.zeros(
+            (L, lora.rank, d_out), param_dtype
+        )
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def is_adapter_path(path: str) -> bool:
+    return LORA_A in path or LORA_B in path
+
+
+def lora_labels(params: Params):
+    """'lora' / 'frozen' label pytree for optax.multi_transform."""
+    from dlrover_tpu.parallel.sharding import path_str
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: "lora"
+        if is_adapter_path(path_str(path))
+        else "frozen",
+        params,
+    )
+
+
+def lora_optimizer(base_optimizer):
+    """Wrap an optax optimizer: adapters train, everything else is
+    frozen WITH no moment state allocated for it (multi_transform
+    inits each inner transform on its own subset)."""
+    import optax
+
+    return optax.multi_transform(
+        {"lora": base_optimizer, "frozen": optax.set_to_zero()},
+        lora_labels,
+    )
+
+
+def adapter_state_dict(params: Params) -> Params:
+    """The adapter-only sub-pytree — what gets checkpointed
+    (reference: peft state_dict into FlashCkptTrainer)."""
+    return {
+        "layers": {
+            k: v
+            for k, v in params["layers"].items()
+            if is_adapter_path(k)
+        }
+    }
+
+
+def load_adapters(params: Params, adapters: Params) -> Params:
+    """Insert a checkpointed adapter dict into a (possibly freshly
+    imported) base param pytree. Shapes must match injection."""
+    layers = dict(params["layers"])
+    for k, v in adapters["layers"].items():
+        if not is_adapter_path(k):
+            raise KeyError(f"{k!r} is not an adapter leaf")
+        base = k.split(LORA_A)[0].split(LORA_B)[0]
+        if base not in layers:
+            raise KeyError(
+                f"adapter {k!r} has no base weight {base!r}"
+            )
+        layers[k] = v
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def merge(cfg: LlamaConfig, params: Params) -> Params:
+    """Fold adapters into the base weights and drop them:
+    W <- W + (alpha/r) A @ B in param dtype. The result is a plain
+    full-parameter pytree — exportable to HF via models/convert.py
+    (merge-to-full, reference fine_tuning merge_and_unload)."""
+    layers = {}
+    for k, v in params["layers"].items():
+        if is_adapter_path(k):
+            continue
+        a = params["layers"].get(k + LORA_A)
+        if a is not None:
+            b = params["layers"][k + LORA_B]
+            scale = cfg.lora_alpha / a.shape[-1]
+            # einsum over the stacked L axis, accumulated in f32
+            delta = jnp.einsum(
+                "lir,lro->lio",
+                a.astype(jnp.float32),
+                b.astype(jnp.float32),
+            )
+            v = (v.astype(jnp.float32) + scale * delta).astype(v.dtype)
+        layers[k] = v
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def lora_partition_rules():
+    """PartitionSpecs for adapter leaves, mirroring each base weight's
+    layout: column-parallel targets (wq/wk/wv/w_gate/w_up) shard A's
+    input dim on fsdp and B's output dim on tensor; row-parallel
+    targets (wo/w_down) shard A's input dim on tensor and B's output
+    dim on fsdp. The rank dim is tiny — never sharded."""
+    from jax.sharding import PartitionSpec as P
+
+    return [
+        (r"layers/(wq|wk|wv|w_gate|w_up)_lora_a", P("pipe", "fsdp", None)),
+        (r"layers/(wq|wk|wv|w_gate|w_up)_lora_b", P("pipe", None, "tensor")),
+        (r"layers/(wo|w_down)_lora_a", P("pipe", "tensor", None)),
+        (r"layers/(wo|w_down)_lora_b", P("pipe", None, "fsdp")),
+    ]

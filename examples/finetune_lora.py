@@ -1,0 +1,167 @@
+"""Llama LoRA fine-tuning — the reference's flagship acceptance
+workload (examples/pytorch/llama2/fine_tuning.py:18,123-167: peft
+LoraConfig + get_peft_model + adapter-only state_dict into the flash
+checkpointer), TPU-first.
+
+Flow: import a pretrained checkpoint (an in-process random HF model by
+default, --hf-path for a real one), inject rank-r adapters next to the
+stacked weights, fine-tune with an optimizer that updates ONLY the
+adapters (no moment state for the frozen base), flash-checkpoint the
+adapter-only sub-pytree every few steps, and finally merge-to-full for
+export.
+
+Flags:
+  --steps N       fine-tuning steps (default 30)
+  --rank R        LoRA rank (default 8)
+  --hf-path P     load a real HF LlamaForCausalLM from this path
+  --ckpt-dir DIR  adapter-only flash checkpoints + resume
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dlrover_tpu.utils.platform import ensure_cpu_if_forced
+
+ensure_cpu_if_forced()
+
+import jax
+import optax
+
+import dlrover_tpu
+from dlrover_tpu.models import convert, llama, lora
+from dlrover_tpu.parallel.accelerate import Strategy, accelerate
+from dlrover_tpu.parallel.mesh import MeshSpec
+
+
+def _pretrained(args):
+    """(cfg, params): a real HF import, or a tiny random 'pretrained'
+    model so the example runs anywhere in seconds."""
+    if args.hf_path:
+        return convert.from_hf(args.hf_path)
+    try:  # tiny random HF model through the real import path
+        from transformers import LlamaConfig as HFConfig
+        from transformers import LlamaForCausalLM
+
+        hf = LlamaForCausalLM(
+            HFConfig(
+                vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=2,
+                intermediate_size=128, max_position_embeddings=128,
+            )
+        )
+        cfg, params = convert.from_hf(hf)
+        import dataclasses
+
+        return (
+            dataclasses.replace(cfg, attn_impl="reference"),
+            params,
+        )
+    except ImportError:
+        cfg = llama.LlamaConfig.tiny()
+        return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--rank", type=int, default=8)
+    p.add_argument("--hf-path", default=None)
+    p.add_argument("--ckpt-dir", default=None)
+    args = p.parse_args()
+
+    dlrover_tpu.init()
+    cfg, params = _pretrained(args)
+    lc = lora.LoraConfig(rank=args.rank, alpha=2.0 * args.rank)
+    cfg = lora.configure(cfg, lc)
+
+    acc = accelerate(
+        init_params=lambda k: lora.inject(params, lc, k),
+        loss_fn=lambda pm, b, m: llama.loss_fn(cfg, pm, b, mesh=m),
+        rules=llama.partition_rules(cfg),
+        optimizer=lora.lora_optimizer(optax.adam(1e-2)),
+        strategy=Strategy(mesh=MeshSpec.fit(jax.device_count())),
+    )
+    state = acc.init(jax.random.PRNGKey(0))
+    n_adapter = sum(
+        x.size
+        for x in jax.tree_util.tree_leaves(
+            lora.adapter_state_dict(state["params"])
+        )
+    )
+    print(
+        f"trainable adapter params: {n_adapter:,} of "
+        f"{llama.num_params(cfg):,} total",
+        flush=True,
+    )
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 33), 0, cfg.vocab_size
+    )
+    batch = acc.shard_batch({"tokens": tokens})
+
+    ckpt = None
+    start_step = 0
+    if args.ckpt_dir:
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            Checkpointer,
+            StorageType,
+        )
+
+        ckpt = Checkpointer(args.ckpt_dir)
+        # adapter-only resume: the checkpoint holds just the A/B
+        # leaves; the base model is re-imported above
+        adapters = lora.adapter_state_dict(state["params"])
+        saved_step, saved = ckpt.load_checkpoint(target=adapters)
+        if saved is not None:
+            state["params"] = lora.load_adapters(
+                state["params"], saved
+            )
+            start_step = saved_step
+            print(f"resumed adapters from step {start_step}", flush=True)
+
+    first_loss = last_loss = None
+    for step in range(start_step + 1, args.steps + 1):
+        state, metrics = acc.train_step(state, batch)
+        loss = float(metrics["loss"])
+        first_loss = first_loss if first_loss is not None else loss
+        last_loss = loss
+        if ckpt is not None and step % 5 == 0:
+            blocked = ckpt.save_checkpoint(
+                step,
+                lora.adapter_state_dict(state["params"]),
+                StorageType.DISK,
+            )
+            print(
+                f"adapter ckpt step {step} staged in "
+                f"{blocked * 1e3:.1f} ms",
+                flush=True,
+            )
+        if step % 10 == 0 or step == 1:
+            print(f"step {step} loss {loss:.4f}", flush=True)
+
+    merged = lora.merge(cfg, state["params"])
+    hf_sd = convert.to_hf_state_dict(cfg, merged)
+    print(
+        f"merged-to-full export: {len(hf_sd)} HF tensors "
+        f"(adapters folded, ready for to_hf/save)",
+        flush=True,
+    )
+    if first_loss is None:  # resumed past --steps: nothing to train
+        print(f"done: already at step {start_step}", flush=True)
+        return
+    print(
+        f"done: first_loss={first_loss:.4f} last_loss={last_loss:.4f}",
+        flush=True,
+    )
+    if last_loss >= first_loss:
+        print("loss did not decrease", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -256,3 +256,39 @@ class TestConvertCli:
             ck.close()
         assert step == 0
         assert "layers" in state and "wte" in state
+
+
+class TestLlamaImportGuards:
+    """Unsupported HF Llama fields must raise, not silently alter
+    numerics (same guard pattern as GPT-2/BERT)."""
+
+    def _cfg(self, **kw):
+        base = dict(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+        )
+        base.update(kw)
+        return transformers.LlamaConfig(**base)
+
+    def test_rope_scaling_rejected(self):
+        cfg = self._cfg(
+            rope_scaling={
+                "rope_type": "llama3", "factor": 8.0,
+                "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 8192,
+            }
+        )
+        with pytest.raises(ValueError, match="rope_scaling"):
+            config_from_hf(cfg)
+
+    def test_attention_bias_rejected(self):
+        with pytest.raises(ValueError, match="attention_bias"):
+            config_from_hf(self._cfg(attention_bias=True))
+
+    def test_hidden_act_rejected(self):
+        with pytest.raises(ValueError, match="hidden_act"):
+            config_from_hf(self._cfg(hidden_act="gelu"))
+
+    def test_default_config_still_imports(self):
+        assert config_from_hf(self._cfg()).dim == 64
