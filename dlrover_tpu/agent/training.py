@@ -97,29 +97,57 @@ class MasterRendezvousHandler:
         """Returns (round, node_rank, world). Blocks until the round
         forms; raises TimeoutError on timeout or RendezvousAborted
         when `should_stop` fires mid-poll."""
-        self.client.join_rendezvous(
-            local_world_size=local_world_size,
-            rdzv_name=self.rdzv_name,
-            node_addr=node_addr,
-        )
+        # NOTE on the error class: MasterClient wraps EVERY exhausted
+        # RPC (grpc.RpcError on each attempt, retries included) in
+        # ConnectionError — "control plane unreachable right now".
+        # A blackholed control plane must not kill the agent
+        # (reference chaos scenario: 100% network loss,
+        # fault_tolerance_exps.md:211), so every RPC in this loop
+        # retries until the ONE rendezvous deadline bounds the join.
+        net_errors = (ConnectionError,)
         deadline = time.monotonic() + self.timeout
+        joined = False
         while time.monotonic() < deadline:
             if self.should_stop():
                 raise RendezvousAborted(
                     f"rendezvous {self.rdzv_name!r} aborted: agent "
                     "stopping (leave/preemption)"
                 )
-            rnd, _, world = self.client.get_comm_world(self.rdzv_name)
+            if not joined:
+                try:
+                    self.client.join_rendezvous(
+                        local_world_size=local_world_size,
+                        rdzv_name=self.rdzv_name,
+                        node_addr=node_addr,
+                    )
+                    joined = True
+                except net_errors as e:
+                    logger.warning(
+                        "rendezvous join RPC failed (%s); retrying "
+                        "until the %.0fs deadline", e, self.timeout,
+                    )
+                    time.sleep(self.poll_interval)
+                    continue
+            try:
+                rnd, _, world = self.client.get_comm_world(
+                    self.rdzv_name
+                )
+            except net_errors as e:
+                logger.warning(
+                    "rendezvous poll RPC failed (%s); retrying "
+                    "until the %.0fs deadline", e, self.timeout,
+                )
+                time.sleep(self.poll_interval)
+                continue
             if world:
                 for rank, (nid, _, _) in world.items():
                     if nid == self.client.node_id:
                         return rnd, rank, world
                 # round formed without us (node_unit rounding) — rejoin
-                self.client.join_rendezvous(
-                    local_world_size=local_world_size,
-                    rdzv_name=self.rdzv_name,
-                    node_addr=node_addr,
-                )
+                # next iteration, after the same pacing sleep as every
+                # other branch (a tight rejoin loop would hammer the
+                # master while it keeps serving the formed world)
+                joined = False
             time.sleep(self.poll_interval)
         raise TimeoutError(
             f"rendezvous {self.rdzv_name!r} did not complete in "

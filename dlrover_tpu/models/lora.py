@@ -62,17 +62,15 @@ class LoraConfig:
             )
 
 
-def configure(cfg: LlamaConfig, lora: LoraConfig) -> LlamaConfig:
-    """Model config carrying the adapter scale (the merge site reads
-    alpha from the config, rank from the adapter shape)."""
-    return dataclasses.replace(cfg, lora_alpha=lora.alpha)
-
-
 def inject(
-    params: Params, lora: LoraConfig, key: jax.Array,
-    param_dtype=jnp.float32,
-) -> Params:
-    """Add adapter leaves next to each target weight.
+    cfg: LlamaConfig, params: Params, lora: LoraConfig,
+    key: jax.Array, param_dtype=jnp.float32,
+) -> Tuple[LlamaConfig, Params]:
+    """Add adapter leaves next to each target weight; returns the
+    (config, params) pair to train with. The returned config carries
+    lora.alpha (the merge site reads alpha from the config and rank
+    from the adapter shape — returning both keeps the one logical
+    knob from splitting across two objects).
 
     Targets are keys of params["layers"] with shape [L, in, out]
     (wq/wk/wv/wo, and w_gate/w_up/w_down if listed). Base weights are
@@ -101,7 +99,7 @@ def inject(
         )
     out = dict(params)
     out["layers"] = layers
-    return out
+    return dataclasses.replace(cfg, lora_alpha=lora.alpha), out
 
 
 def is_adapter_path(path: str) -> bool:

@@ -353,6 +353,8 @@ class CheckpointEngine:
         self.max_to_keep = max_to_keep
         self.replica_manager = replica_manager
         self._replica_thread = None
+        self._backup_lock = threading.Lock()
+        self._pending_backup = None  # latest-wins parked backup
         self._staging_thread = None
         self._staging_error = None
         self.storage = storage or get_checkpoint_storage()
@@ -471,24 +473,57 @@ class CheckpointEngine:
         if self.replica_manager is not None:
             # ship the replica off-host in the background (replica.py:
             # the reference backs up to a peer's shm asynchronously
-            # too). If the previous backup is still in flight, skip
-            # this round — never block the milliseconds fast path.
-            if (
-                self._replica_thread is None
-                or not self._replica_thread.is_alive()
-            ):
-                self._replica_thread = threading.Thread(
-                    target=self.replica_manager.backup,
-                    args=(step, flat, aux),
-                    daemon=True,
+            # too). If the previous backup is still in flight (e.g. a
+            # network partition is stalling its RPCs), park this state
+            # in a latest-wins slot the backup thread drains — never
+            # block the milliseconds fast path, never leave the
+            # replica stale after the partition heals.
+            with self._backup_lock:
+                if (
+                    self._replica_thread is None
+                    or not self._replica_thread.is_alive()
+                ):
+                    self._pending_backup = None
+                    self._replica_thread = threading.Thread(
+                        target=self._backup_drain,
+                        args=(step, flat, aux),
+                        daemon=True,
+                    )
+                    self._replica_thread.start()
+                else:
+                    logger.info(
+                        "replica backup for step %d parked "
+                        "(previous still in flight; latest wins)",
+                        step,
+                    )
+                    self._pending_backup = (step, flat, aux)
+
+    def _backup_drain(self, step: int, flat, aux) -> None:
+        """Backup-thread body: ship the given state, then keep
+        draining whatever newer state was parked while shipping."""
+        while True:
+            try:
+                self.replica_manager.backup(step, flat, aux)
+            except Exception:  # noqa: BLE001 — replica is best-effort
+                logger.warning(
+                    "replica backup for step %d failed", step,
+                    exc_info=True,
                 )
-                self._replica_thread.start()
-            else:
-                logger.info(
-                    "replica backup for step %d skipped "
-                    "(previous still in flight)",
-                    step,
-                )
+            with self._backup_lock:
+                if self._pending_backup is None:
+                    # exit decision and the saver's liveness check
+                    # share this lock: clear the thread slot HERE so
+                    # a save racing our exit sees "no drain running"
+                    # and starts a fresh thread instead of parking a
+                    # backup nobody will ever drain
+                    if (
+                        self._replica_thread
+                        is threading.current_thread()
+                    ):
+                        self._replica_thread = None
+                    return
+                step, flat, aux = self._pending_backup
+                self._pending_backup = None
 
     def save_to_storage(self, step: int, state: Any) -> float:
         """Stage + queue async persist (reference save_to_storage)."""

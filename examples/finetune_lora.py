@@ -77,10 +77,12 @@ def main():
     dlrover_tpu.init()
     cfg, params = _pretrained(args)
     lc = lora.LoraConfig(rank=args.rank, alpha=2.0 * args.rank)
-    cfg = lora.configure(cfg, lc)
+    cfg, lparams = lora.inject(
+        cfg, params, lc, jax.random.PRNGKey(0)
+    )
 
     acc = accelerate(
-        init_params=lambda k: lora.inject(params, lc, k),
+        init_params=lambda k: lparams,
         loss_fn=lambda pm, b, m: llama.loss_fn(cfg, pm, b, mesh=m),
         rules=llama.partition_rules(cfg),
         optimizer=lora.lora_optimizer(optax.adam(1e-2)),

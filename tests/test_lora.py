@@ -36,8 +36,9 @@ class TestInjection:
         cfg = _cfg()
         params = llama.init_params(cfg, jax.random.PRNGKey(0))
         lc = lora.LoraConfig(rank=4)
-        cfg = lora.configure(cfg, lc)
-        injected = lora.inject(params, lc, jax.random.PRNGKey(1))
+        cfg, injected = lora.inject(
+            cfg, params, lc, jax.random.PRNGKey(1)
+        )
         tok = _tokens()
         np.testing.assert_array_equal(
             np.asarray(llama.apply(cfg, params, tok)),
@@ -48,7 +49,9 @@ class TestInjection:
         cfg = _cfg()
         params = llama.init_params(cfg, jax.random.PRNGKey(0))
         lc = lora.LoraConfig(rank=4, targets=("wq", "wo", "w_up"))
-        injected = lora.inject(params, lc, jax.random.PRNGKey(1))
+        _, injected = lora.inject(
+            cfg, params, lc, jax.random.PRNGKey(1)
+        )
         L, D = cfg.n_layers, cfg.dim
         assert injected["layers"]["wq_lora_a"].shape == (L, D, 4)
         assert injected["layers"]["wo_lora_b"].shape == (L, 4, D)
@@ -61,6 +64,7 @@ class TestInjection:
         params = llama.init_params(cfg, jax.random.PRNGKey(0))
         with pytest.raises(KeyError):
             lora.inject(
+                cfg,
                 params,
                 lora.LoraConfig(rank=2, targets=("nope",)),
                 jax.random.PRNGKey(1),
@@ -76,8 +80,7 @@ class TestMerge:
         cfg = _cfg()
         params = llama.init_params(cfg, jax.random.PRNGKey(0))
         lc = lora.LoraConfig(rank=4, alpha=8.0)
-        cfg = lora.configure(cfg, lc)
-        p = lora.inject(params, lc, jax.random.PRNGKey(1))
+        cfg, p = lora.inject(cfg, params, lc, jax.random.PRNGKey(1))
         # non-trivial B so the delta is live
         for t in ("wq", "wv"):
             p["layers"][t + "_lora_b"] = (
@@ -124,8 +127,7 @@ class TestMerge:
             remat=False, attn_impl="reference",
         )
         lc = lora.LoraConfig(rank=4, alpha=8.0)
-        cfg = lora.configure(cfg, lc)
-        p = lora.inject(params, lc, jax.random.PRNGKey(1))
+        cfg, p = lora.inject(cfg, params, lc, jax.random.PRNGKey(1))
         p["layers"]["wq_lora_b"] = (
             jax.random.normal(
                 jax.random.PRNGKey(5),
@@ -156,9 +158,11 @@ class TestFrozenBaseTraining:
         cfg = _cfg()
         base = llama.init_params(cfg, jax.random.PRNGKey(0))
         lc = lora.LoraConfig(rank=4)
-        cfg = lora.configure(cfg, lc)
+        cfg, lparams = lora.inject(
+            cfg, base, lc, jax.random.PRNGKey(1)
+        )
         acc = accelerate(
-            init_params=lambda k: lora.inject(base, lc, k),
+            init_params=lambda k: lparams,
             loss_fn=lambda pm, b, m: llama.loss_fn(
                 cfg, pm, b, mesh=m
             ),
@@ -198,7 +202,7 @@ class TestFrozenBaseTraining:
         base = llama.init_params(cfg, jax.random.PRNGKey(0))
         lc = lora.LoraConfig(rank=2)
         opt = lora.lora_optimizer(optax.adam(1e-2))
-        p = lora.inject(base, lc, jax.random.PRNGKey(1))
+        _, p = lora.inject(cfg, base, lc, jax.random.PRNGKey(1))
         opt_state = opt.init(p)
         moment_bytes = sum(
             x.nbytes
@@ -230,8 +234,7 @@ class TestAdapterCheckpoint:
         cfg = _cfg()
         base = llama.init_params(cfg, jax.random.PRNGKey(0))
         lc = lora.LoraConfig(rank=4)
-        cfg = lora.configure(cfg, lc)
-        p = lora.inject(base, lc, jax.random.PRNGKey(1))
+        cfg, p = lora.inject(cfg, base, lc, jax.random.PRNGKey(1))
         p["layers"]["wv_lora_b"] = (
             jax.random.normal(
                 jax.random.PRNGKey(9),
@@ -255,7 +258,8 @@ class TestAdapterCheckpoint:
             eng2.close()
         assert step == 7
         p2 = lora.load_adapters(
-            lora.inject(base, lc, jax.random.PRNGKey(42)), restored
+            lora.inject(cfg, base, lc, jax.random.PRNGKey(42))[1],
+            restored,
         )
         tok = _tokens()
         np.testing.assert_array_equal(
@@ -271,10 +275,12 @@ class TestShardedLora:
         cfg = _cfg()
         base = llama.init_params(cfg, jax.random.PRNGKey(0))
         lc = lora.LoraConfig(rank=4)
-        cfg = lora.configure(cfg, lc)
+        cfg, lparams = lora.inject(
+            cfg, base, lc, jax.random.PRNGKey(1)
+        )
         spec = MeshSpec(data=2, fsdp=2, tensor=2)
         acc = accelerate(
-            init_params=lambda k: lora.inject(base, lc, k),
+            init_params=lambda k: lparams,
             loss_fn=lambda pm, b, m: llama.loss_fn(
                 cfg, pm, b, mesh=m
             ),

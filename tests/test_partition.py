@@ -1,0 +1,317 @@
+"""Control-plane network-partition chaos: a gRPC blackhole (bytes
+swallowed, NOT connection-refused) between agents and the master
+during the save-commit, rendezvous, and heartbeat windows.
+
+Reference scenarios: the chaosblade experiments in
+docs/tech_report/fault_tolerance_exps.md:211,247 (100% network loss to
+the master; straggler + partition). The blackhole proxy below is the
+in-process analogue: established streams stall mid-flight and new
+connections accept but never answer, so RPCs hang until their deadline
+instead of failing fast.
+
+Invariants under test: no deadlock (every path returns within its
+bound), no double/lost commit of the storage checkpoint, the agent
+survives partitions that heal inside its timeouts, and the worker is
+never killed by a control-plane-only outage."""
+
+import os
+import socket
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.agent.master_client import MasterClient
+from dlrover_tpu.agent.training import (
+    ElasticLaunchConfig,
+    ElasticTrainingAgent,
+    MasterRendezvousHandler,
+)
+from dlrover_tpu.common.constants import JobConstant
+from dlrover_tpu.master.master import LocalJobMaster
+
+
+class BlackholeProxy:
+    """TCP forwarder with a partition switch.
+
+    partitioned=False: transparent byte pump in both directions.
+    partitioned=True: pumps stall (bytes held, connections stay open)
+    and new connections are accepted but never serviced — the gRPC
+    client sees a silent network, exactly what chaosblade's 100%-loss
+    rule produces, and times out on its own deadline."""
+
+    def __init__(self, target_addr: str):
+        host, port = target_addr.rsplit(":", 1)
+        self._target = (host, int(port))
+        self.partitioned = threading.Event()
+        self._stopping = threading.Event()
+        self._listener = socket.socket(
+            socket.AF_INET, socket.SOCK_STREAM
+        )
+        self._listener.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+        )
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(32)
+        self.port = self._listener.getsockname()[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        self._threads = []
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self):
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            if self.partitioned.is_set():
+                # swallow: keep the socket open, never answer — the
+                # client's RPC deadline is the only way out
+                self._threads.append(self._spawn(self._sink, conn))
+                continue
+            try:
+                up = socket.create_connection(self._target, timeout=5)
+            except OSError:
+                conn.close()
+                continue
+            self._threads.append(self._spawn(self._pump, conn, up))
+            self._threads.append(self._spawn(self._pump, up, conn))
+
+    def _spawn(self, fn, *args):
+        t = threading.Thread(target=fn, args=args, daemon=True)
+        t.start()
+        return t
+
+    def _sink(self, conn):
+        conn.settimeout(0.5)
+        while not self._stopping.is_set():
+            try:
+                if not conn.recv(65536):
+                    break
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _pump(self, src, dst):
+        src.settimeout(0.5)
+        while not self._stopping.is_set():
+            try:
+                data = src.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            while self.partitioned.is_set():
+                # hold the bytes: the stream stalls mid-flight
+                if self._stopping.is_set():
+                    return
+                time.sleep(0.05)
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stopping.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture()
+def master():
+    m = LocalJobMaster(num_nodes=1)
+    m.start()
+    yield m
+    m.stop()
+
+
+@pytest.fixture()
+def proxy(master):
+    p = BlackholeProxy(master.addr)
+    yield p
+    p.stop()
+
+
+@pytest.fixture()
+def client(proxy):
+    # short per-RPC deadline + few retries so blackholed calls
+    # resolve in seconds, not minutes
+    c = MasterClient(
+        proxy.addr, node_id=0, node_type="worker",
+        timeout=2.0, max_retries=2,
+    )
+    yield c
+    c.close()
+
+
+def _state(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w": rng.normal(size=(64, 32)).astype(np.float32)}
+
+
+class TestSaveCommitWindow:
+    def test_blackhole_during_save_commit(self, proxy, client, tmp_path):
+        """Partition while a save commits: the LOCAL storage commit
+        must land (the master is not on that path), the replica backup
+        must fail without wedging anything, and close() must return
+        inside its bound — then a healed partition resumes backups."""
+        from dlrover_tpu.trainer.flash_checkpoint.engine import (
+            CheckpointEngine,
+        )
+        from dlrover_tpu.trainer.flash_checkpoint.replica import (
+            CkptReplicaManager,
+        )
+
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"part1-{os.getpid()}"
+        rm = CkptReplicaManager(master_client=client, node_rank=0)
+        eng = CheckpointEngine(
+            str(tmp_path / "ckpt"), replica_manager=rm
+        )
+        try:
+            eng.save_to_storage(1, _state(1))
+            assert eng.wait_for_persist(1, timeout=30)
+            # partition, then save step 2 mid-blackhole
+            proxy.partitioned.set()
+            t0 = time.monotonic()
+            blocked = eng.save_to_storage(2, _state(2))
+            assert blocked < 5.0  # staging never waits on the master
+            assert eng.wait_for_persist(2, timeout=30)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 25.0, "local commit stalled on partition"
+            # heal; step 3 must commit AND replicate again
+            proxy.partitioned.clear()
+            eng.save_to_storage(3, _state(3))
+            assert eng.wait_for_persist(3, timeout=30)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if rm.peek_step() == 3:
+                    break
+                time.sleep(0.5)
+            assert rm.peek_step() == 3
+        finally:
+            t0 = time.monotonic()
+            eng.close()
+            assert time.monotonic() - t0 < 35.0, "close() deadlocked"
+        # no double/lost commit: tracker points at 3, one shard file
+        # per step dir
+        from dlrover_tpu.agent.ckpt_saver import read_tracker_step
+        from dlrover_tpu.common.storage import get_checkpoint_storage
+
+        storage = get_checkpoint_storage()
+        assert read_tracker_step(storage, str(tmp_path / "ckpt")) == 3
+        for step in (1, 2, 3):
+            listing = storage.listdir(
+                str(tmp_path / "ckpt" / str(step))
+            )
+            hosts = [n for n in listing if n.startswith("host_")]
+            assert hosts == ["host_0.npz"], (step, listing)
+
+
+class TestRendezvousWindow:
+    def test_blackhole_mid_rendezvous_poll_survives(
+        self, master, proxy, client
+    ):
+        """Partition after join, heal before the rdzv deadline: the
+        poll loop must absorb the RPC deadline errors and return the
+        formed world — not crash the agent."""
+        handler = MasterRendezvousHandler(
+            client, timeout=60, poll_interval=0.2
+        )
+        proxy.partitioned.set()
+        healer = threading.Timer(4.0, proxy.partitioned.clear)
+        healer.start()
+        try:
+            t0 = time.monotonic()
+            rnd, rank, world = handler.next_rendezvous(
+                local_world_size=1, node_addr="127.0.0.1:0"
+            )
+            elapsed = time.monotonic() - t0
+        finally:
+            healer.cancel()
+        assert rank == 0 and len(world) == 1
+        assert elapsed >= 4.0, "partition window was not exercised"
+
+    def test_unhealed_blackhole_times_out_cleanly(
+        self, master, proxy, client
+    ):
+        """A partition that never heals: next_rendezvous must raise
+        TimeoutError at ITS deadline — bounded, no deadlock."""
+        handler = MasterRendezvousHandler(
+            client, timeout=8, poll_interval=0.2
+        )
+        proxy.partitioned.set()
+        t0 = time.monotonic()
+        # the loop is specified to absorb ConnectionError and raise
+        # TimeoutError at ITS deadline — anything else is a crash
+        with pytest.raises(TimeoutError):
+            handler.next_rendezvous(
+                local_world_size=1, node_addr="127.0.0.1:0"
+            )
+        elapsed = time.monotonic() - t0
+        assert 7.0 <= elapsed < 30.0
+
+
+class TestHeartbeatWindow:
+    def test_blackhole_during_heartbeats_worker_survives(
+        self, master, proxy, monkeypatch, tmp_path
+    ):
+        """Partition spanning several heartbeat intervals while the
+        worker runs: the agent logs failed heartbeats, the worker is
+        NOT killed, and the run exits 0 after the heal."""
+        monkeypatch.setattr(
+            JobConstant, "HEARTBEAT_INTERVAL_SECS", 0.5
+        )
+        client = MasterClient(
+            proxy.addr, node_id=0, node_type="worker",
+            timeout=1.0, max_retries=1,
+        )
+        script = tmp_path / "worker.py"
+        script.write_text(
+            textwrap.dedent(
+                """
+                import time
+                time.sleep(6)
+                print("worker done")
+                """
+            )
+        )
+        config = ElasticLaunchConfig(
+            max_restarts=1, monitor_interval=0.3
+        )
+        agent = ElasticTrainingAgent(
+            config, [sys.executable, str(script)], client
+        )
+        result = {}
+
+        def _run():
+            result["rc"] = agent.run()
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        time.sleep(2.0)  # registration + rendezvous done, worker up
+        proxy.partitioned.set()
+        time.sleep(2.5)  # ~5 heartbeat intervals blackholed
+        proxy.partitioned.clear()
+        t.join(timeout=60)
+        assert not t.is_alive(), "agent.run() deadlocked"
+        assert result.get("rc") == 0
+        client.close()
