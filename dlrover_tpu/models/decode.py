@@ -86,13 +86,30 @@ def _write_cache_and_attend(
     to plain causal attention over the chunk — the Pallas flash
     kernel on TPU (ops/attention.dot_product_attention). Shape/type
     sniffing here would silently mis-handle future callers with
-    padded or packed positions."""
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
-    )
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
-    )
+    padded or packed positions.
+
+    `start` may be a scalar (all rows write at the same offset — the
+    lockstep generate() path) or a [B] vector of per-row offsets (the
+    continuous-batching path, rl/serve.py: every slot sits at its own
+    length). The vector case lowers to a per-row scatter via vmapped
+    dynamic_update_slice."""
+    if getattr(start, "ndim", 0) == 1:
+        def _row_write(c, u):
+            return jax.vmap(
+                lambda cr, ur, s: jax.lax.dynamic_update_slice(
+                    cr, ur.astype(cr.dtype), (s, 0, 0)
+                )
+            )(c, u, start)
+
+        k_cache = _row_write(k_cache, k)
+        v_cache = _row_write(v_cache, v)
+    else:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
+        )
     if plain_causal:
         from dlrover_tpu.ops.attention import dot_product_attention
 
@@ -248,17 +265,58 @@ def decode_step(
     params: Params,
     token: jax.Array,   # [B] current token
     cache: Dict[str, jax.Array],
-    pos,                # scalar int: position of `token`
+    pos,                # position of `token`: scalar, or [B] per slot
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """One cached step → (next-token logits [B,V], updated cache)."""
+    """One cached step → (next-token logits [B,V], updated cache).
+
+    Scalar `pos` is the lockstep path (all rows at the same length);
+    a [B] vector decodes every row at its OWN position — the
+    continuous-batching path (rl/serve.py), where each slot carries a
+    different sequence."""
     b = token.shape[0]
-    positions = jnp.broadcast_to(
-        jnp.asarray(pos, jnp.int32), (b, 1)
-    )
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 1:
+        positions = pos[:, None]
+    else:
+        positions = jnp.broadcast_to(pos, (b, 1))
     logits, cache = _forward_cached(
         cfg, params, token[:, None], cache, positions, pos
     )
     return logits[:, 0], cache
+
+
+def prefill_into_slot(
+    cfg: LlamaConfig,
+    params: Params,
+    prompt: jax.Array,  # [P] (pad tail beyond the real length is fine)
+    cache: Dict[str, jax.Array],
+    slot,
+) -> Dict[str, jax.Array]:
+    """Run a single-sequence prefill and install its K/V into row
+    `slot` of a multi-slot cache — the admission step of continuous
+    batching (rl/serve.py).
+
+    Pad-tail correctness: cells beyond the prompt's true length hold
+    pad-token K/V, but the decode mask (`cols <= pos`) hides every
+    cell past the slot's current position, and generation overwrites
+    them one by one — so they are never attended. The same argument
+    covers stale cells left by the slot's previous occupant."""
+    p = prompt.shape[0]
+    if cache["k"].shape[2] < p:
+        raise ValueError(
+            f"prompt chunk {p} exceeds cache max_len "
+            f"{cache['k'].shape[2]}"
+        )
+    mini = init_kv_cache(cfg, 1, p)
+    _, mini = prefill(cfg, params, prompt[None], mini)
+    out = {}
+    for name in ("k", "v"):
+        out[name] = jax.lax.dynamic_update_slice(
+            cache[name],
+            mini[name].astype(cache[name].dtype),
+            (0, slot, 0, 0, 0),
+        )
+    return out
 
 
 def _mask_top_k(logits: jax.Array, k: int) -> jax.Array:

@@ -72,8 +72,8 @@ class LlamaConfig:
     # (0 → default to the pipe degree)
     pipeline_microbatches: int = 0
     # LoRA delta scale (alpha; rank comes from the adapter shape).
-    # Only read when adapter leaves are present — models/lora.py
-    # injects them and `lora.configure` sets this to match.
+    # Only read when adapter leaves are present — `lora.inject`
+    # returns a config with this set to match its LoraConfig.
     lora_alpha: float = 16.0
 
     @property

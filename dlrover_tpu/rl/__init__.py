@@ -20,6 +20,7 @@ from dlrover_tpu.rl.ppo import (
 from dlrover_tpu.rl.model_engine import ModelEngine
 from dlrover_tpu.rl.replay_buffer import Experience, ReplayBuffer
 from dlrover_tpu.rl.generate import sample_tokens
+from dlrover_tpu.rl.serve import ContinuousBatcher
 
 __all__ = [
     "Experience",
@@ -31,4 +32,5 @@ __all__ = [
     "compute_gae",
     "ppo_loss",
     "sample_tokens",
+    "ContinuousBatcher",
 ]

@@ -739,12 +739,14 @@ class CheckpointEngine:
             # let an in-flight async staging land rather than tear the
             # saver/IPC down under it (the checkpoint would be lost)
             t.join(timeout=30.0)
-        if (
-            self._replica_thread is not None
-            and self._replica_thread.is_alive()
-        ):
+        # snapshot under the lock: _backup_drain nulls the slot from
+        # its own thread on exit, so unsynchronized attribute reads
+        # here can see None between the check and the join
+        with self._backup_lock:
+            rt = self._replica_thread
+        if rt is not None and rt.is_alive():
             # let an in-flight backup commit rather than die mid-write
-            self._replica_thread.join(timeout=30.0)
+            rt.join(timeout=30.0)
         if self._local_saver is not None:
             self._local_saver.stop()
             self._ipc.stop()
