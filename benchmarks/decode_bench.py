@@ -254,6 +254,11 @@ def main():
     emit(
         "serve_mixed_continuous_batching",
         useful / dt_cb,
+        # the serve scenario sizes itself; override the microbench
+        # metadata so the published row describes the real experiment
+        batch=serve_batch,
+        prompt_len=mix_prompt_max,
+        new_tokens=serve_new,
         lockstep_tok_per_s=round(useful / dt_lockstep, 1),
         speedup_vs_lockstep=round(dt_lockstep / max(dt_cb, 1e-9), 2),
         n_requests=n_req,

@@ -1,18 +1,16 @@
-"""Tiny-Llama memorization demo under the elastic launcher.
+"""GPT-2 training under the elastic launcher — the nanoGPT example
+of the reference (examples/pytorch/nanogpt/train.py), TPU-first.
 
-The TPU analogue of the reference's examples/pytorch/mnist/cnn_train.py:
-a small model trained through the full stack — `dlrover-tpu-run` starts a
-local master + agent, the agent supervises this script, and this script
-trains a tiny Llama with `accelerate()` over all local devices, reporting
-steps so the master's SpeedMonitor sees progress.
+Same harness as train_tiny_llama.py (full stack: master, agent,
+accelerate() over all local devices) but driving the GPT family
+(learned positions, pre-LN, tied head) through the SAME trainer
+machinery — models are (config, init, loss, rules) quadruples, so
+the family swap is data, not code.
 
 Flags:
   --steps N          training steps (default 30)
   --crash-at-step K  kill this process at step K on the FIRST attempt
-                     (restart-recovery demo; needs --max-restarts >= 1)
-  --ckpt-dir DIR     enable flash checkpointing: stage to agent shm every
-                     step, persist to DIR every 5 steps, resume on restart
-                     (the fcp_demo.py analogue)
+  --ckpt-dir DIR     flash checkpointing + resume
 """
 
 import argparse
@@ -28,13 +26,12 @@ from dlrover_tpu.utils.platform import ensure_cpu_if_forced
 ensure_cpu_if_forced()
 
 import jax
-import jax.numpy as jnp
 import optax
 
 import dlrover_tpu
 from dlrover_tpu.agent.monitor import write_step_metrics
 from dlrover_tpu.common.constants import NodeEnv
-from dlrover_tpu.models import llama
+from dlrover_tpu.models import gpt
 from dlrover_tpu.parallel.accelerate import Strategy, accelerate
 from dlrover_tpu.parallel.mesh import MeshSpec
 
@@ -47,15 +44,12 @@ def main():
     args = p.parse_args()
 
     restart_count = int(os.environ.get(NodeEnv.RESTART_COUNT, "0"))
-    # join the multi-host world the agent rendezvoused for us (no-op on
-    # single-node runs); installs the membership watch so this process
-    # restarts itself when the world changes
     dlrover_tpu.init()
-    cfg = llama.LlamaConfig.tiny()
+    cfg = gpt.GptConfig.tiny()
     acc = accelerate(
-        init_params=lambda k: llama.init_params(cfg, k),
-        loss_fn=lambda pm, b, m: llama.loss_fn(cfg, pm, b, mesh=m),
-        rules=llama.partition_rules(cfg),
+        init_params=lambda k: gpt.init_params(cfg, k),
+        loss_fn=lambda pm, b, m: gpt.loss_fn(cfg, pm, b, mesh=m),
+        rules=gpt.partition_rules(cfg),
         optimizer=optax.adam(1e-2),
         strategy=Strategy(mesh=MeshSpec.fit(jax.device_count())),
     )
@@ -86,22 +80,11 @@ def main():
             os._exit(17)
         state, metrics = acc.train_step(state, batch)
         loss = float(metrics["loss"])
-        if first_loss is None:
-            first_loss = loss
+        first_loss = first_loss if first_loss is not None else loss
         last_loss = loss
         write_step_metrics(step)
-        if ckpt is not None:
-            kind = (
-                StorageType.DISK
-                if step % 5 == 0
-                else StorageType.MEMORY
-            )
-            blocked = ckpt.save_checkpoint(step, state, kind)
-            if step % 10 == 0:
-                print(
-                    f"ckpt step {step} staged in {blocked*1e3:.1f} ms",
-                    flush=True,
-                )
+        if ckpt is not None and step % 5 == 0:
+            ckpt.save_checkpoint(step, state, StorageType.DISK)
         if step % 10 == 0 or step == 1:
             print(f"step {step} loss {loss:.4f}", flush=True)
 

