@@ -36,6 +36,12 @@ class PpoConfig:
     max_len: int = 32
     temperature: float = 1.0
     gae: GaeConfig = GaeConfig()
+    # "auto": lockstep sampler (cached for llama-family actors).
+    # "continuous": slot-based continuous batching (rl/serve.py) —
+    # keeps the chip busy at mixed rollout lengths (reference hands
+    # this to vLLM, vllm_backend.py:24); requires a llama/GPT-family
+    # actor (model_cfg) since it rides the KV-cache decode path.
+    rollout_engine: str = "auto"
 
 
 def compute_gae(
@@ -166,7 +172,23 @@ class PpoTrainer:
             model_cfg, "n_experts", 0
         ):
             model_cfg = None
-        if model_cfg is not None:
+        if cfg.rollout_engine not in ("auto", "continuous"):
+            raise ValueError(
+                f"unknown rollout_engine {cfg.rollout_engine!r}: "
+                "expected 'auto' or 'continuous'"
+            )
+        if cfg.rollout_engine == "continuous":
+            if model_cfg is None:
+                raise ValueError(
+                    "rollout_engine='continuous' needs a llama/GPT-"
+                    "family actor (KV-cache decode); this actor has "
+                    "none (or is MoE, whose S=1 decode logits are "
+                    "off-policy)"
+                )
+            tokens = self._continuous_rollout(
+                model_cfg, prompts, prompt_lens, key
+            )
+        elif model_cfg is not None:
             # llama-family actor: KV-cache rollout engine (O(1) qkv per
             # step instead of a full forward). Greedy outputs are
             # byte-identical to the generic sampler
@@ -199,6 +221,67 @@ class PpoTrainer:
             )
         logp = eng.actor_logprobs(tokens)         # [B, L-1]
         ref_logp = eng.ref_logprobs(tokens)
+        return self._finish_experience(
+            tokens, prompt_lens, logp, ref_logp
+        )
+
+    def _continuous_rollout(
+        self, model_cfg, prompts, prompt_lens, key
+    ) -> jnp.ndarray:
+        """Mixed-length rollout through the slot engine; returns the
+        same padded [B, max_len] token buffer the lockstep samplers
+        produce (the PPO math downstream is engine-agnostic — the
+        behavior logprobs are recomputed teacher-forced either way)."""
+        from dlrover_tpu.rl.serve import ContinuousBatcher
+
+        cfg = self.cfg
+        B = prompts.shape[0]
+        cb = getattr(self, "_cb", None)
+        if cb is None or cb.n_slots != B:
+            cb = ContinuousBatcher(
+                model_cfg,
+                self.engine.actor.params,
+                n_slots=B,
+                max_len=cfg.max_len,
+                max_new_tokens=cfg.max_len,
+                temperature=cfg.temperature,
+                eos_id=self.eos_id if self.eos_id >= 0 else None,
+                pad_id=0,
+            )
+            self._cb = cb
+        else:
+            # PPO updated the actor since the last rollout: swap the
+            # served weights (stale-policy rollouts otherwise)
+            cb.update_params(self.engine.actor.params)
+        cb.key = key
+        p_np = np.asarray(prompts)
+        lens = np.asarray(prompt_lens)
+        submitted = []  # rows with room to generate, in order
+        for b in range(B):
+            n = int(lens[b])
+            if n >= cfg.max_len:
+                # buffer-filling prompt: nothing to generate — the
+                # lockstep engines emit a zero-generation row here
+                # and so do we (submit() rejects max_new < 1)
+                continue
+            cb.submit(p_np[b, :n], max_new=cfg.max_len - n)
+            submitted.append(b)
+        outs = cb.generate_all([]) if submitted else []
+        toks = np.zeros((B, cfg.max_len), p_np.dtype)
+        for b in range(B):
+            n = int(lens[b])
+            toks[b, :n] = p_np[b, :n]
+        for b, out in zip(submitted, outs):
+            n = int(lens[b])
+            m = min(len(out), cfg.max_len - n)
+            toks[b, n : n + m] = out[:m]
+        return jnp.asarray(toks)
+
+    def _finish_experience(
+        self, tokens, prompt_lens, logp, ref_logp
+    ) -> Experience:
+        cfg = self.cfg
+        eng = self.engine
         values = eng.values(tokens)[:, :-1]       # [B, L-1]
         seq_reward = eng.rewards(tokens, prompt_lens)  # [B]
 

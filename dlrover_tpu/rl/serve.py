@@ -126,9 +126,9 @@ class ContinuousBatcher:
             )
 
         @partial(
-            jax.jit, donate_argnums=(0,), static_argnums=(6,)
+            jax.jit, donate_argnums=(0,), static_argnums=(7,)
         )
-        def _run_chunk(cache, tok, pos, done, limit, key, k):
+        def _run_chunk(cache, params, tok, pos, done, limit, key, k):
             def body(carry, _):
                 cache, tok, pos, done, key = carry
                 logits, cache = decode_step(
@@ -161,7 +161,7 @@ class ContinuousBatcher:
         # log2(max_len) shapes total); cache donated so an admission
         # updates in place instead of copying the whole slot bank
         @partial(jax.jit, donate_argnums=(0,))
-        def _admit_fn(cache, prompt, slot):
+        def _admit_fn(cache, params, prompt, slot):
             return prefill_into_slot(cfg, params, prompt, cache, slot)
 
         self._admit_fn = _admit_fn
@@ -187,6 +187,13 @@ class ContinuousBatcher:
             if not self.done[s]
         )
         return max(1, min(rem, self.chunk))
+
+    def update_params(self, params) -> None:
+        """Swap the served weights (e.g. after a PPO update). Shapes
+        must match; the compiled programs are reused as-is. Call
+        between generate_all() drains — mid-drain the batch would mix
+        policies."""
+        self.params = params
 
     # -- admission ---------------------------------------------------------
 
@@ -223,7 +230,7 @@ class ContinuousBatcher:
         padded = np.full(bucket, self.pad_id, np.int32)
         padded[:p] = req.prompt
         self.cache = self._admit_fn(
-            self.cache, jnp.asarray(padded), slot
+            self.cache, self.params, jnp.asarray(padded), slot
         )
         # carry = last REAL prompt token at its position: the first
         # chunk step recomputes its logits (identical K/V rewrite)
@@ -257,6 +264,7 @@ class ContinuousBatcher:
             old_pos = self.pos.copy()
             cache, tok, pos, done, key, emitted = self._run_chunk(
                 self.cache,
+                self.params,
                 jnp.asarray(self.tok),
                 jnp.asarray(self.pos),
                 jnp.asarray(self.done),
@@ -288,5 +296,9 @@ class ContinuousBatcher:
             np.asarray(r.out, np.int32)
             for r in self._requests[self._returned:]
         ]
-        self._returned = len(self._requests)
+        # drain complete: drop the request ledger, or a long-lived
+        # engine (e.g. one PPO trainer across 100k rollouts) retains
+        # every prompt + output list ever served and leaks host RAM
+        self._requests = []
+        self._returned = 0
         return out

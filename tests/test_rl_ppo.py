@@ -220,3 +220,131 @@ class TestEosCredit:
             first = eos_hits[0]
             # positions after the first EOS are masked out
             assert exp.mask[b, first + 1 :].sum() == 0
+
+
+class TestContinuousRollout:
+    """rollout_engine='continuous' (rl/serve.py) plugged into PPO:
+    greedy tokens match the lockstep cached engine, and a full PPO
+    step trains (reference: vLLM rollouts, vllm_backend.py:24)."""
+
+    def _llama_engine(self, seed=0):
+        import dataclasses
+
+        from dlrover_tpu.models import llama
+
+        cfg = dataclasses.replace(
+            llama.LlamaConfig.tiny(), dtype=jnp.float32
+        )
+        k = jax.random.PRNGKey(seed)
+        ka, kc = jax.random.split(k)
+        actor_params = llama.init_params(cfg, ka)
+        return cfg, ModelEngine(
+            actor=ModelSpec(
+                lambda p, t: llama.apply(cfg, p, t),
+                actor_params,
+                trainable=True,
+                model_cfg=cfg,
+            ),
+            critic=ModelSpec(
+                _critic_apply, _init_critic(kc), trainable=True
+            ),
+            reward_fn=_reward,
+        )
+
+    def _mixed_prompts(self, batch=6):
+        rng = np.random.default_rng(7)
+        lens = rng.integers(1, 6, size=batch)
+        prompts = np.zeros((batch, MAX_LEN), np.int32)
+        for b, n in enumerate(lens):
+            prompts[b, :n] = rng.integers(1, 250, size=n)
+        return (
+            jnp.asarray(prompts),
+            jnp.asarray(lens, jnp.int32),
+        )
+
+    def test_greedy_tokens_match_lockstep(self):
+        cfg, eng = self._llama_engine()
+        prompts, lens = self._mixed_prompts()
+        key = jax.random.PRNGKey(5)
+        auto = PpoTrainer(
+            eng, PpoConfig(max_len=MAX_LEN, temperature=0.0)
+        )
+        cont = PpoTrainer(
+            eng,
+            PpoConfig(
+                max_len=MAX_LEN,
+                temperature=0.0,
+                rollout_engine="continuous",
+            ),
+        )
+        exp_a = auto.make_experience(prompts, lens, key)
+        exp_c = cont.make_experience(prompts, lens, key)
+        np.testing.assert_array_equal(exp_a.tokens, exp_c.tokens)
+        np.testing.assert_allclose(
+            exp_a.logprobs, exp_c.logprobs, atol=1e-5
+        )
+
+    def test_ppo_step_trains(self):
+        cfg, eng = self._llama_engine(seed=1)
+        trainer = PpoTrainer(
+            eng,
+            PpoConfig(
+                max_len=MAX_LEN,
+                minibatch_size=4,
+                rollout_engine="continuous",
+            ),
+        )
+        prompts, lens = self._mixed_prompts(4)
+        metrics = trainer.step(prompts, lens, jax.random.PRNGKey(0))
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_generic_actor_rejected(self):
+        eng = _engine()
+        trainer = PpoTrainer(
+            eng,
+            PpoConfig(
+                max_len=MAX_LEN, rollout_engine="continuous"
+            ),
+        )
+        prompts, lens = _prompts(4)
+        with pytest.raises(ValueError, match="continuous"):
+            trainer.make_experience(
+                prompts, lens, jax.random.PRNGKey(0)
+            )
+
+    def test_full_length_prompt_zero_generation(self):
+        """A prompt that fills the buffer generates nothing — same as
+        the lockstep engines — instead of tripping submit()'s
+        max_new validation."""
+        cfg, eng = self._llama_engine(seed=2)
+        rng = np.random.default_rng(9)
+        prompts = jnp.asarray(
+            rng.integers(1, 250, size=(3, MAX_LEN)), jnp.int32
+        )
+        lens = jnp.asarray([MAX_LEN, 2, MAX_LEN], jnp.int32)
+        trainer = PpoTrainer(
+            eng,
+            PpoConfig(
+                max_len=MAX_LEN,
+                temperature=0.0,
+                rollout_engine="continuous",
+            ),
+        )
+        exp = trainer.make_experience(
+            prompts, lens, jax.random.PRNGKey(0)
+        )
+        assert exp.mask[0].sum() == 0  # nothing trainable on row 0
+        assert exp.mask[2].sum() == 0
+        assert exp.mask[1].sum() > 0
+
+    def test_unknown_engine_rejected(self):
+        cfg, eng = self._llama_engine(seed=3)
+        trainer = PpoTrainer(
+            eng,
+            PpoConfig(max_len=MAX_LEN, rollout_engine="continous"),
+        )
+        prompts, lens = self._mixed_prompts(2)
+        with pytest.raises(ValueError, match="unknown rollout_engine"):
+            trainer.make_experience(
+                prompts, lens, jax.random.PRNGKey(0)
+            )
