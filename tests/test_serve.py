@@ -188,3 +188,30 @@ class TestPerSlotDecode:
         np.testing.assert_array_equal(
             before, np.array(cache["k"][:, 0])
         )
+
+
+def test_dispatch_lengths_are_pow2_bounded(model):
+    """Compile-cost invariant: every dispatched scan length is a
+    power of two or the full chunk — each distinct k is its own
+    compiled program (~tens of seconds on real hardware), so
+    arbitrary tail values would silently reintroduce per-k
+    recompiles that CPU tests cannot feel."""
+    cfg, params = model
+    cb = ContinuousBatcher(
+        cfg, params, n_slots=3, max_len=64,
+        max_new_tokens=13, chunk=8,
+    )
+    seen = []
+    orig = cb._run_chunk
+
+    def spy(cache, params_, tok, pos, done, limit, key, k):
+        seen.append(k)
+        return orig(cache, params_, tok, pos, done, limit, key, k)
+
+    cb._run_chunk = spy
+    prompts = _prompts((5, 9, 3, 12, 7), seed=21)
+    for pr, cap in zip(prompts, (13, 3, 7, 5, 11)):
+        cb.submit(pr, max_new=cap)
+    cb.generate_all([])
+    allowed = {1, 2, 4, 8}
+    assert seen and set(seen) <= allowed, seen
