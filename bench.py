@@ -409,6 +409,14 @@ def main():
     waited_s = _wait_for_backend(watchdog)
     watchdog.beat("backend init + first compile")
 
+    # persistent compile cache: any earlier run of this bench (e.g.
+    # the tunnel-waiter suite) primes it, so the driver's capture run
+    # compiles in seconds instead of ~35 s — keeping time-to-first-
+    # number inside the tunnel's flap window
+    from dlrover_tpu.runtime import enable_compile_cache
+
+    enable_compile_cache()
+
     import jax
     import jax.numpy as jnp
     import optax

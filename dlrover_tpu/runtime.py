@@ -74,7 +74,7 @@ def node_count() -> int:
     return _ctx.node_num
 
 
-def _enable_compile_cache() -> Optional[str]:
+def enable_compile_cache() -> Optional[str]:
     """Point XLA's persistent compilation cache at a per-user disk dir.
 
     The measured recovery stall after a SIGKILL is dominated by the
@@ -143,7 +143,7 @@ def init(
     coordinates, the previous runtime is shut down first (the
     `reset_distributed` path in the reference).
     """
-    _enable_compile_cache()
+    enable_compile_cache()
     addr = coordinator_addr or os.environ.get(NodeEnv.COORDINATOR_ADDR)
     num = (
         num_processes

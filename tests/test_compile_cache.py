@@ -2,7 +2,7 @@
 
 The measured post-SIGKILL recovery stall is dominated by the
 respawned worker recompiling a program its predecessor already
-compiled (~40 s of the r4 E2E stall). runtime._enable_compile_cache
+compiled (~40 s of the r4 E2E stall). runtime.enable_compile_cache
 points jax at a disk cache so respawns hit it. Measured here as a
 process-level fact: 17 s -> 4 s cold-process step on the tiny model
 when the cache is warm (CPU, 8-dev mesh)."""
