@@ -1,0 +1,34 @@
+"""Shared lockstep-generate oracle for the serving tests.
+
+ONE implementation of "run decode.generate per prompt and strip the
+pad tail" — with pad_id=-1 (outside the vocab) so a genuinely
+emitted token 0 is never misread as padding. Used by test_serve.py
+and test_serve_property.py so the eos/pad semantics cannot drift
+between the fixed cases and the fuzz."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.models import decode
+
+
+def lockstep_oracle(
+    cfg, params, prompt, max_new, eos_id=None, pad_id=-1,
+    max_len=None,
+):
+    """Continuation (eos included when hit, pad tail stripped) the
+    lockstep engine produces for one prompt."""
+    out = np.asarray(
+        decode.generate(
+            cfg, params, jnp.asarray([list(prompt)], jnp.int32),
+            max_new, eos_id=eos_id, pad_id=pad_id, max_len=max_len,
+        )
+    )[0, len(prompt):]
+    if eos_id is None:
+        return list(map(int, out))
+    keep = []
+    for t in out:
+        if t == pad_id:
+            break
+        keep.append(int(t))
+    return keep

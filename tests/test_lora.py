@@ -75,22 +75,28 @@ class TestInjection:
             lora.LoraConfig(rank=2, dropout=0.1)
 
 
+def _adapted(targets=("wq", "wv"), seed=3):
+    """(cfg, params) with injected adapters whose B factors are
+    non-trivial, so the delta is live — the shared fixture of every
+    merge/serving parity test."""
+    cfg = _cfg()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    lc = lora.LoraConfig(rank=4, alpha=8.0)
+    cfg, p = lora.inject(cfg, params, lc, jax.random.PRNGKey(1))
+    for t in targets:
+        p["layers"][t + "_lora_b"] = (
+            jax.random.normal(
+                jax.random.PRNGKey(seed),
+                p["layers"][t + "_lora_b"].shape,
+            )
+            * 0.05
+        )
+    return cfg, p
+
+
 class TestMerge:
     def _adapted(self, seed=3):
-        cfg = _cfg()
-        params = llama.init_params(cfg, jax.random.PRNGKey(0))
-        lc = lora.LoraConfig(rank=4, alpha=8.0)
-        cfg, p = lora.inject(cfg, params, lc, jax.random.PRNGKey(1))
-        # non-trivial B so the delta is live
-        for t in ("wq", "wv"):
-            p["layers"][t + "_lora_b"] = (
-                jax.random.normal(
-                    jax.random.PRNGKey(seed),
-                    p["layers"][t + "_lora_b"].shape,
-                )
-                * 0.05
-            )
-        return cfg, p
+        return _adapted(seed=seed)
 
     def test_merge_logit_parity_f32(self):
         cfg, p = self._adapted()
@@ -297,3 +303,48 @@ class TestShardedLora:
         )
         state, metrics = acc.train_step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestLoraServing:
+    """Adapters apply in the KV-cache decode path too (the one
+    _compute_weights merge site serves training, generate(), and the
+    continuous batcher) — a fine-tuned model serves WITHOUT merging."""
+
+    def test_decode_logits_with_adapters_match_merged(self):
+        """Logits-level comparison (NOT greedy tokens — x@W + s(x@A)@B
+        vs x@(W+sAB) differ by float rounding, and a near-tie argmax
+        flip would make token equality flaky across toolchains)."""
+        from dlrover_tpu.models import decode
+
+        cfg, p = _adapted(targets=("wq",))
+        base = llama.init_params(_cfg(), jax.random.PRNGKey(0))
+        prompt = _tokens(2, 9)
+        cache_a = decode.init_kv_cache(cfg, 2, 16)
+        cache_m = decode.init_kv_cache(cfg, 2, 16)
+        la, _ = decode.prefill(cfg, p, prompt, cache_a)
+        lm, _ = decode.prefill(
+            cfg, lora.merge(cfg, p), prompt, cache_m
+        )
+        np.testing.assert_allclose(
+            np.asarray(la), np.asarray(lm), atol=1e-5, rtol=1e-5
+        )
+        # and the adapters actually moved the decode-path logits
+        cache_b = decode.init_kv_cache(cfg, 2, 16)
+        lb, _ = decode.prefill(cfg, base, prompt, cache_b)
+        assert np.abs(np.asarray(la) - np.asarray(lb)).max() > 1e-3
+
+    def test_continuous_batcher_serves_adapters(self):
+        """Same params through serve and generate: identical
+        computation, so token equality is exact here."""
+        from dlrover_tpu.rl.serve import ContinuousBatcher
+        from _serve_oracle import lockstep_oracle
+
+        cfg, p = _adapted(targets=("wv",), seed=5)
+        prompts = [[5, 17, 42], [9, 3, 8, 11, 2]]
+        cb = ContinuousBatcher(
+            cfg, p, n_slots=2, max_len=32, max_new_tokens=6
+        )
+        res = cb.generate_all(prompts)
+        for pr, r in zip(prompts, res):
+            want = lockstep_oracle(cfg, p, pr, 6, pad_id=0)
+            assert list(map(int, r)) == want

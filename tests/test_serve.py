@@ -32,23 +32,15 @@ def _prompts(lengths, seed=0):
     ]
 
 
+from _serve_oracle import lockstep_oracle
+
+
 def _baseline(cfg, params, prompt, max_new, eos_id=None):
-    """Per-prompt lockstep generate -> continuation (eos included,
-    pad tail stripped)."""
-    out = np.asarray(
-        decode.generate(
-            cfg, params, jnp.asarray([prompt], jnp.int32), max_new,
-            eos_id=eos_id, pad_id=0,
-        )
-    )[0, len(prompt):]
-    if eos_id is None:
-        return list(map(int, out))
-    keep = []
-    for t in out:
-        if t == 0:
-            break
-        keep.append(int(t))
-    return keep
+    """Per-prompt lockstep oracle (shared impl; pad_id=0 matches the
+    engines constructed in this file)."""
+    return lockstep_oracle(
+        cfg, params, prompt, max_new, eos_id=eos_id, pad_id=0
+    )
 
 
 class TestParity:

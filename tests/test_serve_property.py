@@ -27,27 +27,16 @@ _MAX_LEN = 48
 _ORACLE_CACHE = {}
 
 
+from _serve_oracle import lockstep_oracle
+
+
 def _oracle(prompt, cap, eos_id):
     key = (tuple(prompt), cap, eos_id)
     if key not in _ORACLE_CACHE:
-        out = np.asarray(
-            decode.generate(
-                _CFG, _PARAMS, jnp.asarray([prompt], jnp.int32),
-                cap, eos_id=eos_id, pad_id=-1, max_len=_MAX_LEN,
-            )
-        )[0, len(prompt):]
-        if eos_id is None:
-            want = list(map(int, out))
-        else:
-            # pad_id=-1 is outside the vocab (sampled ids are 0..255),
-            # so the pad tail is unambiguous even if the model emits
-            # a genuine token 0 mid-sequence
-            want = []
-            for t in out:
-                if t == -1:
-                    break
-                want.append(int(t))
-        _ORACLE_CACHE[key] = want
+        _ORACLE_CACHE[key] = lockstep_oracle(
+            _CFG, _PARAMS, prompt, cap, eos_id=eos_id,
+            pad_id=-1, max_len=_MAX_LEN,
+        )
     return _ORACLE_CACHE[key]
 
 
