@@ -33,25 +33,71 @@ Params = Dict
 
 
 def init_kv_cache(
-    cfg, batch: int, max_len: int
+    cfg, batch: int, max_len: int, quant: bool = False
 ) -> Dict[str, jax.Array]:
     """Fixed-size cache buffers; dtype follows compute dtype. Works for
     any family config with n_layers/n_heads/head_dim (GPT has no GQA,
-    so its KV head count is n_heads)."""
+    so its KV head count is n_heads).
+
+    quant=True stores K/V as symmetric per-vector int8 (+ one bf16
+    scale per [position, head]) — the fp8-KV-cache idea of serving
+    stacks (vLLM), sized for TPU HBM: cache bytes drop ~2x (int8 +
+    1/hd scale overhead vs bf16), and decode attention, which is
+    bound on reading the whole cache every step, reads half the
+    bytes. Dequantization fuses into the attention einsum's loads.
+    Opt-in: exact-parity paths (tests, PPO behavior-policy concerns)
+    keep the full-precision default."""
     kv_heads = getattr(cfg, "n_kv_heads", cfg.n_heads)
     shape = (cfg.n_layers, batch, max_len, kv_heads, cfg.head_dim)
+    if not quant:
+        return {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+        }
+    scale_shape = shape[:-1] + (1,)
+    # bf16 scales: the quantum is 1/127 of the vector max, so the
+    # scale's own 2^-8 relative error is noise — and f32 scales
+    # would double the overhead at small head_dims
     return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.zeros(scale_shape, jnp.bfloat16),
+        "v_scale": jnp.zeros(scale_shape, jnp.bfloat16),
     }
 
 
-def _cached_attention(q, k_cache, v_cache, q_positions, scale):
+def _kv_quantize(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-vector int8: one scale per [..., head] vector
+    (max|x|/127). Same formulation as ops/quantization.py's row
+    scheme, at KV granularity."""
+    scale = jnp.max(
+        jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True
+    ) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def _cached_attention(q, layer_cache, q_positions, scale):
     """q [B,S,H,hd] attends over the whole cache [B,M,KV,hd] under the
     causal position mask (cache col j visible to query at position p
     iff j <= p). Unwritten cache slots are masked out by the same rule.
     GQA runs as a grouped einsum against the UNEXPANDED cache — no
-    n_rep-times repeat of the K/V buffers per step."""
+    n_rep-times repeat of the K/V buffers per step. Quantized caches
+    dequantize here (int8 * per-vector scale), where XLA fuses the
+    multiply into the einsum's cache loads."""
+    k_cache, v_cache = layer_cache["k"], layer_cache["v"]
+    if "k_scale" in layer_cache:
+        k_cache = (
+            k_cache.astype(q.dtype)
+            * layer_cache["k_scale"].astype(q.dtype)
+        )
+        v_cache = (
+            v_cache.astype(q.dtype)
+            * layer_cache["v_scale"].astype(q.dtype)
+        )
     b, s, h, hd = q.shape
     m = k_cache.shape[1]
     kv = k_cache.shape[2]
@@ -69,8 +115,29 @@ def _cached_attention(q, k_cache, v_cache, q_positions, scale):
     return out.reshape(b, s, h, hd)
 
 
+def _cache_write(cache_arr, update, start):
+    """Write `update` [B,S,...] into `cache_arr` [B,M,...] at offset
+    `start` — scalar (all rows same offset) or [B] per-row vector
+    (vmapped dynamic_update_slice → scatter)."""
+    # per-row dims = the M/S axis plus the trailing dims; the
+    # index tuples below need nd-1 trailing zeros after the
+    # offset entry
+    nd = update.ndim - 1
+    if getattr(start, "ndim", 0) == 1:
+        return jax.vmap(
+            lambda cr, ur, s: jax.lax.dynamic_update_slice(
+                cr, ur.astype(cr.dtype), (s,) + (0,) * (nd - 1)
+            )
+        )(cache_arr, update, start)
+    return jax.lax.dynamic_update_slice(
+        cache_arr,
+        update.astype(cache_arr.dtype),
+        (0, start) + (0,) * (nd - 1),
+    )
+
+
 def _write_cache_and_attend(
-    q, k, v, k_cache, v_cache, positions, start, head_dim,
+    q, k, v, layer_cache, positions, start, head_dim,
     attn_impl: str = "auto",
     plain_causal: bool = False,
 ):
@@ -91,25 +158,26 @@ def _write_cache_and_attend(
     `start` may be a scalar (all rows write at the same offset — the
     lockstep generate() path) or a [B] vector of per-row offsets (the
     continuous-batching path, rl/serve.py: every slot sits at its own
-    length). The vector case lowers to a per-row scatter via vmapped
-    dynamic_update_slice."""
-    if getattr(start, "ndim", 0) == 1:
-        def _row_write(c, u):
-            return jax.vmap(
-                lambda cr, ur, s: jax.lax.dynamic_update_slice(
-                    cr, ur.astype(cr.dtype), (s, 0, 0)
-                )
-            )(c, u, start)
+    length; _cache_write vmaps to a scatter).
 
-        k_cache = _row_write(k_cache, k)
-        v_cache = _row_write(v_cache, v)
+    `layer_cache` is this layer's {"k","v"[,"k_scale","v_scale"]};
+    quantized caches get the chunk's K/V int8-quantized on write and
+    dequantized inside the masked attention."""
+    out_cache = dict(layer_cache)
+    if "k_scale" in layer_cache:
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+        out_cache["k"] = _cache_write(layer_cache["k"], kq, start)
+        out_cache["v"] = _cache_write(layer_cache["v"], vq, start)
+        out_cache["k_scale"] = _cache_write(
+            layer_cache["k_scale"], ks, start
+        )
+        out_cache["v_scale"] = _cache_write(
+            layer_cache["v_scale"], vs, start
+        )
     else:
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, start, 0, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, start, 0, 0)
-        )
+        out_cache["k"] = _cache_write(layer_cache["k"], k, start)
+        out_cache["v"] = _cache_write(layer_cache["v"], v, start)
     if plain_causal:
         from dlrover_tpu.ops.attention import dot_product_attention
 
@@ -124,21 +192,20 @@ def _write_cache_and_attend(
         )
     else:
         attn = _cached_attention(
-            q, k_cache, v_cache, positions, float(head_dim) ** -0.5
+            q, out_cache, positions, float(head_dim) ** -0.5
         )
-    return attn, k_cache, v_cache
+    return attn, out_cache
 
 
 def _block(
     cfg: LlamaConfig,
     x: jax.Array,            # [B, S, D]
     layer_params: Params,
-    k_cache: jax.Array,      # [B, M, KV, hd]
-    v_cache: jax.Array,
+    layer_cache: Dict[str, jax.Array],  # per-layer k/v(+scales)
     positions: jax.Array,    # [B, S] global positions of x's tokens
     start,                   # scalar: cache slot of x's first token
     plain_causal: bool = False,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One decoder block writing its K/V into the cache. Prefill is
     S=prompt_len/start=0; decode is S=1/start=pos. The projections,
     RoPE, residuals and MLP are llama._layer's own helpers — the cache
@@ -147,18 +214,18 @@ def _block(
     lp = _compute_weights(cfg, layer_params)
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
     q, k, v = _attn_qkv(cfg, None, h, lp, positions)
-    attn, k_cache, v_cache = _write_cache_and_attend(
-        q, k, v, k_cache, v_cache, positions, start, cfg.head_dim,
+    attn, layer_cache = _write_cache_and_attend(
+        q, k, v, layer_cache, positions, start, cfg.head_dim,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
         plain_causal=plain_causal,
     )
     x = _attn_residual(cfg, None, x, attn, lp)
     x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
-    return x, k_cache, v_cache
+    return x, layer_cache
 
 
 def _block_gpt(
-    cfg, x, lp, k_cache, v_cache, positions, start,
+    cfg, x, lp, layer_cache, positions, start,
     plain_causal: bool = False,
 ):
     """GPT-2 pre-LN block with cache write — built from gpt.py's own
@@ -167,14 +234,14 @@ def _block_gpt(
     from dlrover_tpu.models import gpt
 
     q, k, v = gpt._attn_qkv(cfg, x, lp)
-    attn, k_cache, v_cache = _write_cache_and_attend(
-        q, k, v, k_cache, v_cache, positions, start, cfg.head_dim,
+    attn, layer_cache = _write_cache_and_attend(
+        q, k, v, layer_cache, positions, start, cfg.head_dim,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
         plain_causal=plain_causal,
     )
     x = gpt._attn_residual(cfg, x, attn, lp)
     x = gpt._mlp_residual(cfg, x, lp)
-    return x, k_cache, v_cache
+    return x, layer_cache
 
 
 def _is_gpt(cfg) -> bool:
@@ -216,15 +283,17 @@ def _forward_cached(
 
     def body(carry, inp):
         h = carry
-        layer_params, kc, vc = inp
-        h, kc, vc = block(
-            cfg, h, layer_params, kc, vc, positions, start,
+        layer_params, layer_cache = inp
+        h, layer_cache = block(
+            cfg, h, layer_params, layer_cache, positions, start,
             plain_causal=plain_causal,
         )
-        return h, (kc, vc)
+        return h, layer_cache
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
+    # the cache dict scans as a pytree: each layer body sees its own
+    # {"k","v"[,"k_scale","v_scale"]} slice and emits the updated one
+    x, cache_new = jax.lax.scan(
+        body, x, (params["layers"], dict(cache))
     )
     if gpt:
         from dlrover_tpu.models.gpt import _layer_norm
@@ -239,7 +308,7 @@ def _forward_cached(
         )
         head = _head_matrix(cfg, params)
     logits = (x @ head).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, cache_new
 
 
 def prefill(
@@ -307,14 +376,14 @@ def prefill_into_slot(
             f"prompt chunk {p} exceeds cache max_len "
             f"{cache['k'].shape[2]}"
         )
-    mini = init_kv_cache(cfg, 1, p)
+    mini = init_kv_cache(cfg, 1, p, quant="k_scale" in cache)
     _, mini = prefill(cfg, params, prompt[None], mini)
     out = {}
-    for name in ("k", "v"):
+    for name, arr in cache.items():
         out[name] = jax.lax.dynamic_update_slice(
-            cache[name],
-            mini[name].astype(cache[name].dtype),
-            (0, slot, 0, 0, 0),
+            arr,
+            mini[name].astype(arr.dtype),
+            (0, slot) + (0,) * (arr.ndim - 2),
         )
     return out
 
@@ -366,6 +435,7 @@ def generate(
     top_p: float = 1.0,
     eos_id: Optional[int] = None,
     pad_id: int = 0,
+    kv_quant: bool = False,
 ) -> jax.Array:
     """Greedy / temperature sampling with the KV cache; one compiled
     scan drives all steps. Returns [B, P + max_new_tokens].
@@ -402,7 +472,7 @@ def generate(
         return prompt
     if key is None:
         key = jax.random.PRNGKey(0)
-    cache = init_kv_cache(cfg, b, m)
+    cache = init_kv_cache(cfg, b, m, quant=kv_quant)
     logits, cache = prefill(cfg, params, prompt, cache)
 
     def sample(logits, key):

@@ -86,6 +86,7 @@ class ContinuousBatcher:
         pad_id: int = 0,
         chunk: int = 8,   # steps per dispatch; see _next_chunk_len
         seed: int = 0,
+        kv_quant: bool = False,  # int8 KV cache (~2x slots per HBM)
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -102,7 +103,9 @@ class ContinuousBatcher:
         self.pad_id = pad_id
         self.chunk = chunk
         self.key = jax.random.PRNGKey(seed)
-        self.cache = init_kv_cache(cfg, n_slots, max_len)
+        self.cache = init_kv_cache(
+            cfg, n_slots, max_len, quant=kv_quant
+        )
         # host-side slot state (tiny [B] vectors; shipped per chunk)
         self.tok = np.full(n_slots, pad_id, np.int32)
         self.pos = np.zeros(n_slots, np.int32)
