@@ -133,6 +133,51 @@ def main():
         batch / dt,
         ms_per_token=round(dt * 1e3, 2),
     )
+    dt_full = dt
+
+    # ---- per-token decode, int8 KV cache --------------------------------
+    # decode attention reads the whole cache every step; the int8
+    # cache halves those bytes (the HBM-bound leg on chip)
+    cache_q0 = decode.init_kv_cache(cfg, batch, max_len, quant=True)
+    lgq, cache_q = jax.jit(
+        lambda p, t, c: decode.prefill(cfg, p, t, c)
+    )(params, prompt, cache_q0)
+    device_fence(lgq)
+    dsq = jax.jit(
+        lambda p, tok, c, pos: decode.decode_step(cfg, p, tok, c, pos)
+    )
+    lgq, cache_q1 = dsq(params, tok, cache_q, prompt_len)  # compile
+    device_fence(lgq)
+    qpos_box = {"c": cache_q, "i": 0}
+
+    def _chain_q():
+        lg = None
+        for _ in range(steps):
+            lg, qpos_box["c"] = dsq(
+                params, tok, qpos_box["c"],
+                prompt_len + qpos_box["i"],
+            )
+            qpos_box["i"] += 1
+        return lg
+
+    chain_s, _ = timed_with_fence(_chain_q, iters=1, warmup=1)
+    dt = chain_s / steps
+    emit(
+        "decode_per_token_kv_quant",
+        batch / dt,
+        ms_per_token=round(dt * 1e3, 2),
+        speedup_vs_full=round(dt_full / max(dt, 1e-9), 2),
+        cache_bytes_ratio=round(
+            sum(v.nbytes for v in cache_q0.values())
+            / sum(
+                v.nbytes
+                for v in decode.init_kv_cache(
+                    cfg, batch, max_len
+                ).values()
+            ),
+            3,
+        ),
+    )
 
     # ---- generate: cached scan vs uncached full re-forward ---------------
     gen = jax.jit(
