@@ -10,8 +10,14 @@ TPU notes: scaling changes the SPMD world, so executing a worker-count
 plan also bumps the rendezvous round (agents re-join, jax re-inits over
 the new mesh) — the scaler only moves pods; the rendezvous manager owns
 re-formation.
+
+Serving path: `ServingScaleAdvisor` consumes the queue-pressure hints
+the inference replica pool writes into the master KV store
+(serving/replica.py) and turns them into ScalePlans for the replica
+node group — the control plane scales training AND serving workloads.
 """
 
+import json
 import threading
 import time
 from typing import Dict, Optional
@@ -134,3 +140,76 @@ class JobAutoScaler:
             return
         self.executed_plans += 1
         self._scaler.scale(plan)
+
+
+class ServingScaleAdvisor:
+    """Inference-replica scaling from serving queue pressure.
+
+    The replica pool (serving/replica.py) folds its replicas' queue
+    pressure into a hint it writes at `serving/scale_hint` in the
+    master KV store (and can call `on_hint` directly when it lives in
+    the master process). The advisor turns an up/down hint into a
+    ScalePlan for the replica node group, bounded by [min_replicas,
+    max_replicas], and executes it through the job's Scaler — the same
+    plan → scaler path training scaling takes.
+    """
+
+    HINT_KEY = "serving/scale_hint"
+
+    def __init__(
+        self,
+        kv_store=None,
+        scaler: Optional[Scaler] = None,
+        node_type: str = "inference",
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+    ):
+        self._kv = kv_store
+        self._scaler = scaler
+        self.node_type = node_type
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.executed_plans = 0
+        self._last_hint_ts = 0.0
+
+    def poll_once(self) -> Optional[ScalePlan]:
+        """Read the latest hint from the KV store; act on a fresh
+        up/down. Returns the plan (possibly empty) or None when there
+        is no new hint."""
+        if self._kv is None:
+            return None
+        raw = self._kv.get(self.HINT_KEY)
+        if not raw:
+            return None
+        try:
+            hint = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            logger.warning("unparseable scale hint: %r", raw[:100])
+            return None
+        if hint.get("ts", 0.0) <= self._last_hint_ts:
+            return None  # already acted on this hint
+        self._last_hint_ts = hint.get("ts", 0.0)
+        return self.on_hint(hint)
+
+    def on_hint(self, hint: dict) -> ScalePlan:
+        """Direct-call path (the pool's `advisor` hook)."""
+        plan = ScalePlan()
+        direction = hint.get("direction")
+        if direction not in ("up", "down"):
+            return plan
+        target = int(hint.get("replicas", hint.get("current", 0)))
+        target = min(self.max_replicas, max(self.min_replicas, target))
+        if target == int(hint.get("current", -1)):
+            return plan  # bounds clamped the move away
+        plan.node_group_resources[self.node_type] = NodeGroupResource(
+            count=target
+        )
+        logger.info(
+            "serving scale hint %s: replica group -> %d "
+            "(pressure %.2f)",
+            direction, target, hint.get("pressure", -1.0),
+        )
+        if self._scaler is not None:
+            self.executed_plans += 1
+            self._scaler.scale(plan)
+        return plan

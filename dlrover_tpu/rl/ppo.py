@@ -246,7 +246,13 @@ class PpoTrainer:
                 max_new_tokens=cfg.max_len,
                 temperature=cfg.temperature,
                 eos_id=self.eos_id if self.eos_id >= 0 else None,
-                pad_id=0,
+                # pad_id=-1 sits outside every vocab, so it can never
+                # collide with the tokenizer's eos (ContinuousBatcher
+                # rejects eos_id == pad_id, and a real tokenizer with
+                # eos_id=0 crashed the old pad_id=0 choice). Pad never
+                # reaches the output buffer: emitted pads are dropped
+                # by the delta harvest, prompt-bucket pads are masked.
+                pad_id=-1,
             )
             self._cb = cb
         else:

@@ -1,317 +1,18 @@
-"""Continuous-batching rollout engine: slot-based KV cache, per-slot
-lengths, admit-on-release.
+"""Compatibility shim: the continuous-batching engine moved to
+dlrover_tpu/serving/engine.py.
 
-Reference parity: atorch/rl/inference_backend/vllm_backend.py:24 — the
-reference hands PPO rollouts to vLLM for continuous batching + paged
-KV. TPU re-design, not a port:
-
-- ONE static-shape compiled program does all the stepping: a fixed
-  bank of `n_slots` cache rows, each at its OWN position (the vector-
-  `pos` path of models/decode.py). No dynamic shapes, no recompiles —
-  mixed-length traffic changes only the DATA (which slots are live),
-  never the program.
-- "paged KV" collapses to slot reuse: a released row is re-admitted by
-  overwriting its cache prefix (prefill_into_slot); cells beyond the
-  new prompt are dead by the position mask, so no page table is
-  needed at this granularity.
-- host↔device chatter is amortized by decoding `chunk` steps per
-  dispatch inside one lax.scan (the axon tunnel has a ~1.5 ms
-  dispatch floor; a finished slot idles at most chunk-1 steps before
-  the host swaps in the next request).
-- sampling (temperature/top-k/top-p, EOS discipline) reuses
-  decode.py's own mask helpers, so serve and generate() cannot drift.
-
-The win over lockstep generate(): a fixed batch runs every row to the
-LONGEST request's length (finished rows burn steps emitting pad);
-here a finished slot is refilled within one chunk, so the chip's
-step-rate turns into useful tokens at any length mix.
+Serving stopped being an RL-only concern once the inference gateway
+(dlrover_tpu/serving/) grew around the batcher — the engine is generic
+over models/decode.py and the PPO rollout path is just one of its
+drivers. This module keeps the historical import path
+(`from dlrover_tpu.rl.serve import ContinuousBatcher`) working; the
+implementation lives in one place only.
 """
 
-import dataclasses
-from collections import deque
-from functools import partial
-from typing import Any, Dict, List, Optional, Sequence
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from dlrover_tpu.models.decode import (
-    _check_positional_capacity,
-    _mask_top_k,
-    _mask_top_p,
-    decode_step,
-    init_kv_cache,
-    prefill_into_slot,
+from dlrover_tpu.serving.engine import (  # noqa: F401
+    ContinuousBatcher,
+    _pad_bucket,
+    _Request,
 )
 
-
-def _pad_bucket(n: int, lo: int = 16) -> int:
-    """Next power-of-two bucket (≥ lo) — bounds prefill recompiles to
-    log2(max_len) distinct shapes."""
-    b = lo
-    while b < n:
-        b *= 2
-    return b
-
-
-@dataclasses.dataclass
-class _Request:
-    idx: int                 # submission order
-    prompt: np.ndarray       # [P] true tokens
-    max_new: int = 0         # per-request cap (0 = engine default)
-    out: List[int] = dataclasses.field(default_factory=list)
-    done: bool = False
-
-
-class ContinuousBatcher:
-    """Greedy/sampling rollouts over a slot bank.
-
-    generate_all(prompts) -> list of generated continuations (eos
-    included when hit), in submission order. `params` may be any
-    llama/GPT-family pytree models/decode.py serves.
-    """
-
-    def __init__(
-        self,
-        cfg,
-        params,
-        n_slots: int = 8,
-        max_len: int = 512,
-        max_new_tokens: int = 128,
-        temperature: float = 0.0,
-        top_k: int = 0,
-        top_p: float = 1.0,
-        eos_id: Optional[int] = None,
-        pad_id: int = 0,
-        chunk: int = 8,   # steps per dispatch; see _next_chunk_len
-        seed: int = 0,
-        kv_quant: bool = False,  # int8 KV cache (~2x slots per HBM)
-    ):
-        if eos_id is not None and eos_id == pad_id:
-            raise ValueError(
-                "eos_id and pad_id must differ: the pad emitted by "
-                "finished slots would re-trigger EOS detection"
-            )
-        _check_positional_capacity(cfg, max_len)
-        self.cfg = cfg
-        self.params = params
-        self.n_slots = n_slots
-        self.max_len = max_len
-        self.max_new = max_new_tokens
-        self.eos_id = eos_id
-        self.pad_id = pad_id
-        self.chunk = chunk
-        self.key = jax.random.PRNGKey(seed)
-        self.cache = init_kv_cache(
-            cfg, n_slots, max_len, quant=kv_quant
-        )
-        # host-side slot state (tiny [B] vectors; shipped per chunk)
-        self.tok = np.full(n_slots, pad_id, np.int32)
-        self.pos = np.zeros(n_slots, np.int32)
-        self.limit = np.zeros(n_slots, np.int32)
-        self.done = np.ones(n_slots, bool)   # all free initially
-        self.slot_req: List[Optional[_Request]] = [None] * n_slots
-        self._queue: deque = deque()
-        self._requests: List[_Request] = []
-        self._returned = 0  # requests already handed back
-
-        def _sample(logits, key):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / temperature
-            if 0 < top_k < logits.shape[-1]:
-                logits = _mask_top_k(logits, top_k)
-            if top_p < 1.0:
-                logits = _mask_top_p(logits, top_p)
-            return jax.random.categorical(key, logits).astype(
-                jnp.int32
-            )
-
-        @partial(
-            jax.jit, donate_argnums=(0,), static_argnums=(7,)
-        )
-        def _run_chunk(cache, params, tok, pos, done, limit, key, k):
-            def body(carry, _):
-                cache, tok, pos, done, key = carry
-                logits, cache = decode_step(
-                    cfg, params, tok, cache, pos
-                )
-                key, sub = jax.random.split(key)
-                nxt = _sample(logits, sub)
-                nxt = jnp.where(done, pad_id, nxt)
-                hit_eos = (
-                    (nxt == eos_id)
-                    if eos_id is not None
-                    else jnp.zeros_like(done)
-                )
-                # tokens generated through this step = pos+2-prompt_len
-                # (carry enters at prompt_len-1), so the length cap
-                # limit = prompt_len + max_new fires at pos+2 >= limit
-                new_done = done | hit_eos | (pos + 2 >= limit)
-                pos = jnp.where(done, pos, pos + 1)
-                tok = jnp.where(done, tok, nxt)
-                return (cache, tok, pos, new_done, key), nxt
-
-            (cache, tok, pos, done, key), emitted = jax.lax.scan(
-                body, (cache, tok, pos, done, key), None, length=k,
-            )
-            return cache, tok, pos, done, key, emitted.T  # [B, k]
-
-        self._run_chunk = _run_chunk
-
-        # admission compiled too (retraces once per prompt bucket,
-        # log2(max_len) shapes total); cache donated so an admission
-        # updates in place instead of copying the whole slot bank
-        @partial(jax.jit, donate_argnums=(0,))
-        def _admit_fn(cache, params, prompt, slot):
-            return prefill_into_slot(cfg, params, prompt, cache, slot)
-
-        self._admit_fn = _admit_fn
-
-    def _next_chunk_len(self) -> int:
-        """Dispatch size: `chunk` steps, shortened only when EVERY
-        live slot's remaining cap (limit - pos - 1) is smaller — the
-        drain tail then runs exactly to the last release instead of
-        idling the whole bank.
-
-        Measured policy note (48-req long-tail mix, 4 slots, CPU):
-        chunking to the SOONEST release ("min rule") looks idle-free
-        but lets every freshly admitted short request drag all slots
-        to 1-2-step dispatches — dispatch overhead ate the win
-        (1.05x vs lockstep). A fixed chunk with this max-cap tail
-        clamp measured best (1.23x toy-scale WITH the pow2 tail
-        quantization below — measured on the shipped policy;
-        overheads shrink ~10x against the real-model step time on
-        chip). A mid-chunk release idles one slot for at most
-        chunk-1 steps while the others keep working."""
-        rem = max(
-            int(self.limit[s] - self.pos[s] - 1)
-            for s in range(self.n_slots)
-            if not self.done[s]
-        )
-        k_target = max(1, min(rem, self.chunk))
-        if k_target == self.chunk:
-            return k_target
-        # tail values quantize DOWN to powers of two: each distinct k
-        # is its own compiled scan (~tens of seconds on chip), so the
-        # tail may cost log2(chunk) compiles, never chunk of them
-        k = 1
-        while k * 2 <= k_target:
-            k *= 2
-        return k
-
-    def update_params(self, params) -> None:
-        """Swap the served weights (e.g. after a PPO update). Shapes
-        must match; the compiled programs are reused as-is. Call
-        between generate_all() drains — mid-drain the batch would mix
-        policies."""
-        self.params = params
-
-    # -- admission ---------------------------------------------------------
-
-    def submit(
-        self, prompt: Sequence[int], max_new: Optional[int] = None
-    ) -> int:
-        """Queue one request; returns its index in the output list.
-        `max_new` caps THIS request's generation (vLLM-style
-        per-request max_tokens); default is the engine's."""
-        arr = np.asarray(prompt, np.int32)
-        if arr.ndim != 1 or arr.size == 0:
-            raise ValueError("prompt must be a non-empty 1-D sequence")
-        if max_new is not None and max_new < 1:
-            raise ValueError(
-                f"max_new must be >= 1, got {max_new} (omit it for "
-                "the engine default)"
-            )
-        if arr.size + 1 > self.max_len:
-            raise ValueError(
-                f"prompt length {arr.size} leaves no room to generate "
-                f"(max_len {self.max_len})"
-            )
-        req = _Request(
-            idx=len(self._requests), prompt=arr,
-            max_new=max_new or 0,
-        )
-        self._requests.append(req)
-        self._queue.append(req)
-        return req.idx
-
-    def _admit(self, slot: int, req: _Request):
-        p = len(req.prompt)
-        bucket = min(_pad_bucket(p), self.max_len)
-        padded = np.full(bucket, self.pad_id, np.int32)
-        padded[:p] = req.prompt
-        self.cache = self._admit_fn(
-            self.cache, self.params, jnp.asarray(padded), slot
-        )
-        # carry = last REAL prompt token at its position: the first
-        # chunk step recomputes its logits (identical K/V rewrite)
-        # and samples the first new token from them
-        self.tok[slot] = req.prompt[-1]
-        self.pos[slot] = p - 1
-        self.limit[slot] = min(
-            p + (req.max_new or self.max_new), self.max_len
-        )
-        self.done[slot] = False
-        self.slot_req[slot] = req
-
-    # -- the loop ----------------------------------------------------------
-
-    def generate_all(
-        self, prompts: Sequence[Sequence[int]]
-    ) -> List[np.ndarray]:
-        """Run every queued prompt to completion; returns generated
-        continuations (without the prompt) in submission order —
-        including any requests submit()ted beforehand that have not
-        been returned yet. Callable repeatedly."""
-        for pr in prompts:
-            self.submit(pr)
-        while True:
-            # fill free slots from the queue
-            for slot in range(self.n_slots):
-                if self.done[slot] and self._queue:
-                    self._admit(slot, self._queue.popleft())
-            if self.done.all() and not self._queue:
-                break
-            old_pos = self.pos.copy()
-            cache, tok, pos, done, key, emitted = self._run_chunk(
-                self.cache,
-                self.params,
-                jnp.asarray(self.tok),
-                jnp.asarray(self.pos),
-                jnp.asarray(self.done),
-                jnp.asarray(self.limit),
-                self.key,
-                self._next_chunk_len(),
-            )
-            self.cache, self.key = cache, key
-            # np.array (copy): np.asarray of a jax array is a
-            # read-only view, and _admit writes these in place
-            self.tok = np.array(tok)
-            self.pos = np.array(pos)
-            new_done = np.array(done)
-            emitted = np.asarray(emitted)
-            for slot in range(self.n_slots):
-                req = self.slot_req[slot]
-                if req is None or req.done:
-                    continue
-                # live steps form a prefix of the chunk (done is
-                # sticky), and pos advanced once per live step — the
-                # first (new_pos - old_pos) emitted entries are
-                # exactly the real tokens, whatever their values
-                delta = int(self.pos[slot] - old_pos[slot])
-                req.out.extend(int(t) for t in emitted[slot][:delta])
-                if new_done[slot]:
-                    req.done = True
-            self.done = new_done
-        out = [
-            np.asarray(r.out, np.int32)
-            for r in self._requests[self._returned:]
-        ]
-        # drain complete: drop the request ledger, or a long-lived
-        # engine (e.g. one PPO trainer across 100k rollouts) retains
-        # every prompt + output list ever served and leaks host RAM
-        self._requests = []
-        self._returned = 0
-        return out
+__all__ = ["ContinuousBatcher"]

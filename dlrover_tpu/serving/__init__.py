@@ -1,0 +1,42 @@
+"""Elastic inference gateway: SLO-aware serving over the continuous
+batcher.
+
+The serving stack mirrors the training control plane's shape (PAPER.md:
+master-coordinated node pools with health-checked members), applied to
+inference:
+
+  gateway.py   — stdlib HTTP front door, streaming responses
+  scheduler.py — SLO-aware admission control + deadline shedding over
+                 the generation engine's slot bank
+  engine.py    — the continuous-batching generation engine (extracted
+                 from rl/serve.py; rl imports it back)
+  replica.py   — replica pool: KV-store registration, health checks,
+                 queue-pressure scale hints for the auto-scaler
+  metrics.py   — TTFT/TPOT/queue-depth counters, Prometheus exposition
+"""
+
+from dlrover_tpu.serving.engine import ContinuousBatcher, GenerationEngine
+from dlrover_tpu.serving.metrics import ServingMetrics
+from dlrover_tpu.serving.scheduler import (
+    AdmissionError,
+    RequestScheduler,
+    RequestState,
+    ServeRequest,
+    SloConfig,
+)
+from dlrover_tpu.serving.replica import InferenceReplica, ReplicaPool
+from dlrover_tpu.serving.gateway import ServingGateway
+
+__all__ = [
+    "AdmissionError",
+    "ContinuousBatcher",
+    "GenerationEngine",
+    "InferenceReplica",
+    "ReplicaPool",
+    "RequestScheduler",
+    "RequestState",
+    "ServeRequest",
+    "ServingGateway",
+    "ServingMetrics",
+    "SloConfig",
+]

@@ -1,0 +1,211 @@
+"""HTTP front door for the serving stack — stdlib only.
+
+threading + http.server, no web framework: the gateway is a thin
+protocol adapter over the scheduler/pool (the control logic lives
+there, where it is unit-testable without sockets), and the repo's
+no-new-deps rule holds for serving like everywhere else.
+
+Endpoints:
+
+  POST /v1/generate   {"tokens": [...], "max_new"?: n,
+                       "deadline_s"?: s, "stream"?: bool}
+    stream=true (default): application/x-ndjson — one
+      {"tokens": [...]} line per decoded chunk as it lands, then a
+      {"done": true, ...} trailer. TTFT for the client is one engine
+      chunk, not one full generation.
+    stream=false: one JSON body with the full continuation.
+    429 when admission rejects (queue full / token budget);
+    503 when the request is shed past its deadline.
+
+  GET /metrics        Prometheus text (serving/metrics.py)
+  GET /healthz        {"ok": ..., "replicas": n}
+
+Responses are HTTP/1.0 with Connection: close — the absence of a
+Content-Length makes end-of-body explicit at close, which is exactly
+the framing a streaming response wants, and every http client (curl
+included) consumes it incrementally.
+"""
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.metrics import ServingMetrics
+from dlrover_tpu.serving.scheduler import (
+    AdmissionError,
+    RequestState,
+)
+
+
+class ServingGateway:
+    """HTTP server routing generation requests into a backend.
+
+    `backend` is anything with submit(prompt, max_new, deadline_s) ->
+    ServeRequest: a RequestScheduler (single replica) or a ReplicaPool
+    (least-loaded routing across replicas)."""
+
+    def __init__(
+        self,
+        backend,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics: Optional[ServingMetrics] = None,
+        stream_timeout_s: float = 120.0,
+    ):
+        self.backend = backend
+        self.metrics = metrics or getattr(backend, "metrics", None) \
+            or ServingMetrics()
+        self.stream_timeout_s = stream_timeout_s
+        gw = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"
+
+            # route the handler's log through ours, not stderr
+            def log_message(self, fmt, *args):
+                logger.debug("gateway: " + fmt, *args)
+
+            def _json(self, code: int, obj: dict):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = gw.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4",
+                    )
+                    self.send_header(
+                        "Content-Length", str(len(body))
+                    )
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/healthz":
+                    self._json(200, gw._health())
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    self._json(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    tokens = payload["tokens"]
+                except (KeyError, ValueError, json.JSONDecodeError):
+                    self._json(
+                        400,
+                        {"error": "body must be JSON with 'tokens'"},
+                    )
+                    return
+                try:
+                    req = gw.backend.submit(
+                        tokens,
+                        max_new=payload.get("max_new"),
+                        deadline_s=payload.get("deadline_s"),
+                    )
+                except AdmissionError as e:
+                    self._json(429, {"error": e.reason})
+                    return
+                if payload.get("stream", True):
+                    self._stream(req)
+                else:
+                    self._blocking(req)
+
+            def _stream(self, req):
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "application/x-ndjson"
+                )
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    for chunk in req.iter_stream(
+                        timeout=gw.stream_timeout_s
+                    ):
+                        self.wfile.write(
+                            json.dumps({"tokens": chunk}).encode()
+                            + b"\n"
+                        )
+                        self.wfile.flush()
+                    self.wfile.write(
+                        json.dumps(gw._trailer(req)).encode() + b"\n"
+                    )
+                except queue.Empty:
+                    self.wfile.write(
+                        json.dumps(
+                            {"error": "stream timeout"}
+                        ).encode()
+                        + b"\n"
+                    )
+                except BrokenPipeError:
+                    pass  # client went away; scheduler finishes anyway
+
+            def _blocking(self, req):
+                if not req.wait(timeout=gw.stream_timeout_s):
+                    self._json(504, {"error": "generation timeout"})
+                    return
+                if req.state is RequestState.SHED:
+                    self._json(503, gw._trailer(req))
+                    return
+                self._json(
+                    200, {"tokens": req.tokens, **gw._trailer(req)}
+                )
+
+            handler_version = "dlrover-tpu-serving"
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _trailer(req) -> dict:
+        return {
+            "done": True,
+            "id": req.id,
+            "state": req.state.value,
+            "n_tokens": len(req.tokens),
+        }
+
+    def _health(self) -> dict:
+        reps = getattr(self.backend, "healthy_replicas", None)
+        n = len(reps()) if callable(reps) else 1
+        return {"ok": n > 0, "replicas": n}
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def addr(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="serving-gateway",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info("serving gateway on %s", self.addr)
+
+    def stop(self):
+        self._server.shutdown()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        self._server.server_close()

@@ -1,0 +1,234 @@
+"""Serving metrics: TTFT/TPOT/queue-depth/throughput counters with
+Prometheus text exposition.
+
+Follows master/monitor/speed_monitor.py conventions: one lock, plain
+ingestion methods, sliding windows where a rate or percentile needs
+recency (a serving TTFT quantile over the whole process lifetime would
+hide a regression behind hours of healthy history).
+
+No prometheus_client dependency — the text exposition format
+(https://prometheus.io/docs/instrumenting/exposition_formats/) is a few
+lines of string assembly, and the gateway serves it from /metrics.
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank quantile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[i]
+
+
+class _Window:
+    """Sliding sample window: count/sum forever, quantiles over the
+    last `maxlen` observations."""
+
+    def __init__(self, maxlen: int = 512):
+        self.count = 0
+        self.total = 0.0
+        self.recent: Deque[float] = deque(maxlen=maxlen)
+
+    def observe(self, v: float):
+        self.count += 1
+        self.total += v
+        self.recent.append(v)
+
+    def quantiles(self, qs=(0.5, 0.95)) -> Dict[float, float]:
+        vals = sorted(self.recent)
+        return {q: _quantile(vals, q) for q in qs}
+
+
+class ServingMetrics:
+    """Thread-safe serving counters; render() emits Prometheus text.
+
+    TTFT = submit → first token out (queueing + prefill).
+    TPOT = mean inter-token time after the first (decode rate).
+    """
+
+    def __init__(self, window: int = 512):
+        self._lock = threading.Lock()
+        self._ttft_ms = _Window(window)
+        self._tpot_ms = _Window(window)
+        self._queue_depth = 0
+        self._active_requests = 0
+        self._requests_total = 0
+        self._completed_total = 0
+        self._shed_total = 0
+        self._rejected_total = 0
+        self._tokens_total = 0
+        # (tokens, ts) window for the tokens/sec rate gauge
+        self._token_events: Deque[Tuple[int, float]] = deque(maxlen=512)
+
+    # ---- ingestion -------------------------------------------------------
+
+    def request_submitted(self):
+        with self._lock:
+            self._requests_total += 1
+
+    def request_rejected(self):
+        with self._lock:
+            self._rejected_total += 1
+
+    def request_shed(self):
+        with self._lock:
+            self._shed_total += 1
+
+    def request_completed(self):
+        with self._lock:
+            self._completed_total += 1
+
+    def observe_ttft(self, ms: float):
+        with self._lock:
+            self._ttft_ms.observe(ms)
+
+    def observe_tpot(self, ms: float):
+        with self._lock:
+            self._tpot_ms.observe(ms)
+
+    def observe_tokens(self, n: int, ts: Optional[float] = None):
+        with self._lock:
+            self._tokens_total += n
+            self._token_events.append((n, ts or time.monotonic()))
+
+    def set_queue_depth(self, depth: int):
+        with self._lock:
+            self._queue_depth = depth
+
+    def set_active_requests(self, n: int):
+        with self._lock:
+            self._active_requests = n
+
+    # ---- queries ---------------------------------------------------------
+
+    @property
+    def shed_total(self) -> int:
+        with self._lock:
+            return self._shed_total
+
+    @property
+    def rejected_total(self) -> int:
+        with self._lock:
+            return self._rejected_total
+
+    @property
+    def requests_total(self) -> int:
+        with self._lock:
+            return self._requests_total
+
+    @property
+    def completed_total(self) -> int:
+        with self._lock:
+            return self._completed_total
+
+    @property
+    def tokens_total(self) -> int:
+        with self._lock:
+            return self._tokens_total
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self._queue_depth
+
+    def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
+        """Emission rate over the trailing `horizon_s` seconds."""
+        now = time.monotonic()
+        with self._lock:
+            toks = sum(
+                n for n, ts in self._token_events
+                if now - ts <= horizon_s
+            )
+        return toks / horizon_s if toks else 0.0
+
+    # ---- exposition ------------------------------------------------------
+
+    def render(self) -> str:
+        """Prometheus text exposition (version 0.0.4)."""
+        with self._lock:
+            ttft_q = self._ttft_ms.quantiles()
+            tpot_q = self._tpot_ms.quantiles()
+            lines = []
+
+            def summary(name, help_, win: _Window, q: Dict):
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} summary")
+                for quant, val in q.items():
+                    lines.append(
+                        f'{name}{{quantile="{quant}"}} {val:.6g}'
+                    )
+                lines.append(f"{name}_sum {win.total:.6g}")
+                lines.append(f"{name}_count {win.count}")
+
+            def gauge(name, help_, val):
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {val:.6g}")
+
+            def counter(name, help_, val):
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {val}")
+
+            summary(
+                "serving_ttft_ms",
+                "Time to first token (queueing + prefill), ms.",
+                self._ttft_ms, ttft_q,
+            )
+            summary(
+                "serving_tpot_ms",
+                "Mean time per output token after the first, ms.",
+                self._tpot_ms, tpot_q,
+            )
+            gauge(
+                "serving_queue_depth",
+                "Requests waiting for a slot.",
+                self._queue_depth,
+            )
+            gauge(
+                "serving_active_requests",
+                "Requests currently decoding.",
+                self._active_requests,
+            )
+            counter(
+                "serving_requests_total",
+                "Requests admitted.",
+                self._requests_total,
+            )
+            counter(
+                "serving_requests_completed_total",
+                "Requests run to completion.",
+                self._completed_total,
+            )
+            counter(
+                "serving_requests_shed_total",
+                "Requests shed past their deadline.",
+                self._shed_total,
+            )
+            counter(
+                "serving_requests_rejected_total",
+                "Requests rejected at admission.",
+                self._rejected_total,
+            )
+            counter(
+                "serving_tokens_total",
+                "Tokens emitted.",
+                self._tokens_total,
+            )
+        # rate gauge takes the lock itself — outside the block above
+        tps = self.tokens_per_sec()
+        return "\n".join(
+            lines
+            + [
+                "# HELP serving_tokens_per_sec "
+                "Token emission rate (10s horizon).",
+                "# TYPE serving_tokens_per_sec gauge",
+                f"serving_tokens_per_sec {tps:.6g}",
+                "",
+            ]
+        )

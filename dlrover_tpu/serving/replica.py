@@ -1,0 +1,304 @@
+"""Inference replica pool: KV-store registration, health checks, and
+queue-pressure scale hints.
+
+This is the serving-side mirror of the training control plane
+(PAPER.md: master-coordinated node pools with health-checked members):
+
+- each replica registers itself in the master KV store
+  (master/kv_store.py — reachable either in-process or through an
+  agent's MasterClient; both speak the same two verbs) and refreshes
+  its entry with a heartbeat carrying live load,
+- the pool health-checks replicas with the agent's node-check
+  discipline (agent/node_check.py: repeated rounds, a node is faulty
+  only after consecutive strikes — one slow probe is weather, two is
+  climate),
+- aggregate queue pressure is folded into a scale hint the auto-scaler
+  consumes (master/auto_scaler.py:ServingScaleAdvisor), making the
+  elastic control plane bidirectional: training throughput scales the
+  worker pool, serving pressure scales the replica pool.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.scheduler import (
+    AdmissionError,
+    RequestScheduler,
+    ServeRequest,
+)
+
+REPLICA_KEY_PREFIX = "serving/replicas/"
+SCALE_HINT_KEY = "serving/scale_hint"
+
+# chaos hook, mirroring agent/node_check.py's MOCK_ERR_RANK
+MOCK_ERR_REPLICA_ENV = "DLROVER_TPU_SERVING_MOCK_ERR_REPLICA"
+
+
+def _kv_set(kv, key: str, value: bytes):
+    """Duck-typed store write: MasterClient.kv_set (over gRPC) or
+    KVStoreService.set (in-process master)."""
+    if hasattr(kv, "kv_set"):
+        kv.kv_set(key, value)
+    else:
+        kv.set(key, value)
+
+
+def _kv_get(kv, key: str) -> bytes:
+    if hasattr(kv, "kv_get"):
+        return kv.kv_get(key)
+    return kv.get(key)
+
+
+class InferenceReplica:
+    """One serving replica: a scheduler over one engine, registered in
+    the master KV store."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        scheduler: RequestScheduler,
+        kv=None,
+    ):
+        self.id = replica_id
+        self.scheduler = scheduler
+        self.kv = kv
+        self.healthy = True
+        self.strikes = 0
+
+    # ---- registration ----------------------------------------------------
+
+    @property
+    def kv_key(self) -> str:
+        return REPLICA_KEY_PREFIX + self.id
+
+    def register(self):
+        if self.kv is not None:
+            _kv_set(self.kv, self.kv_key, self._meta())
+
+    def heartbeat(self):
+        """Refresh the registration with live load (the master-side
+        reader distinguishes a dead replica by a stale ts)."""
+        self.register()
+
+    def _meta(self) -> bytes:
+        return json.dumps(
+            {
+                "id": self.id,
+                "ts": time.time(),
+                "n_slots": self.scheduler.engine.n_slots,
+                "queue_depth": self.scheduler.queue_depth(),
+                "active": self.scheduler.active_count(),
+                "pressure": self.scheduler.pressure(),
+                "healthy": self.healthy,
+            }
+        ).encode()
+
+    # ---- health ----------------------------------------------------------
+
+    def probe(self) -> bool:
+        """One health probe: the scheduler's driver thread is live (if
+        started) and its queue answers. Chaos tests force a failure
+        via DLROVER_TPU_SERVING_MOCK_ERR_REPLICA=<id>."""
+        if os.environ.get(MOCK_ERR_REPLICA_ENV, "") == self.id:
+            return False
+        t = self.scheduler._thread
+        if t is not None and not t.is_alive():
+            return False
+        try:
+            self.scheduler.queue_depth()
+            return True
+        except Exception:  # noqa: BLE001 — any engine error = unhealthy
+            logger.exception("replica %s probe failed", self.id)
+            return False
+
+    def load(self) -> float:
+        """Routing weight: waiting pressure plus slot occupancy, so an
+        idle replica wins over a busy one even when neither queues."""
+        sched = self.scheduler
+        occupancy = sched.active_count() / max(1, sched.engine.n_slots)
+        return sched.pressure() + occupancy
+
+    def start(self):
+        self.scheduler.start()
+        self.register()
+
+    def stop(self):
+        self.scheduler.stop()
+
+
+class ReplicaPool:
+    """Routes requests across replicas; health-checks them; emits
+    scale hints from aggregate queue pressure."""
+
+    def __init__(
+        self,
+        kv=None,
+        max_strikes: int = 2,
+        hint_cooldown_s: float = 10.0,
+        advisor: Optional[Callable[[dict], None]] = None,
+    ):
+        self.kv = kv
+        self.max_strikes = max_strikes
+        self.hint_cooldown_s = hint_cooldown_s
+        self.advisor = advisor
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, InferenceReplica] = {}
+        self._last_hint_ts = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- membership ------------------------------------------------------
+
+    def add(self, replica: InferenceReplica):
+        if replica.kv is None:
+            replica.kv = self.kv
+        with self._lock:
+            self._replicas[replica.id] = replica
+        replica.register()
+
+    def remove(self, replica_id: str) -> Optional[InferenceReplica]:
+        with self._lock:
+            return self._replicas.pop(replica_id, None)
+
+    def replicas(self) -> List[InferenceReplica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def healthy_replicas(self) -> List[InferenceReplica]:
+        return [r for r in self.replicas() if r.healthy]
+
+    # ---- routing ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> ServeRequest:
+        """Least-loaded routing with failover: try healthy replicas in
+        load order until one admits."""
+        candidates = sorted(
+            self.healthy_replicas(), key=lambda r: r.load()
+        )
+        if not candidates:
+            raise AdmissionError("no healthy replicas")
+        last_err: Optional[AdmissionError] = None
+        for rep in candidates:
+            try:
+                return rep.scheduler.submit(
+                    prompt, max_new=max_new, deadline_s=deadline_s
+                )
+            except AdmissionError as e:
+                last_err = e
+        raise last_err
+
+    # ---- health + scaling ------------------------------------------------
+
+    def check_replicas(self):
+        """One health round: consecutive probe failures accumulate
+        strikes; `max_strikes` marks the replica unhealthy (and out of
+        routing); a passing probe heals it."""
+        for rep in self.replicas():
+            if rep.probe():
+                rep.strikes = 0
+                if not rep.healthy:
+                    logger.info("replica %s recovered", rep.id)
+                rep.healthy = True
+            else:
+                rep.strikes += 1
+                if rep.strikes >= self.max_strikes and rep.healthy:
+                    rep.healthy = False
+                    logger.warning(
+                        "replica %s unhealthy after %d strikes",
+                        rep.id, rep.strikes,
+                    )
+            rep.heartbeat()
+
+    def aggregate_pressure(self) -> float:
+        reps = self.healthy_replicas()
+        if not reps:
+            return 1.0
+        return sum(r.scheduler.pressure() for r in reps) / len(reps)
+
+    def scale_hint(self, force: bool = False) -> Optional[dict]:
+        """Fold queue pressure into an up/down/hold hint, write it to
+        the master KV store, and hand it to the advisor. Rate-limited
+        by `hint_cooldown_s` so a pressure spike cannot flap the
+        scaler (force=True bypasses, for tests)."""
+        now = time.monotonic()
+        if (
+            not force
+            and now - self._last_hint_ts < self.hint_cooldown_s
+        ):
+            return None
+        reps = self.healthy_replicas()
+        n = len(reps)
+        pressure = self.aggregate_pressure()
+        if not reps:
+            direction, target = "up", 1
+        else:
+            slo = reps[0].scheduler.slo
+            if pressure > slo.pressure_high:
+                direction, target = "up", n + 1
+            elif pressure < slo.pressure_low and n > 1:
+                direction, target = "down", n - 1
+            else:
+                direction, target = "hold", n
+        hint = {
+            "direction": direction,
+            "replicas": target,
+            "current": n,
+            "pressure": round(pressure, 4),
+            "ts": time.time(),
+        }
+        self._last_hint_ts = now
+        if self.kv is not None:
+            try:
+                _kv_set(
+                    self.kv, SCALE_HINT_KEY, json.dumps(hint).encode()
+                )
+            except Exception:  # noqa: BLE001 — master blip ≠ serving outage
+                logger.warning(
+                    "scale hint write failed (master unreachable?)",
+                    exc_info=True,
+                )
+        if self.advisor is not None and direction != "hold":
+            try:
+                self.advisor(hint)
+            except Exception:  # noqa: BLE001
+                logger.exception("scale advisor failed on %s", hint)
+        return hint
+
+    # ---- background loop -------------------------------------------------
+
+    def start(self, interval: float = 5.0):
+        """Run health checks + heartbeats + scale hints periodically."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.check_replicas()
+                    self.scale_hint()
+                except Exception:  # noqa: BLE001 — keep the pool alive
+                    logger.exception("replica pool iteration failed")
+
+        self._thread = threading.Thread(
+            target=_loop, name="replica-pool", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+        for rep in self.replicas():
+            rep.stop()

@@ -1,0 +1,312 @@
+"""SLO-aware request scheduling over the generation engine's slot bank.
+
+The engine (serving/engine.py) is a pure batching machine: it decodes
+whatever occupies its slots. This module is the policy layer in front
+of it — the piece vLLM calls the scheduler and DLRover's master calls
+admission:
+
+- admission control: a bounded wait queue (`max_queue_depth`) and a
+  per-request token budget (`max_new_tokens`) reject work the replica
+  cannot promise to serve, at submit time, with a typed error the
+  gateway maps to HTTP 429 — instead of queueing unboundedly and
+  missing every deadline at once.
+- EDF dispatch: waiting requests are admitted earliest-deadline-first
+  into freed slots (a deadline is an SLO, so the queue is a deadline
+  heap, not FIFO).
+- deadline shedding: a request whose deadline passes while it still
+  waits is shed — it would burn slot time to miss its SLO anyway, and
+  shedding it early keeps the queue honest for the requests behind it.
+  Requests already decoding are never shed (their tokens are sunk
+  cost about to pay off).
+
+Tokens stream out per engine chunk through each request's stream
+queue; the gateway forwards them as they land, so TTFT is one chunk
+away from admission, not one full generation away.
+"""
+
+import dataclasses
+import enum
+import heapq
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.engine import ContinuousBatcher
+from dlrover_tpu.serving.metrics import ServingMetrics
+
+
+class AdmissionError(RuntimeError):
+    """Request rejected at admission (queue full / budget exceeded);
+    the gateway maps this to HTTP 429."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    SHED = "shed"
+
+
+@dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """Admission + shedding policy knobs."""
+
+    max_queue_depth: int = 64        # waiting requests before 429
+    max_new_tokens: int = 512        # per-request token budget cap
+    default_deadline_s: float = 60.0
+    # queue-pressure thresholds driving replica scale hints
+    pressure_high: float = 0.75
+    pressure_low: float = 0.25
+
+
+class ServeRequest:
+    """One in-flight request: identity, SLO, and the token stream the
+    gateway reads."""
+
+    def __init__(
+        self,
+        req_id: int,
+        prompt: np.ndarray,
+        max_new: int,
+        deadline: float,
+        submit_ts: float,
+    ):
+        self.id = req_id
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline = deadline
+        self.submit_ts = submit_ts
+        self.state = RequestState.QUEUED
+        self.tokens: List[int] = []
+        self.first_token_ts: Optional[float] = None
+        self.finish_ts: Optional[float] = None
+        # chunks of newly emitted tokens; None terminates the stream
+        self.stream: "queue.Queue[Optional[List[int]]]" = queue.Queue()
+        self._finished = threading.Event()
+
+    def iter_stream(
+        self, timeout: Optional[float] = None
+    ) -> Iterator[List[int]]:
+        """Yield token chunks until the stream ends (done or shed)."""
+        while True:
+            chunk = self.stream.get(timeout=timeout)
+            if chunk is None:
+                return
+            yield chunk
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request finished (done or shed)."""
+        return self._finished.wait(timeout)
+
+    def _end(self, state: RequestState, ts: float):
+        self.state = state
+        self.finish_ts = ts
+        self.stream.put(None)
+        self._finished.set()
+
+
+class RequestScheduler:
+    """SLO-aware queue feeding one generation engine.
+
+    Drive it either with the background thread (`start()`/`stop()` —
+    the gateway path) or by calling `pump()` / `run_to_completion()`
+    directly (tests, benches: deterministic, no thread)."""
+
+    def __init__(
+        self,
+        engine: ContinuousBatcher,
+        slo: Optional[SloConfig] = None,
+        metrics: Optional[ServingMetrics] = None,
+        clock=time.monotonic,
+    ):
+        self.engine = engine
+        self.slo = slo or SloConfig()
+        self.metrics = metrics or ServingMetrics()
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        # EDF heap of (deadline, id, request)
+        self._waiting: List[Any] = []
+        self._running: Dict[int, ServeRequest] = {}  # engine idx -> req
+        self._next_id = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- admission -------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> ServeRequest:
+        """Admit one request or raise AdmissionError. Returns the
+        handle whose `stream` yields token chunks as they decode."""
+        arr = np.asarray(prompt, np.int32)
+        slo = self.slo
+        want = max_new or min(self.engine.max_new, slo.max_new_tokens)
+        with self._cond:
+            if len(self._waiting) >= slo.max_queue_depth:
+                self.metrics.request_rejected()
+                raise AdmissionError(
+                    f"queue full ({slo.max_queue_depth} waiting)"
+                )
+            if want > slo.max_new_tokens:
+                self.metrics.request_rejected()
+                raise AdmissionError(
+                    f"token budget: max_new {want} > "
+                    f"{slo.max_new_tokens}"
+                )
+            if arr.ndim != 1 or arr.size == 0:
+                self.metrics.request_rejected()
+                raise AdmissionError("prompt must be non-empty 1-D")
+            if arr.size + 1 > self.engine.max_len:
+                self.metrics.request_rejected()
+                raise AdmissionError(
+                    f"prompt length {arr.size} leaves no room to "
+                    f"generate (max_len {self.engine.max_len})"
+                )
+            now = self._clock()
+            req = ServeRequest(
+                req_id=self._next_id,
+                prompt=arr,
+                max_new=want,
+                deadline=now + (deadline_s or slo.default_deadline_s),
+                submit_ts=now,
+            )
+            self._next_id += 1
+            heapq.heappush(self._waiting, (req.deadline, req.id, req))
+            self.metrics.request_submitted()
+            self.metrics.set_queue_depth(len(self._waiting))
+            self._cond.notify_all()
+            return req
+
+    # ---- queries ---------------------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._waiting)
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._running)
+
+    def pressure(self) -> float:
+        """Waiting load relative to the admission bound, in [0, 1+]."""
+        with self._lock:
+            return len(self._waiting) / max(1, self.slo.max_queue_depth)
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._waiting) or bool(self._running)
+
+    # ---- the loop --------------------------------------------------------
+
+    def _shed_expired(self, now: float):
+        """Shed every WAITING request whose deadline already passed
+        (the heap is deadline-ordered, so they sit at the front)."""
+        while self._waiting and self._waiting[0][0] <= now:
+            _, _, req = heapq.heappop(self._waiting)
+            req._end(RequestState.SHED, now)
+            self.metrics.request_shed()
+            logger.info(
+                "shed request %d: deadline passed %.3fs ago in queue",
+                req.id, now - req.deadline,
+            )
+
+    def pump(self) -> bool:
+        """One scheduling iteration: shed expired, admit EDF into free
+        slots, decode one chunk, stream the emitted tokens. Returns
+        True while work remains."""
+        with self._cond:
+            now = self._clock()
+            self._shed_expired(now)
+            # admit only up to the engine's free slots so EDF order,
+            # not engine-internal FIFO, decides dispatch
+            while (
+                self._waiting
+                and self.engine.queue_len() < self.engine.free_slots()
+            ):
+                _, _, req = heapq.heappop(self._waiting)
+                idx = self.engine.submit(req.prompt, max_new=req.max_new)
+                req.state = RequestState.RUNNING
+                self._running[idx] = req
+            events = self.engine.step() if self.engine.has_work() else []
+            now = self._clock()
+            for idx, new_toks, finished in events:
+                req = self._running.get(idx)
+                if req is None:
+                    continue
+                if new_toks:
+                    if req.first_token_ts is None:
+                        req.first_token_ts = now
+                        self.metrics.observe_ttft(
+                            (now - req.submit_ts) * 1000.0
+                        )
+                    req.tokens.extend(new_toks)
+                    req.stream.put(new_toks)
+                    self.metrics.observe_tokens(len(new_toks), now)
+                if finished:
+                    self.engine.retire(idx)
+                    del self._running[idx]
+                    if (
+                        req.first_token_ts is not None
+                        and len(req.tokens) > 1
+                    ):
+                        self.metrics.observe_tpot(
+                            (now - req.first_token_ts)
+                            * 1000.0
+                            / (len(req.tokens) - 1)
+                        )
+                    req._end(RequestState.DONE, now)
+                    self.metrics.request_completed()
+            self.metrics.set_queue_depth(len(self._waiting))
+            self.metrics.set_active_requests(len(self._running))
+            return bool(self._waiting) or bool(self._running)
+
+    def run_to_completion(self):
+        """Drain everything submitted so far (tests/bench path)."""
+        while self.pump():
+            pass
+
+    # ---- background driver ----------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                busy = self.pump()
+            except Exception:  # keep the serving thread alive
+                logger.exception("scheduler pump failed")
+                busy = False
+            if not busy:
+                with self._cond:
+                    # wake on submit or shortly before the nearest
+                    # deadline (a queued-only request must still shed
+                    # on time even with no decode traffic)
+                    self._cond.wait(timeout=0.02)

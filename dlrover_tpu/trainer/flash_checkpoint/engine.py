@@ -676,12 +676,19 @@ class CheckpointEngine:
                     step, state = self.replica_manager.restore_state(
                         target=target
                     )
-                except (KeyError, ValueError) as e:
+                except (
+                    KeyError, ValueError, ConnectionError, OSError,
+                ) as e:
                     # the replica carries the same flatten as shm, so
                     # a resized mesh fails its unflatten the same way
                     # — fall through to storage (merged shards cover
                     # any mesh) instead of crash-looping (r3
-                    # postmortem, same guard as the shm path above)
+                    # postmortem, same guard as the shm path above).
+                    # ConnectionError/OSError: the replica lives on
+                    # the MASTER (kv_get raises ConnectionError when
+                    # it is unreachable) — a control-plane outage
+                    # between peek_step and the chunk fetch must fall
+                    # through to storage, not crash the restore
                     logger.warning(
                         "replica restore failed (%s); "
                         "falling back to storage",
@@ -710,7 +717,11 @@ class CheckpointEngine:
                 step, state = self.replica_manager.restore_state(
                     target=target
                 )
-            except (KeyError, ValueError) as e:
+            except (
+                KeyError, ValueError, ConnectionError, OSError,
+            ) as e:
+                # same guard as above: an unreachable master is a
+                # missing replica, not a fatal restore error
                 logger.warning("replica restore failed (%s)", e)
                 step, state = -1, None
             if state is not None:

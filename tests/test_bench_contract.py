@@ -9,6 +9,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -79,3 +81,50 @@ def test_bench_watchdog_emits_diagnosed_line():
     )
     assert d["value"] == 0.0
     assert "error" in d["detail"]
+
+
+@pytest.mark.slow
+def test_serve_bench_smoke_emits_driver_contract():
+    """Same ONE-JSON-line contract for the serving bench: TTFT/TPOT/
+    throughput axes must be present so the serving perf evidence
+    channel can't silently rot. Slow: shells out a fresh JAX process
+    (imports + engine/baseline compiles — minutes on a small box)."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "serve_bench.py"),
+        ],
+        env={
+            **os.environ,
+            "DLROVER_TPU_FORCE_CPU": "1",
+            "JAX_PLATFORMS": "cpu",
+        },
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [
+        ln for ln in proc.stdout.splitlines() if ln.startswith("{")
+    ]
+    assert len(lines) == 1, f"expected ONE JSON line: {lines}"
+    d = json.loads(lines[0])
+    assert d["metric"] == "serve_tokens_per_sec"
+    assert d["unit"] == "tok/s"
+    assert d["value"] > 0
+    assert d["vs_baseline"] > 0
+    detail = d["detail"]
+    for key in (
+        "ttft_ms_p50",
+        "ttft_ms_p95",
+        "tpot_ms_mean",
+        "throughput_tok_s",
+        "lockstep_tok_s",
+        "n_requests",
+        "shed_total",
+        "completed",
+    ):
+        assert key in detail, f"missing detail axis: {key}"
+    assert detail["shed_total"] == 0
+    assert detail["completed"] == detail["n_requests"]

@@ -295,3 +295,76 @@ def test_broken_fresh_replica_falls_back_to_storage(
         assert step == 5 and restored is not None
     finally:
         eng2.close()
+
+
+class TestMasterDropMidRestore:
+    """Chaos: the master vanishes BETWEEN peek_step() (which saw a
+    fresh replica) and the replica chunk fetch. The engine must fall
+    through to storage, not crash the restore — kv_get surfaces the
+    outage as ConnectionError after its retries."""
+
+    def test_drop_falls_back_to_storage(self, tmp_path):
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"drop-{os.getpid()}"
+        ckpt_dir = str(tmp_path / "ckpt")
+        eng = CheckpointEngine(ckpt_dir)
+        state_a = _state(13)
+        try:
+            eng.save_to_storage(5, state_a)
+            assert eng.wait_for_persist(5, timeout=30)
+        finally:
+            eng.close()
+        master = LocalJobMaster(num_nodes=1)
+        master.start()
+        # single attempt: the drop must fail fast, not burn backoff
+        client = MasterClient(
+            master.addr, node_id=0, node_type="worker", max_retries=1
+        )
+        rm = CkptReplicaManager(master_client=client, node_rank=0)
+        flat, aux = flatten_state(_state(14))
+        rm.backup(9, flat, aux)  # fresher than storage's step 5
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"drop2-{os.getpid()}"
+        eng2 = CheckpointEngine(ckpt_dir, replica_manager=rm)
+        orig_restore = rm.restore_state
+
+        def dying_restore(*a, **kw):
+            master.stop()  # the real gRPC server goes away mid-restore
+            return orig_restore(*a, **kw)
+
+        rm.restore_state = dying_restore
+        try:
+            step, restored = eng2.load()
+            assert step == 5  # storage, reached through the outage
+            np.testing.assert_allclose(
+                restored["params"]["w"],
+                np.asarray(jax.device_get(state_a["params"]["w"])),
+            )
+        finally:
+            eng2.close()
+            client.close()
+
+    def test_oserror_falls_back_to_storage(self, tmp_path, client):
+        """Same guard for OSError (socket-layer failures below gRPC)."""
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"ose-{os.getpid()}"
+        ckpt_dir = str(tmp_path / "ckpt")
+        eng = CheckpointEngine(ckpt_dir)
+        state_a = _state(15)
+        try:
+            eng.save_to_storage(5, state_a)
+            assert eng.wait_for_persist(5, timeout=30)
+        finally:
+            eng.close()
+        rm = CkptReplicaManager(master_client=client, node_rank=0)
+        flat, aux = flatten_state(_state(16))
+        rm.backup(9, flat, aux)
+        os.environ["DLROVER_TPU_JOB_NAME"] = f"ose2-{os.getpid()}"
+        eng2 = CheckpointEngine(ckpt_dir, replica_manager=rm)
+
+        def broken_restore(*a, **kw):
+            raise OSError("connection reset by peer")
+
+        rm.restore_state = broken_restore
+        try:
+            step, restored = eng2.load()
+            assert step == 5 and restored is not None
+        finally:
+            eng2.close()

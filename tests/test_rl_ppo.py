@@ -348,3 +348,34 @@ class TestContinuousRollout:
             trainer.make_experience(
                 prompts, lens, jax.random.PRNGKey(0)
             )
+
+
+class TestContinuousRolloutEosZero:
+    """Regression: a tokenizer whose eos_id is 0 (e.g. sentencepiece
+    unk/pad conventions) must work with the continuous engine. The old
+    rollout hard-coded pad_id=0, which the engine rejects when it
+    collides with eos; the pad now sits outside the vocab at -1."""
+
+    def test_eos_zero_matches_lockstep(self):
+        helper = TestContinuousRollout()
+        cfg, eng = helper._llama_engine(seed=4)
+        prompts, lens = helper._mixed_prompts(4)
+        key = jax.random.PRNGKey(3)
+        auto = PpoTrainer(
+            eng,
+            PpoConfig(max_len=MAX_LEN, temperature=0.0),
+            eos_id=0,
+        )
+        cont = PpoTrainer(
+            eng,
+            PpoConfig(
+                max_len=MAX_LEN,
+                temperature=0.0,
+                rollout_engine="continuous",
+            ),
+            eos_id=0,
+        )
+        exp_a = auto.make_experience(prompts, lens, key)
+        exp_c = cont.make_experience(prompts, lens, key)
+        np.testing.assert_array_equal(exp_a.tokens, exp_c.tokens)
+        np.testing.assert_array_equal(exp_a.mask, exp_c.mask)

@@ -1,0 +1,223 @@
+"""SLO scheduler + engine step API (dlrover_tpu/serving/): admission
+control, deadline shedding, EDF dispatch, streaming deltas, and parity
+of the incremental step() path with generate_all()/the lockstep
+oracle."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _serve_oracle import lockstep_oracle
+from dlrover_tpu.serving.engine import ContinuousBatcher
+from dlrover_tpu.serving.metrics import ServingMetrics
+from dlrover_tpu.serving.scheduler import (
+    AdmissionError,
+    RequestScheduler,
+    RequestState,
+    SloConfig,
+)
+
+
+from dlrover_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(), dtype=jnp.float32
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 250, size=n).tolist() for n in lengths]
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("max_new_tokens", 8)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("pad_id", -1)  # oracle's pad: outside the vocab
+    return ContinuousBatcher(cfg, params, **kw)
+
+
+class TestEngineStepApi:
+    def test_step_deltas_reassemble_generate_all(self, model):
+        """Concatenated step() deltas per request == the drain output
+        — the streaming path emits exactly the batch path's tokens."""
+        cfg, params = model
+        prompts = _prompts((5, 12, 3, 20, 9), seed=1)
+        eng = _engine(cfg, params, n_slots=2)
+        ids = [eng.submit(p) for p in prompts]
+        streamed = {i: [] for i in ids}
+        while eng.has_work():
+            for idx, toks, _done in eng.step():
+                streamed[idx].extend(toks)
+        for p, i in zip(prompts, ids):
+            want = lockstep_oracle(cfg, params, p, 8)
+            assert streamed[i] == want
+            assert list(map(int, eng.retire(i))) == want
+
+    def test_retire_prunes_ledger(self, model):
+        cfg, params = model
+        eng = _engine(cfg, params)
+        i = eng.submit(_prompts((4,), seed=2)[0], max_new=3)
+        while eng.has_work():
+            eng.step()
+        assert len(eng._requests) == 1
+        eng.retire(i)
+        assert len(eng._requests) == 0 and eng._pending == []
+
+    def test_generate_all_after_streaming(self, model):
+        """Mixing modes: a generate_all() drain after retire()d
+        streaming requests returns only the un-returned ones."""
+        cfg, params = model
+        eng = _engine(cfg, params)
+        i = eng.submit(_prompts((5,), seed=3)[0], max_new=3)
+        while eng.has_work():
+            eng.step()
+        eng.retire(i)
+        p = _prompts((7,), seed=4)[0]
+        outs = eng.generate_all([p])
+        assert len(outs) == 1
+        assert list(map(int, outs[0])) == lockstep_oracle(
+            cfg, params, p, 8
+        )
+
+
+class TestAdmission:
+    def test_queue_depth_rejects(self, model):
+        cfg, params = model
+        sched = RequestScheduler(
+            _engine(cfg, params),
+            SloConfig(max_queue_depth=2, max_new_tokens=8),
+        )
+        p = _prompts((4,), seed=5)[0]
+        sched.submit(p)
+        sched.submit(p)
+        with pytest.raises(AdmissionError, match="queue full"):
+            sched.submit(p)
+        assert sched.metrics.rejected_total == 1
+
+    def test_token_budget_rejects(self, model):
+        cfg, params = model
+        sched = RequestScheduler(
+            _engine(cfg, params, max_new_tokens=32),
+            SloConfig(max_new_tokens=8),
+        )
+        with pytest.raises(AdmissionError, match="token budget"):
+            sched.submit(_prompts((4,), seed=6)[0], max_new=9)
+
+    def test_oversize_prompt_rejects(self, model):
+        cfg, params = model
+        sched = RequestScheduler(
+            _engine(cfg, params, max_len=16), SloConfig()
+        )
+        with pytest.raises(AdmissionError, match="no room"):
+            sched.submit(list(range(1, 17)))
+
+
+class TestSheddingAndOrder:
+    def test_expired_request_is_shed(self, model):
+        """A deadline that passes while the request waits sheds it:
+        state SHED, stream terminated, shed counter bumped."""
+        cfg, params = model
+        now = [0.0]
+        sched = RequestScheduler(
+            _engine(cfg, params),
+            SloConfig(default_deadline_s=10.0),
+            clock=lambda: now[0],
+        )
+        req = sched.submit(_prompts((4,), seed=7)[0], deadline_s=5.0)
+        now[0] = 6.0  # past the deadline before any pump
+        sched.run_to_completion()
+        assert req.state is RequestState.SHED
+        assert list(req.iter_stream(timeout=1.0)) == []
+        assert sched.metrics.shed_total == 1
+        assert req.wait(timeout=1.0)
+
+    def test_running_requests_never_shed(self, model):
+        """Once decoding, a request runs to completion even if its
+        deadline passes mid-generation (sunk slot time pays off)."""
+        cfg, params = model
+        now = [0.0]
+        sched = RequestScheduler(
+            _engine(cfg, params, n_slots=1, chunk=2),
+            SloConfig(),
+            clock=lambda: now[0],
+        )
+        req = sched.submit(
+            _prompts((4,), seed=8)[0], max_new=6, deadline_s=5.0
+        )
+        assert sched.pump()  # admitted + first chunk
+        now[0] = 100.0  # deadline long gone
+        sched.run_to_completion()
+        assert req.state is RequestState.DONE
+        assert len(req.tokens) == 6
+        assert sched.metrics.shed_total == 0
+
+    def test_edf_dispatch_order(self, model):
+        """With one slot, the later-submitted but tighter-deadline
+        request decodes first (EDF, not FIFO)."""
+        cfg, params = model
+        sched = RequestScheduler(
+            _engine(cfg, params, n_slots=1), SloConfig()
+        )
+        relaxed = sched.submit(
+            _prompts((4,), seed=9)[0], max_new=2, deadline_s=500.0
+        )
+        urgent = sched.submit(
+            _prompts((5,), seed=10)[0], max_new=2, deadline_s=5.0
+        )
+        sched.run_to_completion()
+        assert urgent.finish_ts <= relaxed.finish_ts
+        assert urgent.state is RequestState.DONE
+
+    def test_scheduler_parity_with_oracle(self, model):
+        """Drained through admission + EDF + slot re-admission, every
+        request's stream is still token-for-token the lockstep
+        oracle's continuation."""
+        cfg, params = model
+        prompts = _prompts((5, 12, 3, 20, 9, 7, 15), seed=11)
+        sched = RequestScheduler(
+            _engine(cfg, params, n_slots=3), SloConfig()
+        )
+        reqs = [sched.submit(p, max_new=8) for p in prompts]
+        sched.run_to_completion()
+        for p, r in zip(prompts, reqs):
+            assert r.tokens == lockstep_oracle(cfg, params, p, 8)
+            assert r.state is RequestState.DONE
+
+
+class TestMetrics:
+    def test_counters_and_render(self, model):
+        cfg, params = model
+        metrics = ServingMetrics()
+        sched = RequestScheduler(
+            _engine(cfg, params), SloConfig(), metrics=metrics
+        )
+        reqs = [
+            sched.submit(p, max_new=4)
+            for p in _prompts((5, 9, 3), seed=12)
+        ]
+        sched.run_to_completion()
+        assert metrics.requests_total == 3
+        assert metrics.completed_total == 3
+        assert metrics.tokens_total == sum(
+            len(r.tokens) for r in reqs
+        )
+        text = metrics.render()
+        for needle in (
+            "# TYPE serving_ttft_ms summary",
+            "# TYPE serving_tpot_ms summary",
+            "# TYPE serving_queue_depth gauge",
+            "serving_requests_total 3",
+            'serving_ttft_ms{quantile="0.5"}',
+        ):
+            assert needle in text, text
