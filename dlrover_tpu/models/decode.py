@@ -388,6 +388,115 @@ def prefill_into_slot(
     return out
 
 
+# ---------------------------------------------------------------------------
+# prefix-pool primitives (serving/engine.py's admission-time prefix cache)
+#
+# The pool is a second KV bank beside the slot bank whose rows hold
+# EXACT (unquantized) K/V for block-aligned prompt prefixes. Keeping
+# the pool exact is what makes cached admission token-for-token equal
+# to cold prefill even with an int8 slot bank: install re-quantizes
+# the exact values with the same _kv_quantize the cold write path
+# uses, so the slot bytes come out identical either way (whereas a
+# quantized pool would chain dequantize→attend→requantize drift into
+# the suffix).
+#
+# All four helpers are shape-static in everything but scalars
+# (slot/row/start), so the engine compiles each exactly once per
+# suffix bucket — the same log2(max_len) discipline as prefill.
+# ---------------------------------------------------------------------------
+
+
+def exact_row_cache(cfg, max_len: int) -> Dict[str, jax.Array]:
+    """A single-sequence full-precision cache row [L, 1, M, KV, hd] —
+    the working buffer admission prefills into and publishes from."""
+    return init_kv_cache(cfg, 1, max_len, quant=False)
+
+
+def prefill_exact_row(
+    cfg, params, prompt: jax.Array, max_len: int
+) -> Dict[str, jax.Array]:
+    """Cold-admission prefill: run `prompt` [P] (pad tail fine) into a
+    fresh exact row. The forward is identical to prefill_into_slot's
+    (plain-causal attention never reads the cache, so an unquantized
+    target changes nothing about the computed K/V)."""
+    row = exact_row_cache(cfg, max_len)
+    _, row = prefill(cfg, params, prompt[None], row)
+    return row
+
+
+def prefill_suffix_row(
+    cfg, params, suffix: jax.Array, row: Dict[str, jax.Array], start
+) -> Dict[str, jax.Array]:
+    """Warm-admission prefill: extend an exact row that already holds
+    K/V for positions [0, start) with `suffix` [S] at positions
+    [start, start+S). Suffix queries attend over the installed prefix
+    AND the suffix itself through the position-masked cached-attention
+    path (each chunk position is written before it is read).
+
+    `start` is a traced scalar — one compiled program per suffix
+    bucket, any prefix length. The caller guarantees start + S fits
+    the row (engine clamps the match depth so the bucket fits)."""
+    s = suffix.shape[0]
+    positions = (jnp.asarray(start, jnp.int32) + jnp.arange(s))[None]
+    _, row = _forward_cached(
+        cfg, params, suffix[None], row, positions, start
+    )
+    return row
+
+
+def install_exact_row(
+    cache: Dict[str, jax.Array], row: Dict[str, jax.Array], slot
+) -> Dict[str, jax.Array]:
+    """Write an exact row into slot `slot` of the (possibly int8)
+    slot bank, quantizing on the way in when the bank is quantized —
+    the same per-vector scheme the cold write path applies, on the
+    same exact values, so the installed bytes match a cold prefill's.
+    Whole-row write: cells beyond the valid prefix carry garbage that
+    the decode position mask hides until generation overwrites them
+    (the prefill_into_slot pad-tail argument)."""
+    if "k_scale" in cache:
+        kq, ks = _kv_quantize(row["k"])
+        vq, vs = _kv_quantize(row["v"])
+        src = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+    else:
+        src = row
+    out = {}
+    for name, arr in cache.items():
+        out[name] = jax.lax.dynamic_update_slice(
+            arr,
+            src[name].astype(arr.dtype),
+            (0, slot) + (0,) * (arr.ndim - 2),
+        )
+    return out
+
+
+def pool_take_row(
+    pool: Dict[str, jax.Array], row
+) -> Dict[str, jax.Array]:
+    """Copy pool row `row` out as a single-sequence exact cache."""
+    out = {}
+    for name, arr in pool.items():
+        size = (arr.shape[0], 1) + arr.shape[2:]
+        out[name] = jax.lax.dynamic_slice(
+            arr, (0, row) + (0,) * (arr.ndim - 2), size
+        )
+    return out
+
+
+def pool_put_row(
+    pool: Dict[str, jax.Array], row_cache: Dict[str, jax.Array], row
+) -> Dict[str, jax.Array]:
+    """Publish an exact row into pool row `row` (whole-row write)."""
+    out = {}
+    for name, arr in pool.items():
+        out[name] = jax.lax.dynamic_update_slice(
+            arr,
+            row_cache[name].astype(arr.dtype),
+            (0, row) + (0,) * (arr.ndim - 2),
+        )
+    return out
+
+
 def _mask_top_k(logits: jax.Array, k: int) -> jax.Array:
     """Keep the k highest logits per row; the rest become -inf. Static
     k, so the top_k + threshold compare stays one fused XLA program.

@@ -13,10 +13,13 @@ inference:
   replica.py   — replica pool: KV-store registration, health checks,
                  queue-pressure scale hints for the auto-scaler
   metrics.py   — TTFT/TPOT/queue-depth counters, Prometheus exposition
+  prefix_cache.py — radix-matched prompt-prefix reuse for admission
+                 (suffix-only prefill over an LRU'd device KV pool)
 """
 
 from dlrover_tpu.serving.engine import ContinuousBatcher, GenerationEngine
 from dlrover_tpu.serving.metrics import ServingMetrics
+from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
 from dlrover_tpu.serving.scheduler import (
     AdmissionError,
     RequestScheduler,
@@ -32,6 +35,7 @@ __all__ = [
     "ContinuousBatcher",
     "GenerationEngine",
     "InferenceReplica",
+    "RadixPrefixCache",
     "ReplicaPool",
     "RequestScheduler",
     "RequestState",

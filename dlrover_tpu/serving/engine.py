@@ -20,6 +20,13 @@ KV. TPU re-design, not a port:
   overwriting its cache prefix (prefill_into_slot); cells beyond the
   new prompt are dead by the position mask, so no page table is
   needed at this granularity.
+- prompt-prefix reuse (vLLM's prefix caching) is admission-time and
+  copy-based: `prefix_cache_rows > 0` keeps a radix tree of
+  block-aligned prompt prefixes (serving/prefix_cache.py) whose K/V
+  live in a second exact-dtype bank; a matched admission installs the
+  prefix with one compiled copy and prefills ONLY the suffix bucket.
+  A fleet sharing a 512-token system prompt pays its prefill once,
+  not per request — and the chunk-scan program never changes.
 - host↔device chatter is amortized by decoding `chunk` steps per
   dispatch inside one lax.scan (the axon tunnel has a ~1.5 ms
   dispatch floor; a finished slot idles at most chunk-1 steps before
@@ -58,8 +65,14 @@ from dlrover_tpu.models.decode import (
     _mask_top_p,
     decode_step,
     init_kv_cache,
+    install_exact_row,
+    pool_put_row,
+    pool_take_row,
+    prefill_exact_row,
     prefill_into_slot,
+    prefill_suffix_row,
 )
+from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
 
 
 def _pad_bucket(n: int, lo: int = 16) -> int:
@@ -82,6 +95,123 @@ class _Request:
 
 # one step() event: (request idx, tokens emitted this chunk, finished)
 StepEvent = Tuple[int, List[int], bool]
+
+
+# ---------------------------------------------------------------------------
+# Compiled-program caches. The jitted closures are built per
+# (config, knobs) key, NOT per engine instance: a second engine with
+# the same shapes — a restarted replica, the bench's cold/warm passes,
+# a test suite full of tiny engines — reuses the first one's programs
+# (and their XLA compile caches) instead of re-tracing everything.
+# Split in two because the admission/pool programs don't depend on the
+# sampling knobs: a greedy engine and a sampled engine over the same
+# model share every admit compile.
+
+_CHUNK_PROGRAMS: Dict[Any, Any] = {}
+_ADMIT_PROGRAMS: Dict[Any, Any] = {}
+
+
+def _cached_program(cache: Dict[Any, Any], key, build):
+    try:
+        prog = cache.get(key)
+    except TypeError:  # unhashable config: fall back to per-instance
+        return build()
+    if prog is None:
+        prog = cache[key] = build()
+    return prog
+
+
+def _build_chunk_program(
+    cfg, pad_id, eos_id, temperature, top_k, top_p
+):
+    def _sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if 0 < top_k < logits.shape[-1]:
+            logits = _mask_top_k(logits, top_k)
+        if top_p < 1.0:
+            logits = _mask_top_p(logits, top_p)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+
+    @partial(jax.jit, donate_argnums=(0,), static_argnums=(7,))
+    def _run_chunk(cache, params, tok, pos, done, limit, key, k):
+        def body(carry, _):
+            cache, tok, pos, done, key = carry
+            logits, cache = decode_step(cfg, params, tok, cache, pos)
+            key, sub = jax.random.split(key)
+            nxt = _sample(logits, sub)
+            nxt = jnp.where(done, pad_id, nxt)
+            hit_eos = (
+                (nxt == eos_id)
+                if eos_id is not None
+                else jnp.zeros_like(done)
+            )
+            # tokens generated through this step = pos+2-prompt_len
+            # (carry enters at prompt_len-1), so the length cap
+            # limit = prompt_len + max_new fires at pos+2 >= limit
+            new_done = done | hit_eos | (pos + 2 >= limit)
+            pos = jnp.where(done, pos, pos + 1)
+            tok = jnp.where(done, tok, nxt)
+            return (cache, tok, pos, new_done, key), nxt
+
+        (cache, tok, pos, done, key), emitted = jax.lax.scan(
+            body, (cache, tok, pos, done, key), None, length=k,
+        )
+        return cache, tok, pos, done, key, emitted.T  # [B, k]
+
+    return _run_chunk
+
+
+def _build_admit_programs(cfg, max_len):
+    """Admission + prefix-pool programs. Each retraces once per
+    prompt/suffix BUCKET (log2(max_len) shapes total); slot/row/start
+    are traced scalars so no recompile per slot, row, or prefix
+    length. The cache/pool argument is donated: an admission updates
+    the bank in place instead of copying it."""
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _admit_fn(cache, params, prompt, slot):
+        return prefill_into_slot(cfg, params, prompt, cache, slot)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _admit_cold_fn(cache, params, prompt, slot):
+        """Full prefill into an exact working row, installed into
+        the slot (quantizing iff the bank is int8). Returns the
+        row too so the host can publish its prefix."""
+        row = prefill_exact_row(cfg, params, prompt, max_len)
+        return install_exact_row(cache, row, slot), row
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _admit_warm_fn(cache, pool, params, suffix, slot, row, start):
+        """Suffix-only prefill: copy pool row `row` (exact K/V of
+        the matched prefix) into a working row, run ONLY the
+        suffix forward at positions [start, start+S), install."""
+        work = pool_take_row(pool, row)
+        work = prefill_suffix_row(cfg, params, suffix, work, start)
+        return install_exact_row(cache, work, slot), work
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _admit_hit_fn(cache, pool, slot, row):
+        """Full-prefix hit: zero prefill FLOPs — install the pool
+        row and let the first chunk step recompute the last prompt
+        token's logits from the cache (the cold path discards its
+        prefill logits the same way)."""
+        return install_exact_row(
+            cache, pool_take_row(pool, row), slot
+        )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _publish_fn(pool, work, row):
+        return pool_put_row(pool, work, row)
+
+    return {
+        "admit": _admit_fn,
+        "cold": _admit_cold_fn,
+        "warm": _admit_warm_fn,
+        "hit": _admit_hit_fn,
+        "publish": _publish_fn,
+    }
 
 
 class ContinuousBatcher:
@@ -107,6 +237,8 @@ class ContinuousBatcher:
         chunk: int = 8,   # steps per dispatch; see _next_chunk_len
         seed: int = 0,
         kv_quant: bool = False,  # int8 KV cache (~2x slots per HBM)
+        prefix_cache_rows: int = 0,  # 0 disables the prefix cache
+        prefix_block: int = 16,      # prefix match granularity (tokens)
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -137,61 +269,51 @@ class ContinuousBatcher:
         # A dict (not a list) so the serving path can retire() finished
         # requests individually without shifting later indices.
         self._requests: Dict[int, _Request] = {}
-        self._pending: List[int] = []  # submitted, not yet returned
+        # submitted, not yet returned — an insertion-ordered dict used
+        # as an ordered set: retire() must be O(1), not an O(n) list
+        # scan, or a long-lived serving engine degrades linearly in
+        # requests ever served
+        self._pending: Dict[int, None] = {}
         self._next_idx = 0
 
-        def _sample(logits, key):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / temperature
-            if 0 < top_k < logits.shape[-1]:
-                logits = _mask_top_k(logits, top_k)
-            if top_p < 1.0:
-                logits = _mask_top_p(logits, top_p)
-            return jax.random.categorical(key, logits).astype(
-                jnp.int32
+        # ---- admission-time prefix cache --------------------------------
+        # A radix tree over block-quantized prompt prefixes whose rows
+        # live in a second, exact-dtype KV bank beside the slot bank.
+        # On admission the longest cached block-aligned prefix is
+        # installed into the slot with one compiled copy and only the
+        # SUFFIX is prefilled; the request's own aligned prefix is
+        # published back for the next arrival. See prefix_cache.py for
+        # the design note vs vLLM page tables.
+        self.prefix_cache: Optional[RadixPrefixCache] = None
+        self.pool = None
+        # pool row pinned per slot while its request is in flight
+        self._slot_row: List[Optional[int]] = [None] * n_slots
+        if prefix_cache_rows > 0:
+            self.prefix_cache = RadixPrefixCache(
+                prefix_cache_rows, block=prefix_block
             )
+            # exact dtype even when the slot bank is int8: install
+            # re-quantizes, which keeps warm admissions byte-identical
+            # to cold ones (models/decode.py pool primitives)
+            self.pool = init_kv_cache(cfg, prefix_cache_rows, max_len)
 
-        @partial(
-            jax.jit, donate_argnums=(0,), static_argnums=(7,)
+        self._run_chunk = _cached_program(
+            _CHUNK_PROGRAMS,
+            (cfg, pad_id, eos_id, temperature, top_k, top_p),
+            lambda: _build_chunk_program(
+                cfg, pad_id, eos_id, temperature, top_k, top_p
+            ),
         )
-        def _run_chunk(cache, params, tok, pos, done, limit, key, k):
-            def body(carry, _):
-                cache, tok, pos, done, key = carry
-                logits, cache = decode_step(
-                    cfg, params, tok, cache, pos
-                )
-                key, sub = jax.random.split(key)
-                nxt = _sample(logits, sub)
-                nxt = jnp.where(done, pad_id, nxt)
-                hit_eos = (
-                    (nxt == eos_id)
-                    if eos_id is not None
-                    else jnp.zeros_like(done)
-                )
-                # tokens generated through this step = pos+2-prompt_len
-                # (carry enters at prompt_len-1), so the length cap
-                # limit = prompt_len + max_new fires at pos+2 >= limit
-                new_done = done | hit_eos | (pos + 2 >= limit)
-                pos = jnp.where(done, pos, pos + 1)
-                tok = jnp.where(done, tok, nxt)
-                return (cache, tok, pos, new_done, key), nxt
-
-            (cache, tok, pos, done, key), emitted = jax.lax.scan(
-                body, (cache, tok, pos, done, key), None, length=k,
-            )
-            return cache, tok, pos, done, key, emitted.T  # [B, k]
-
-        self._run_chunk = _run_chunk
-
-        # admission compiled too (retraces once per prompt bucket,
-        # log2(max_len) shapes total); cache donated so an admission
-        # updates in place instead of copying the whole slot bank
-        @partial(jax.jit, donate_argnums=(0,))
-        def _admit_fn(cache, params, prompt, slot):
-            return prefill_into_slot(cfg, params, prompt, cache, slot)
-
-        self._admit_fn = _admit_fn
+        admit = _cached_program(
+            _ADMIT_PROGRAMS,
+            (cfg, max_len),
+            lambda: _build_admit_programs(cfg, max_len),
+        )
+        self._admit_fn = admit["admit"]
+        self._admit_cold_fn = admit["cold"]
+        self._admit_warm_fn = admit["warm"]
+        self._admit_hit_fn = admit["hit"]
+        self._publish_fn = admit["publish"]
 
     def _next_chunk_len(self) -> int:
         """Dispatch size: `chunk` steps, shortened only when EVERY
@@ -209,11 +331,9 @@ class ContinuousBatcher:
         overheads shrink ~10x against the real-model step time on
         chip). A mid-chunk release idles one slot for at most
         chunk-1 steps while the others keep working."""
-        rem = max(
-            int(self.limit[s] - self.pos[s] - 1)
-            for s in range(self.n_slots)
-            if not self.done[s]
-        )
+        # vectorized over the host-side [B] arrays (a Python generator
+        # here costs O(n_slots) interpreter work EVERY chunk)
+        rem = int((self.limit - self.pos - 1)[~self.done].max())
         k_target = max(1, min(rem, self.chunk))
         if k_target == self.chunk:
             return k_target
@@ -258,18 +378,27 @@ class ContinuousBatcher:
         )
         self._next_idx += 1
         self._requests[req.idx] = req
-        self._pending.append(req.idx)
+        self._pending[req.idx] = None
         self._queue.append(req)
         return req.idx
 
+    def _pad_to(self, toks: np.ndarray, bucket: int) -> np.ndarray:
+        padded = np.full(bucket, self.pad_id, np.int32)
+        padded[: len(toks)] = toks
+        return padded
+
     def _admit(self, slot: int, req: _Request):
         p = len(req.prompt)
-        bucket = min(_pad_bucket(p), self.max_len)
-        padded = np.full(bucket, self.pad_id, np.int32)
-        padded[:p] = req.prompt
-        self.cache = self._admit_fn(
-            self.cache, self.params, jnp.asarray(padded), slot
-        )
+        if self.prefix_cache is None:
+            bucket = min(_pad_bucket(p), self.max_len)
+            self.cache = self._admit_fn(
+                self.cache,
+                self.params,
+                jnp.asarray(self._pad_to(req.prompt, bucket)),
+                slot,
+            )
+        else:
+            self._admit_with_prefix(slot, req, p)
         # carry = last REAL prompt token at its position: the first
         # chunk step recomputes its logits (identical K/V rewrite)
         # and samples the first new token from them
@@ -280,6 +409,69 @@ class ContinuousBatcher:
         )
         self.done[slot] = False
         self.slot_req[slot] = req
+
+    def _admit_with_prefix(self, slot: int, req: _Request, p: int):
+        """Prefix-cached admission: install the longest cached
+        block-aligned prefix, prefill only the suffix bucket, publish
+        the request's own aligned prefix for the next arrival."""
+        pc = self.prefix_cache
+        matched, row = pc.match(req.prompt)
+        # a matched depth whose suffix bucket would overrun max_len
+        # retreats block by block (the pool row stays valid for any
+        # shallower start); start==0 degrades to a cold admission
+        start = min(matched, p)
+        while start > 0 and start + _pad_bucket(p - start) > self.max_len:
+            start -= pc.block
+        start = max(start, 0)
+        work = None
+        if start <= 0 or row is None:
+            bucket = min(_pad_bucket(p), self.max_len)
+            self.cache, work = self._admit_cold_fn(
+                self.cache,
+                self.params,
+                jnp.asarray(self._pad_to(req.prompt, bucket)),
+                slot,
+            )
+            pc.record_admission(0)
+        else:
+            # pin the row for the life of the slot occupancy: install
+            # copies the K/V, but the pin is the invariant ("never
+            # evict under a live slot") a zero-copy backend will need
+            pc.acquire(row)
+            self._slot_row[slot] = row
+            if start >= p:
+                self.cache = self._admit_hit_fn(
+                    self.cache, self.pool, slot, row
+                )
+            else:
+                suffix = self._pad_to(
+                    req.prompt[start:], _pad_bucket(p - start)
+                )
+                self.cache, work = self._admit_warm_fn(
+                    self.cache,
+                    self.pool,
+                    self.params,
+                    jnp.asarray(suffix),
+                    slot,
+                    row,
+                    start,
+                )
+            pc.record_admission(start)
+        # publish the aligned prefix when it is deeper than what was
+        # cached (at admission time, not retire: the K/V is fresh in
+        # the working row, and the NEXT request in this very batch —
+        # the shared-system-prompt case — already hits)
+        publish_len = pc.aligned_len(p)
+        if work is not None and publish_len > matched:
+            new_row, is_new = pc.insert(req.prompt[:publish_len])
+            if is_new:
+                self.pool = self._publish_fn(self.pool, work, new_row)
+
+    def _release_slot_row(self, slot: int):
+        row = self._slot_row[slot]
+        if row is not None:
+            self.prefix_cache.release(row)
+            self._slot_row[slot] = None
 
     # -- the loop ----------------------------------------------------------
 
@@ -342,6 +534,8 @@ class ContinuousBatcher:
             finished = bool(new_done[slot])
             if finished:
                 req.done = True
+                if self.prefix_cache is not None:
+                    self._release_slot_row(slot)
             if new_toks or finished:
                 events.append((req.idx, new_toks, finished))
         self.done = new_done
@@ -352,7 +546,9 @@ class ContinuousBatcher:
         — the streaming path's per-request counterpart of
         generate_all()'s end-of-drain cleanup (without it a long-lived
         serving engine retains every request ever served)."""
-        self._pending.remove(idx)
+        if idx not in self._pending:
+            raise KeyError(f"request {idx} is not pending")
+        del self._pending[idx]
         return np.asarray(self._requests.pop(idx).out, np.int32)
 
     def generate_all(
@@ -373,7 +569,7 @@ class ContinuousBatcher:
             np.asarray(self._requests.pop(i).out, np.int32)
             for i in self._pending
         ]
-        self._pending = []
+        self._pending = {}
         return out
 
 

@@ -180,7 +180,18 @@ class ServingGateway:
     def _health(self) -> dict:
         reps = getattr(self.backend, "healthy_replicas", None)
         n = len(reps()) if callable(reps) else 1
-        return {"ok": n > 0, "replicas": n}
+        out = {"ok": n > 0, "replicas": n}
+        pc = self._prefix_cache()
+        if pc is not None:
+            out["prefix_cache"] = pc.stats()
+        return out
+
+    def _prefix_cache(self):
+        """The backing engine's RadixPrefixCache, when the backend is
+        a single scheduler with the cache enabled (a replica pool
+        aggregates through /metrics instead)."""
+        engine = getattr(self.backend, "engine", None)
+        return getattr(engine, "prefix_cache", None)
 
     @property
     def port(self) -> int:

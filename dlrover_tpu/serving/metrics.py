@@ -64,6 +64,13 @@ class ServingMetrics:
         self._tokens_total = 0
         # (tokens, ts) window for the tokens/sec rate gauge
         self._token_events: Deque[Tuple[int, float]] = deque(maxlen=512)
+        # prefix-cache counters: copied verbatim from the engine's
+        # RadixPrefixCache (which owns the monotonic truth) each pump,
+        # so the exposition needs no engine reference
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_evictions = 0
+        self._prefix_tokens_reused = 0
 
     # ---- ingestion -------------------------------------------------------
 
@@ -104,6 +111,24 @@ class ServingMetrics:
         with self._lock:
             self._active_requests = n
 
+    def update_prefix_cache(
+        self, hits: int, misses: int, evictions: int,
+        tokens_reused: int,
+    ):
+        """Refresh the prefix-cache counters from the engine's radix
+        cache. Values are running totals; max() guards a multi-replica
+        pool from a lagging replica rolling a shared exposition
+        backwards (Prometheus counters must be monotonic)."""
+        with self._lock:
+            self._prefix_hits = max(self._prefix_hits, hits)
+            self._prefix_misses = max(self._prefix_misses, misses)
+            self._prefix_evictions = max(
+                self._prefix_evictions, evictions
+            )
+            self._prefix_tokens_reused = max(
+                self._prefix_tokens_reused, tokens_reused
+            )
+
     # ---- queries ---------------------------------------------------------
 
     @property
@@ -135,6 +160,21 @@ class ServingMetrics:
     def queue_depth(self) -> int:
         with self._lock:
             return self._queue_depth
+
+    @property
+    def prefix_hits(self) -> int:
+        with self._lock:
+            return self._prefix_hits
+
+    @property
+    def prefix_misses(self) -> int:
+        with self._lock:
+            return self._prefix_misses
+
+    @property
+    def prefix_tokens_reused(self) -> int:
+        with self._lock:
+            return self._prefix_tokens_reused
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -219,6 +259,27 @@ class ServingMetrics:
                 "serving_tokens_total",
                 "Tokens emitted.",
                 self._tokens_total,
+            )
+            counter(
+                "serving_prefix_cache_hits_total",
+                "Admissions that reused a cached prompt prefix.",
+                self._prefix_hits,
+            )
+            counter(
+                "serving_prefix_cache_misses_total",
+                "Admissions with no usable cached prefix.",
+                self._prefix_misses,
+            )
+            counter(
+                "serving_prefix_cache_evictions_total",
+                "Prefix pool rows evicted (LRU).",
+                self._prefix_evictions,
+            )
+            counter(
+                "serving_prefix_tokens_reused_total",
+                "Prompt tokens whose prefill was skipped via the "
+                "prefix cache.",
+                self._prefix_tokens_reused,
             )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()

@@ -1,0 +1,232 @@
+"""Host-side radix index over block-quantized prompt prefixes.
+
+The admission-time prefix cache (serving/engine.py) splits into two
+halves:
+
+- DEVICE: a prefix pool — a second, smaller KV bank beside the slot
+  bank, one full-length row per cached prefix. Rows are written once
+  at publish time and copied whole at install time (one
+  dynamic_slice + dynamic_update_slice program for ANY row/slot pair:
+  no per-length recompiles, same bucketing discipline as the engine's
+  chunk scan).
+- HOST: this radix tree — the only thing that knows which pool row
+  holds which token prefix and how many of its cache cells are valid.
+
+Design vs vLLM's page tables (docs/DEVIATIONS.md §6): vLLM shares K/V
+at page granularity through an indirection table the attention kernel
+walks. Our slot bank attends over a dense per-slot buffer (the whole
+point of the static-shape TPU design), so sharing is COPY-based: a
+matched prefix's K/V is gathered from its pool row into the slot once
+at admission, and the pool row itself is immutable until evicted.
+That keeps the decode program untouched — the cache is an admission
+optimization, invisible to the chunk scan.
+
+Token prefixes are quantized to `block` tokens (default 16, matching
+`_pad_bucket`'s floor): every tree edge is one block, so lookup cost
+is O(prefix/block) tuple hashes and a prompt can only match at
+block-aligned lengths — exactly the lengths whose suffix buckets the
+engine already compiles.
+
+Eviction is LRU over UNREFERENCED rows: a row acquired by a live slot
+(admission installed from it and the request is still in flight) is
+pinned until `release()`. With copy-based install the pin is not
+needed for memory safety today, but it is the invariant a future
+zero-copy page-table backend needs, so the property tests pin it now
+(tests/test_serving_prefix_cache.py).
+"""
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Node:
+    """One radix node = one block-aligned prefix. `row` is the pool
+    row holding K/V for positions [0, depth), or None for a pure
+    interior node (a longer prefix was published through here)."""
+
+    __slots__ = ("children", "parent", "edge", "depth", "row")
+
+    def __init__(self, parent=None, edge=None, depth=0):
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        self.edge = edge          # block tuple keying us in parent
+        self.depth = depth        # prefix length in TOKENS
+        self.row: Optional[int] = None
+
+
+class RadixPrefixCache:
+    """Radix-matched prefix → pool-row index, ref-counted LRU.
+
+    Pure host bookkeeping: it never touches device memory. The engine
+    owns the device pool and calls match/insert/acquire/release; the
+    row numbers handed out here are its row indices there.
+    """
+
+    def __init__(self, n_rows: int, block: int = 16):
+        if n_rows < 1:
+            raise ValueError(f"n_rows must be >= 1, got {n_rows}")
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        self.n_rows = n_rows
+        self.block = block
+        self.root = _Node()
+        self._row_node: Dict[int, _Node] = {}
+        self._free: List[int] = list(range(n_rows))
+        # insertion/touch order = LRU order (oldest first)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._refs: Dict[int, int] = {}
+        # monotonic counters (Prometheus-friendly; ServingMetrics
+        # copies them verbatim)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.tokens_reused = 0
+
+    # ---- lookup ----------------------------------------------------------
+
+    def aligned_len(self, n: int) -> int:
+        """Longest block-aligned prefix length of an n-token prompt."""
+        return (n // self.block) * self.block
+
+    def _block_key(self, tokens, i: int) -> Tuple[int, ...]:
+        return tuple(int(t) for t in tokens[i : i + self.block])
+
+    def match(self, tokens: Sequence[int]) -> Tuple[int, Optional[int]]:
+        """Longest block-aligned cached prefix of `tokens` →
+        (matched_len, pool_row). (0, None) on a complete miss. The
+        matched row is touched in LRU order but NOT acquired — call
+        `acquire(row)` before handing it to device code."""
+        node = self.root
+        best_len, best_row = 0, None
+        n = self.aligned_len(len(tokens))
+        for i in range(0, n, self.block):
+            child = node.children.get(self._block_key(tokens, i))
+            if child is None:
+                break
+            node = child
+            if node.row is not None:
+                best_len, best_row = node.depth, node.row
+        if best_row is not None:
+            self._lru.move_to_end(best_row)
+        return best_len, best_row
+
+    # ---- ref counting ----------------------------------------------------
+
+    def acquire(self, row: int) -> None:
+        """Pin a row while a live slot depends on it (admission is
+        installing from it, or the installed request is in flight)."""
+        if row not in self._row_node:
+            raise KeyError(f"row {row} is not allocated")
+        self._refs[row] = self._refs.get(row, 0) + 1
+
+    def release(self, row: int) -> None:
+        n = self._refs.get(row, 0)
+        if n <= 0:
+            raise ValueError(f"release of unreferenced row {row}")
+        if n == 1:
+            del self._refs[row]
+        else:
+            self._refs[row] = n - 1
+
+    def refcount(self, row: int) -> int:
+        return self._refs.get(row, 0)
+
+    # ---- publish ---------------------------------------------------------
+
+    def insert(
+        self, tokens: Sequence[int]
+    ) -> Tuple[Optional[int], bool]:
+        """Claim a pool row for the (block-aligned) prefix `tokens`.
+
+        Returns (row, is_new): is_new=True means the caller must now
+        write the K/V into that device row (the tree records the
+        mapping first so eviction accounting can never orphan a
+        written row). (row, False) when the exact prefix is already
+        cached; (None, False) when every row is pinned by a live
+        reference and nothing can be evicted — the caller just skips
+        publishing."""
+        n = self.aligned_len(len(tokens))
+        if n < self.block:
+            return None, False
+        node = self.root
+        for i in range(0, n, self.block):
+            key = self._block_key(tokens, i)
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(
+                    parent=node, edge=key, depth=node.depth + self.block
+                )
+                node.children[key] = child
+            node = child
+        if node.row is not None:
+            self._lru.move_to_end(node.row)
+            return node.row, False
+        # reserve the target before allocating: _alloc may evict a
+        # descendant's row, and the resulting _prune must not detach
+        # THIS (still rowless) node when that was its last child
+        node.row = -1
+        row = self._alloc()
+        node.row = None
+        if row is None:
+            self._prune(node)
+            return None, False
+        node.row = row
+        self._row_node[row] = node
+        self._lru[row] = None
+        return row, True
+
+    def _alloc(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        for row in self._lru:  # oldest-touched first
+            if self._refs.get(row, 0) == 0:
+                self._evict(row)
+                return row
+        return None
+
+    def _evict(self, row: int) -> None:
+        assert self._refs.get(row, 0) == 0, (
+            f"evicting row {row} with live references"
+        )
+        node = self._row_node.pop(row)
+        node.row = None
+        del self._lru[row]
+        self.evictions += 1
+        self._prune(node)
+
+    @staticmethod
+    def _prune(node: _Node) -> None:
+        """Drop rowless leaf chains so a churned tree stays O(rows)."""
+        while (
+            node.parent is not None
+            and node.row is None
+            and not node.children
+        ):
+            parent = node.parent
+            del parent.children[node.edge]
+            node = parent
+
+    # ---- accounting ------------------------------------------------------
+
+    def record_admission(self, reused_tokens: int) -> None:
+        """One admission's outcome: reused_tokens > 0 is a hit."""
+        if reused_tokens > 0:
+            self.hits += 1
+            self.tokens_reused += reused_tokens
+        else:
+            self.misses += 1
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "tokens_reused": self.tokens_reused,
+            "hit_rate": self.hit_rate(),
+            "rows_used": len(self._row_node),
+            "rows_total": self.n_rows,
+        }

@@ -168,6 +168,13 @@ class RequestScheduler:
             if arr.ndim != 1 or arr.size == 0:
                 self.metrics.request_rejected()
                 raise AdmissionError("prompt must be non-empty 1-D")
+            # mirrors engine.submit()'s room-to-generate check — and
+            # stays correct with the prefix cache on: even a fully
+            # cached prompt still needs one cell past the prompt
+            # (limit >= p+1), and the engine clamps a matched depth
+            # until the SUFFIX bucket fits max_len, so no prompt the
+            # engine accepts cold becomes inadmissible warm (pinned by
+            # tests/test_serving_prefix_cache.py::test_admission_checks_agree)
             if arr.size + 1 > self.engine.max_len:
                 self.metrics.request_rejected()
                 raise AdmissionError(
@@ -270,6 +277,11 @@ class RequestScheduler:
                     self.metrics.request_completed()
             self.metrics.set_queue_depth(len(self._waiting))
             self.metrics.set_active_requests(len(self._running))
+            pc = getattr(self.engine, "prefix_cache", None)
+            if pc is not None:
+                self.metrics.update_prefix_cache(
+                    pc.hits, pc.misses, pc.evictions, pc.tokens_reused
+                )
             return bool(self._waiting) or bool(self._running)
 
     def run_to_completion(self):

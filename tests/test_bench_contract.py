@@ -124,7 +124,23 @@ def test_serve_bench_smoke_emits_driver_contract():
         "n_requests",
         "shed_total",
         "completed",
+        # shared-system-prompt phase: the prefix-cache evidence axes
+        "prefix_hit_rate",
+        "prefix_tokens_reused",
+        "prefix_evictions",
+        "prefix_pool_rows",
+        "sys_prompt_len",
+        "n_prefix_requests",
+        "ttft_cold_ms_p50",
+        "ttft_cold_ms_p95",
+        "ttft_warm_ms_p50",
+        "ttft_warm_ms_p95",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
     assert detail["completed"] == detail["n_requests"]
+    # the tentpole's acceptance floor: most admissions reuse the
+    # shared prefix, and reuse buys real admission latency
+    assert detail["prefix_hit_rate"] > 0.9
+    assert detail["ttft_warm_ms_p50"] < detail["ttft_cold_ms_p50"]
+    assert detail["prefix_tokens_reused"] > 0

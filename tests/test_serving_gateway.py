@@ -323,6 +323,58 @@ class TestMetricsEndpoint:
             gw.stop()
             pool.stop()
 
+    def test_prefix_cache_exposition(self, model):
+        """With the prefix cache on, /metrics carries its counters
+        and /healthz its stats — the fleet-side view of reuse."""
+        cfg, params = model
+        eng = ContinuousBatcher(
+            cfg, params, n_slots=2, max_len=64, max_new_tokens=8,
+            chunk=4, pad_id=-1, prefix_cache_rows=4,
+        )
+        metrics = ServingMetrics()
+        sched = RequestScheduler(eng, SloConfig(), metrics=metrics)
+        sched.start()
+        gw = ServingGateway(sched, metrics=metrics)
+        gw.start()
+        try:
+            rng = np.random.default_rng(5)
+            shared = rng.integers(1, 250, size=32).tolist()
+            for tail in ([1, 2], [3]):  # cold publish, then a hit
+                toks, trailer = _post_stream(
+                    gw.port, shared + tail, max_new=4
+                )
+                assert trailer["state"] == "done"
+                assert toks == lockstep_oracle(
+                    cfg, params, shared + tail, 4
+                )
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=30
+            )
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+            conn.close()
+            for needle in (
+                "# TYPE serving_prefix_cache_hits_total counter",
+                "serving_prefix_cache_hits_total 1",
+                "serving_prefix_cache_misses_total 1",
+                "serving_prefix_cache_evictions_total 0",
+                "serving_prefix_tokens_reused_total 32",
+            ):
+                assert needle in text, text
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=30
+            )
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            conn.close()
+            assert health["ok"] is True
+            assert health["prefix_cache"]["hits"] == 1
+            assert health["prefix_cache"]["tokens_reused"] == 32
+            assert health["prefix_cache"]["rows_used"] == 1
+        finally:
+            gw.stop()
+            sched.stop()
+
 
 @pytest.mark.slow
 class TestGatewaySoak:

@@ -1,0 +1,446 @@
+"""Admission-time prefix cache (serving/prefix_cache.py + the engine's
+warm admission paths): the parity oracle — prefix-cached admission must
+be token-for-token identical to cold full prefill (greedy, sampled,
+int8 KV) — plus radix-tree model-based properties (insert/match/
+refcount/evict never hands out a row a live slot still references),
+eviction-under-pressure chaos mid-decode, and the admission-check
+agreement the scheduler relies on."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+try:  # hypothesis drives the radix model test when available; a
+    # seeded-numpy fuzz covers the same invariants when it is not
+    # (the image has no hypothesis and the no-new-deps rule holds)
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:
+    HAVE_HYPOTHESIS = False
+
+from _serve_oracle import lockstep_oracle
+from dlrover_tpu.serving.engine import ContinuousBatcher
+from dlrover_tpu.serving.metrics import ServingMetrics
+from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
+from dlrover_tpu.serving.scheduler import (
+    AdmissionError,
+    RequestScheduler,
+    SloConfig,
+)
+
+from dlrover_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(), dtype=jnp.float32
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(cfg, params, rows=4, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("pad_id", -1)
+    return ContinuousBatcher(
+        cfg, params, prefix_cache_rows=rows, **kw
+    )
+
+
+def _shared_prompts(seed=0, tails=((3,), (9, 9, 9))):
+    """Fixed tail lengths on a shared 40-token prefix + one unrelated
+    5-token miss. Fixed (not drawn) lengths keep prompt shapes — and
+    therefore oracle/engine compile cache entries — shared across the
+    tests in this file."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(1, 250, size=40).tolist()
+    return [shared + list(t) for t in tails] + [
+        rng.integers(1, 250, size=5).tolist()
+    ]
+
+
+def _drain(eng, prompts):
+    return [list(map(int, o)) for o in eng.generate_all(prompts)]
+
+
+# ---------------------------------------------------------------------------
+# the parity oracle: warm == cold, token for token
+# ---------------------------------------------------------------------------
+
+
+class TestParityOracle:
+    def test_greedy_matches_lockstep(self, model):
+        """Warm admissions vs the lockstep oracle (the oracle equals a
+        cold engine by PR 1's pinned parity tests, so one independent
+        reference suffices)."""
+        cfg, params = model
+        prompts = _shared_prompts()
+        warm_eng = _engine(cfg, params, rows=4)
+        warm = _drain(warm_eng, prompts)
+        assert warm_eng.prefix_cache.hits > 0, "no reuse; vacuous"
+        for p, w in zip(prompts, warm):
+            assert w == lockstep_oracle(cfg, params, p, 6)
+
+    def test_sampled_matches_cold(self, model):
+        """Same PRNG seed, same chunk schedule → byte-identical cache
+        contents must reproduce the exact sampled stream."""
+        cfg, params = model
+        prompts = _shared_prompts(seed=2)
+        kw = dict(temperature=0.8, top_p=0.9, seed=11)
+        warm_eng = _engine(cfg, params, rows=4, **kw)
+        warm = _drain(warm_eng, prompts)
+        assert warm_eng.prefix_cache.hits > 0
+        cold = _drain(_engine(cfg, params, rows=0, **kw), prompts)
+        assert warm == cold
+
+    def test_int8_kv_matches_cold(self, model):
+        """The pool stores EXACT K/V and install re-quantizes with the
+        cold path's scheme, so warm int8 slot bytes equal cold int8
+        slot bytes — parity holds even under quantization."""
+        cfg, params = model
+        prompts = _shared_prompts(seed=3)
+        warm_eng = _engine(cfg, params, rows=4, kv_quant=True)
+        warm = _drain(warm_eng, prompts)
+        assert warm_eng.prefix_cache.hits > 0
+        cold = _drain(
+            _engine(cfg, params, rows=0, kv_quant=True), prompts
+        )
+        assert warm == cold
+
+    def test_full_prefix_hit_skips_prefill(self, model):
+        """A block-aligned prompt that is fully cached admits with
+        ZERO prefill (the first chunk step recomputes the last prompt
+        token's logits) and still matches cold + oracle."""
+        cfg, params = model
+        rng = np.random.default_rng(4)
+        shared = rng.integers(1, 250, size=32).tolist()
+        prompts = [shared, shared, shared + [5, 7]]
+        warm_eng = _engine(cfg, params, rows=4)
+        calls = []
+        orig = warm_eng._admit_hit_fn
+        warm_eng._admit_hit_fn = lambda *a: (
+            calls.append(1), orig(*a)
+        )[1]
+        warm = _drain(warm_eng, prompts)
+        assert calls, "full-hit path never taken; vacuous"
+        for p, w in zip(prompts, warm):
+            assert w == lockstep_oracle(cfg, params, p, 6)
+
+    def test_non_pow2_max_len_clamps_to_cold(self, model):
+        """max_len=50: a 48-deep match with a 17-token suffix cannot
+        fit any pow2 suffix bucket, so the match retreats — possibly
+        all the way to a cold admission — without breaking parity."""
+        cfg, params = model
+        rng = np.random.default_rng(5)
+        shared = rng.integers(1, 250, size=32).tolist()
+        prompts = [
+            shared + rng.integers(1, 250, size=n).tolist()
+            for n in (3, 13, 17, 16)
+        ]
+        max_len = 50
+        warm = _drain(
+            _engine(
+                cfg, params, rows=4, max_len=max_len,
+                max_new_tokens=4,
+            ),
+            prompts,
+        )
+        for p, w in zip(prompts, warm):
+            n_gen = min(len(p) + 4, max_len) - len(p)
+            assert w == lockstep_oracle(
+                cfg, params, p, n_gen, max_len=max_len
+            )
+
+    def test_streaming_step_path_matches(self, model):
+        """The scheduler-driven step()/retire() path (what the gateway
+        runs) with the cache on is also parity-exact."""
+        cfg, params = model
+        prompts = _shared_prompts(seed=6)
+        eng = _engine(cfg, params, rows=4)
+        metrics = ServingMetrics()
+        sched = RequestScheduler(eng, SloConfig(), metrics=metrics)
+        reqs = [sched.submit(p, max_new=6) for p in prompts]
+        sched.run_to_completion()
+        for p, r in zip(prompts, reqs):
+            assert r.tokens == lockstep_oracle(cfg, params, p, 6)
+        assert eng.prefix_cache.hits > 0
+        # pump() propagated the cache counters into the metrics
+        assert metrics.prefix_hits == eng.prefix_cache.hits
+        text = metrics.render()
+        for needle in (
+            "serving_prefix_cache_hits_total",
+            "serving_prefix_cache_misses_total",
+            "serving_prefix_cache_evictions_total",
+            "serving_prefix_tokens_reused_total",
+        ):
+            assert needle in text, text
+
+
+# ---------------------------------------------------------------------------
+# eviction chaos: memory pressure mid-decode
+# ---------------------------------------------------------------------------
+
+
+class TestEvictionChaos:
+    def test_eviction_under_pressure_never_corrupts_live_slots(
+        self, model
+    ):
+        """A 1-row pool with many distinct prefixes interleaved across
+        2 slots: rows are published, evicted, and re-published while
+        other requests are mid-decode. Every continuation must still
+        match the lockstep oracle, and eviction must actually have
+        fired (vacuous otherwise)."""
+        cfg, params = model
+        rng = np.random.default_rng(7)
+        prompts = []
+        for _ in range(3):
+            pre = rng.integers(1, 250, size=16).tolist()
+            prompts += [
+                pre + rng.integers(1, 250, size=3).tolist()
+                for _ in range(2)
+            ]
+        eng = _engine(cfg, params, rows=1, max_new_tokens=4)
+        outs = _drain(eng, prompts)
+        assert eng.prefix_cache.evictions > 0, "no eviction; vacuous"
+        for p, o in zip(prompts, outs):
+            assert o == lockstep_oracle(cfg, params, p, 4)
+
+    def test_pinned_row_survives_pressure(self, model):
+        """While a slot decodes FROM a pool row, publishes that would
+        need its row skip instead of evicting it (the radix refuses);
+        the in-flight request still finishes correctly."""
+        cfg, params = model
+        rng = np.random.default_rng(8)
+        shared = rng.integers(1, 250, size=16).tolist()
+        other = rng.integers(1, 250, size=16).tolist()
+        # 3 slots: all three admitted in ONE step loop, so the third
+        # prompt's publish runs while the second still pins the row
+        prompts = [
+            shared + [3],
+            shared + [9],        # hit: pins the row while in flight
+            other + [4, 5],      # wants to publish: must NOT evict
+            other + [6],         # misses (publish above was skipped)
+        ]
+        eng = _engine(
+            cfg, params, rows=1, n_slots=3, max_new_tokens=8
+        )
+        outs = _drain(eng, prompts)
+        pc = eng.prefix_cache
+        # prompt 2's publish skipped (pinned row), so prompt 3 is a
+        # cold miss that evicts only AFTER the pin is released
+        assert (pc.hits, pc.misses, pc.evictions) == (1, 3, 1)
+        for p, o in zip(prompts, outs):
+            assert o == lockstep_oracle(cfg, params, p, 8)
+
+
+# ---------------------------------------------------------------------------
+# radix tree model-based property test
+# ---------------------------------------------------------------------------
+
+
+_OP_KINDS = ["insert", "match", "acquire", "release"]
+
+
+def _check_radix_model(rows, block, ops):
+    """Model-based check against a plain dict: longest-match answers,
+    row↔prefix consistency after arbitrary insert/evict churn, and the
+    load-bearing invariant — an allocation NEVER returns (= never
+    evicts) a row some live reference still pins."""
+    cache = RadixPrefixCache(rows, block=block)
+    prefix_of = {}   # row -> tuple(prefix)
+    refs = {}        # row -> count
+    for kind, toks in ops:
+        aligned = tuple(toks[: cache.aligned_len(len(toks))])
+        if kind == "insert":
+            row, is_new = cache.insert(toks)
+            if len(aligned) < block:
+                assert row is None and not is_new
+            elif row is None:
+                # only legal when every row is pinned
+                assert not is_new
+                assert len(refs) == rows and all(
+                    v > 0 for v in refs.values()
+                )
+            elif is_new:
+                assert refs.get(row, 0) == 0, (
+                    "evicted/allocated a row with live references"
+                )
+                prefix_of[row] = aligned
+            else:
+                assert prefix_of[row] == aligned
+        elif kind == "match":
+            got_len, got_row = cache.match(toks)
+            want = max(
+                (
+                    len(p)
+                    for p in prefix_of.values()
+                    if aligned[: len(p)] == p
+                ),
+                default=0,
+            )
+            assert got_len == want
+            if want:
+                assert prefix_of[got_row] == aligned[:want]
+            else:
+                assert got_row is None
+        elif kind == "acquire":
+            _, row = cache.match(toks)
+            if row is not None:
+                cache.acquire(row)
+                refs[row] = refs.get(row, 0) + 1
+        elif kind == "release":
+            if refs:
+                row = sorted(refs)[0]
+                cache.release(row)
+                refs[row] -= 1
+                if refs[row] == 0:
+                    del refs[row]
+        # global invariants
+        assert len(prefix_of) <= rows
+        for row, n_refs in refs.items():
+            assert cache.refcount(row) == n_refs
+            assert row in prefix_of  # pinned rows are never evicted
+
+
+def test_radix_model_fuzz():
+    """Seeded fuzz of the radix model (always runs; the hypothesis
+    variant below shrinks counterexamples when the dep is present)."""
+    rng = np.random.default_rng(0)
+    for _ in range(150):
+        rows = int(rng.integers(1, 4))
+        block = int(rng.choice([1, 2, 4]))
+        ops = [
+            (
+                _OP_KINDS[int(rng.integers(len(_OP_KINDS)))],
+                rng.integers(0, 4, size=int(rng.integers(0, 10)))
+                .tolist(),
+            )
+            for _ in range(int(rng.integers(1, 60)))
+        ]
+        _check_radix_model(rows, block, ops)
+
+
+if HAVE_HYPOTHESIS:
+
+    @st.composite
+    def _ops(draw):
+        n = draw(st.integers(1, 60))
+        return [
+            (
+                draw(st.sampled_from(_OP_KINDS)),
+                draw(st.lists(st.integers(0, 3), max_size=9)),
+            )
+            for _ in range(n)
+        ]
+
+    @settings(max_examples=120, deadline=None)
+    @given(
+        rows=st.integers(1, 3),
+        block=st.sampled_from([1, 2, 4]),
+        ops=_ops(),
+    )
+    def test_radix_model(rows, block, ops):
+        _check_radix_model(rows, block, ops)
+
+
+def test_radix_release_underflow_raises():
+    cache = RadixPrefixCache(2, block=2)
+    row, is_new = cache.insert([1, 2])
+    assert is_new
+    with pytest.raises(ValueError, match="unreferenced"):
+        cache.release(row)
+
+
+# ---------------------------------------------------------------------------
+# satellites: admission agreement, retire order, chunk-policy vectorization
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionAgreement:
+    def test_admission_checks_agree(self, model):
+        """scheduler.submit and engine.submit must accept/reject the
+        same prompts with the prefix cache on — the prompt-exactly-
+        max_len edge in particular: a fully cached prompt still needs
+        one cell to generate into."""
+        cfg, params = model
+        max_len = 32
+        eng = _engine(cfg, params, rows=4, max_len=max_len)
+        sched = RequestScheduler(eng, SloConfig())
+        rng = np.random.default_rng(9)
+        exact = rng.integers(1, 250, size=max_len).tolist()
+        # seed the pool so the admissible prompt below admits WARM —
+        # the rejection must not depend on cache state either way
+        seed_req = sched.submit(exact[: max_len - 1], max_new=2)
+        sched.run_to_completion()
+        assert seed_req.tokens
+        with pytest.raises(ValueError, match="no room"):
+            eng.submit(exact)
+        with pytest.raises(AdmissionError, match="no room"):
+            sched.submit(exact)
+        # one token shorter is admissible on both paths, admits warm
+        # (16 of its 31 tokens cached), and clamps to exactly 1 token
+        ok = sched.submit(exact[: max_len - 1], max_new=2)
+        sched.run_to_completion()
+        assert eng.prefix_cache.hits >= 1
+        assert ok.tokens == lockstep_oracle(
+            cfg, params, exact[: max_len - 1], 1, max_len=max_len
+        )
+
+
+class TestRetireOrder:
+    def test_out_of_order_retires(self, model):
+        """retire() in any order: O(1) dict removal, remaining drain
+        order preserved (regression guard for the _pending list scan)."""
+        cfg, params = model
+        eng = _engine(cfg, params, rows=0)
+        prompts = _shared_prompts(seed=10)
+        ids = [eng.submit(p, max_new=3) for p in prompts]
+        while eng.has_work():
+            eng.step()
+        # retire the middle, then the first — never the submit order
+        eng.retire(ids[1])
+        eng.retire(ids[0])
+        with pytest.raises(KeyError):
+            eng.retire(ids[1])  # double-retire is an error, not a scan
+        remaining = eng.generate_all([])
+        assert len(remaining) == len(ids) - 2
+        want = lockstep_oracle(cfg, params, prompts[2], 3)
+        assert list(map(int, remaining[0])) == want
+
+
+def test_next_chunk_len_matches_scalar_reference(model):
+    """The vectorized _next_chunk_len must agree with the original
+    per-slot generator formula on random live/limit/pos states."""
+    cfg, params = model
+    eng = _engine(cfg, params, rows=0, n_slots=8, chunk=8)
+    rng = np.random.default_rng(11)
+    for _ in range(200):
+        eng.pos = rng.integers(0, 40, size=8).astype(np.int32)
+        eng.limit = eng.pos + rng.integers(
+            1, 20, size=8
+        ).astype(np.int32)
+        eng.done = rng.random(8) < 0.5
+        if eng.done.all():
+            eng.done[rng.integers(0, 8)] = False
+        want_rem = max(
+            int(eng.limit[s] - eng.pos[s] - 1)
+            for s in range(8)
+            if not eng.done[s]
+        )
+        k_target = max(1, min(want_rem, eng.chunk))
+        if k_target == eng.chunk:
+            want = k_target
+        else:
+            want = 1
+            while want * 2 <= k_target:
+                want *= 2
+        assert eng._next_chunk_len() == want

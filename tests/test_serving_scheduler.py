@@ -72,7 +72,7 @@ class TestEngineStepApi:
             eng.step()
         assert len(eng._requests) == 1
         eng.retire(i)
-        assert len(eng._requests) == 0 and eng._pending == []
+        assert len(eng._requests) == 0 and not eng._pending
 
     def test_generate_all_after_streaming(self, model):
         """Mixing modes: a generate_all() drain after retire()d
