@@ -16,6 +16,13 @@ ON (warm TTFT + hit rate). The cache's win is admission-time: warm
 admissions prefill only the suffix bucket, so warm TTFT p50 must sit
 strictly below cold.
 
+A third phase drives an n-gram-friendly echo workload (each prompt
+contains the model's own greedy repetition loop) through the engine
+twice — spec_draft_len=0 (baseline) and spec_draft_len=K — and
+publishes acceptance, accepted-per-step, and the TPOT p50 pair. The
+contract lock: speculation must accept >1 draft token per verify round
+AND beat baseline TPOT on this workload, or it is dead weight.
+
 Run (real chip):  python benchmarks/serve_bench.py
 CPU smoke:        DLROVER_TPU_FORCE_CPU=1 python benchmarks/serve_bench.py
 Prints ONE JSON line (the schema tests/test_bench_contract.py pins):
@@ -222,6 +229,103 @@ def main():
     warm_ttfts, warm_eng = _ttft_pass(rows=8)
     pc_stats = warm_eng.prefix_cache.stats()
 
+    # ---- speculative phase: n-gram-friendly workload, spec off vs on ----
+    # The drafter's target regime is generation that revisits seen
+    # text. The portable stand-in: a tiny-vocab model driven by its
+    # own greedy echo — each prompt is a seed plus the model's own
+    # continuation, kept only when that trajectory has settled into a
+    # repetition loop (the cycle is IN the prompt, so prompt-lookup
+    # drafting predicts the continuation the way it would on
+    # templated/retrieval text). Tiny-vocab on every backend: the
+    # phase measures speculation dynamics (acceptance, tokens/step,
+    # TPOT), which don't need model scale.
+    import dataclasses as _dc
+
+    scfg = _dc.replace(
+        llama.LlamaConfig.tiny(), dtype=jnp.float32, vocab_size=32
+    )
+    sparams = llama.init_params(scfg, jax.random.PRNGKey(2))
+    spec_k, s_max_new, seed_len, echo_len = 8, 48, 6, 160
+    n_spec_reqs, s_slots, s_chunk = 8, 2, 4
+    s_max_len = seed_len + echo_len + s_max_new + spec_k + 4
+
+    def _has_cycle(gen):
+        return any(
+            len(gen) >= 3 * p
+            and gen[-p:] == gen[-2 * p : -p] == gen[-3 * p : -2 * p]
+            for p in range(1, 33)
+        )
+
+    spec_prompts = []
+    tries = 0
+    srng = np.random.default_rng(0)  # phase-local: workload must not
+    # drift when an earlier phase changes its rng draws
+    while len(spec_prompts) < n_spec_reqs and tries < 64:
+        tries += 1
+        seed = srng.integers(1, scfg.vocab_size, size=seed_len).tolist()
+        echo = np.asarray(
+            decode.generate(
+                scfg, sparams, jnp.asarray([seed], jnp.int32),
+                echo_len, max_len=seed_len + echo_len,
+            )
+        )[0].tolist()
+        if _has_cycle(echo[seed_len:]):
+            spec_prompts.append(echo)
+
+    def _spec_pass(draft_len):
+        """Drain the echo workload through the scheduler; returns
+        per-request TPOTs + the engine (for spec counters)."""
+        eng = ContinuousBatcher(
+            scfg, sparams, n_slots=s_slots, max_len=s_max_len,
+            max_new_tokens=s_max_new, chunk=s_chunk, pad_id=-1,
+            spec_draft_len=draft_len, spec_probe_interval=4,
+            spec_ngram_max=4,
+        )
+        ssched = RequestScheduler(
+            eng,
+            SloConfig(
+                max_queue_depth=n_spec_reqs + 6,
+                max_new_tokens=s_max_new,
+                default_deadline_s=600.0,
+            ),
+            metrics=ServingMetrics(),
+        )
+        # warm every program the timed drain can hit: the spec/verify
+        # program, the prefill bucket, and each chunk length the
+        # fallback path reaches (variable-advance slots leave 1..chunk
+        # remainders, and a mid-drain compile would land in TPOT)
+        for mn in (1, 2, 3, s_max_new):
+            ssched.submit(spec_prompts[0], max_new=mn)
+        ssched.run_to_completion()
+        timed = RequestScheduler(
+            eng,
+            SloConfig(
+                max_queue_depth=n_spec_reqs + 6,
+                max_new_tokens=s_max_new,
+                default_deadline_s=600.0,
+            ),
+            metrics=ServingMetrics(),
+        )
+        sreqs = [
+            timed.submit(p, max_new=s_max_new) for p in spec_prompts
+        ]
+        timed.run_to_completion()
+        stpots = sorted(
+            (r.finish_ts - r.first_token_ts)
+            * 1000.0
+            / (len(r.tokens) - 1)
+            for r in sreqs
+            if r.first_token_ts is not None and len(r.tokens) > 1
+        )
+        return stpots, eng, [list(r.tokens) for r in sreqs]
+
+    spec_base_tpots, _, spec_base_out = _spec_pass(0)
+    spec_tpots, spec_eng, spec_out = _spec_pass(spec_k)
+    # greedy parity is a hard guarantee of the verify program; a bench
+    # that publishes a speedup for wrong tokens would be lying
+    assert spec_out == spec_base_out, "speculative greedy parity broke"
+    spec_stats = spec_eng.spec.stats()
+
     print(
         json.dumps(
             {
@@ -271,6 +375,24 @@ def main():
                     "ttft_warm_ms_p95": round(
                         pct(warm_ttfts, 0.95), 2
                     ),
+                    # speculative phase: n-gram drafting off vs on
+                    "spec_tpot_ms_p50": round(
+                        pct(spec_tpots, 0.5), 3
+                    ),
+                    "spec_baseline_tpot_ms_p50": round(
+                        pct(spec_base_tpots, 0.5), 3
+                    ),
+                    "spec_accept_rate": round(
+                        spec_stats["acceptance_rate"], 3
+                    ),
+                    "spec_accepted_per_step": round(
+                        spec_stats["accepted_per_step"], 3
+                    ),
+                    "spec_tokens_per_step": round(
+                        spec_stats["tokens_per_step"], 3
+                    ),
+                    "spec_draft_len": spec_k,
+                    "n_spec_requests": len(spec_prompts),
                 },
             }
         ),

@@ -354,6 +354,112 @@ def decode_step(
     return logits[:, 0], cache
 
 
+def verify_step(
+    cfg: LlamaConfig,
+    params: Params,
+    tokens: jax.Array,  # [B, S]: carry token + S-1 draft tokens
+    cache: Dict[str, jax.Array],
+    pos,                # [B] position of tokens[:, 0] per slot
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Batched speculative verify: run the target model over all S
+    positions per row in ONE compiled forward (the speculative
+    decoding counterpart of decode_step — S=K+1 instead of S=1).
+
+    Row b's tokens occupy global positions [pos[b], pos[b]+S); their
+    K/V is written there first, then every query attends the whole
+    buffer under the causal position mask — so draft token j attends
+    the carry token and drafts 1..j exactly as if they had been
+    decoded one step at a time. logits[:, j] is therefore the target
+    distribution for the token FOLLOWING tokens[:, j], for every j at
+    once: one memory-bandwidth-bound pass prices K drafts plus the
+    bonus position.
+
+    S is static per program (one trace per draft width); pos is a
+    traced [B] vector, so mixed-length slots share the compile. The
+    caller guarantees pos + S <= the cache buffer length (the serving
+    engine over-allocates its bank by the draft width so the write
+    window can never clamp near max_len)."""
+    b, s = tokens.shape
+    pos = jnp.asarray(pos, jnp.int32)
+    positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    logits, cache = _forward_cached(
+        cfg, params, tokens, cache, positions, pos
+    )
+    return logits, cache
+
+
+def spec_accept_greedy(
+    logits: jax.Array,  # [B, K+1, V] verify logits
+    drafts: jax.Array,  # [B, K] proposed draft tokens
+    draft_len: jax.Array,  # [B] valid drafts per row (<= K)
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy acceptance: draft j survives while it equals the target
+    argmax at its position (and every earlier draft survived). Returns
+    (m, extra): m accepted drafts per row plus the target's own token
+    at the first divergence (the 'bonus' token when all K accepted) —
+    so the emitted prefix is exactly the target's greedy continuation,
+    whatever the drafter proposed."""
+    k = drafts.shape[1]
+    tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+    ok = (drafts == tgt[:, :k]) & (
+        jnp.arange(k)[None, :] < draft_len[:, None]
+    )
+    m = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+    extra = jnp.take_along_axis(tgt, m[:, None], axis=1)[:, 0]
+    return m, extra
+
+
+def spec_accept_sampled(
+    key: jax.Array,
+    probs: jax.Array,   # [B, K+1, V] warped target probabilities
+    drafts: jax.Array,  # [B, K]
+    draft_len: jax.Array,  # [B]
+) -> Tuple[jax.Array, jax.Array]:
+    """Standard speculative rejection sampling, specialized to a
+    DETERMINISTIC drafter (n-gram lookup proposes a point mass q):
+    accept draft d_j with probability min(1, p_j(d_j)/q_j(d_j)) =
+    p_j(d_j); on the first rejection sample the replacement from the
+    residual norm(max(p_j - q_j, 0)) — p_j with d_j's mass removed,
+    renormalized; when every draft survives, sample the bonus token
+    from p_K+1 directly. The emitted marginal at each position is
+    exactly p_j (p(d)·1[x=d] + (1-p(d))·p(x)1[x≠d]/(1-p(d)) = p(x)),
+    so the output distribution is provably the target's — pinned by
+    tests/test_serving_speculative.py's Monte-Carlo check.
+
+    A rejected row always has residual mass: rejection means
+    u >= p(d) with u < 1, so p(d) < 1 and the renormalizer 1 - p(d)
+    is positive; rows with no rejection never read the residual."""
+    b, kp1, v = probs.shape
+    k = kp1 - 1
+    ku, kr = jax.random.split(key)
+    u = jax.random.uniform(ku, (b, k))
+    p_draft = jnp.take_along_axis(
+        probs[:, :k], drafts[..., None], axis=-1
+    )[..., 0]
+    ok = (u < p_draft) & (
+        jnp.arange(k)[None, :] < draft_len[:, None]
+    )
+    m = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+    pm = jnp.take_along_axis(probs, m[:, None, None], axis=1)[:, 0]
+    # the draft at the rejection index (pad column keeps the gather
+    # in-bounds when m == K; `rejected` is False there anyway)
+    drafts_p = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), drafts.dtype)], axis=1
+    )
+    d_at_m = jnp.take_along_axis(drafts_p, m[:, None], axis=1)[:, 0]
+    rejected = m < draft_len
+    resid = jnp.where(
+        rejected[:, None] & (jnp.arange(v)[None, :] == d_at_m[:, None]),
+        0.0,
+        pm,
+    )
+    # categorical renormalizes; zero-mass tokens become -inf logits
+    extra = jax.random.categorical(kr, jnp.log(resid)).astype(
+        jnp.int32
+    )
+    return m, extra
+
+
 def prefill_into_slot(
     cfg: LlamaConfig,
     params: Params,

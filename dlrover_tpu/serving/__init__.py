@@ -15,6 +15,9 @@ inference:
   metrics.py   — TTFT/TPOT/queue-depth counters, Prometheus exposition
   prefix_cache.py — radix-matched prompt-prefix reuse for admission
                  (suffix-only prefill over an LRU'd device KV pool)
+  speculative.py — n-gram/prompt-lookup drafting + adaptive per-slot
+                 draft-length control for the batched verify program
+                 (models/decode.py:verify_step)
 """
 
 from dlrover_tpu.serving.engine import ContinuousBatcher, GenerationEngine
@@ -27,7 +30,16 @@ from dlrover_tpu.serving.scheduler import (
     ServeRequest,
     SloConfig,
 )
-from dlrover_tpu.serving.replica import InferenceReplica, ReplicaPool
+from dlrover_tpu.serving.speculative import (
+    NgramDrafter,
+    SpecController,
+    SpeculativeDecoder,
+)
+from dlrover_tpu.serving.replica import (
+    InferenceReplica,
+    NoHealthyReplicasError,
+    ReplicaPool,
+)
 from dlrover_tpu.serving.gateway import ServingGateway
 
 __all__ = [
@@ -35,6 +47,8 @@ __all__ = [
     "ContinuousBatcher",
     "GenerationEngine",
     "InferenceReplica",
+    "NgramDrafter",
+    "NoHealthyReplicasError",
     "RadixPrefixCache",
     "ReplicaPool",
     "RequestScheduler",
@@ -43,4 +57,6 @@ __all__ = [
     "ServingGateway",
     "ServingMetrics",
     "SloConfig",
+    "SpecController",
+    "SpeculativeDecoder",
 ]

@@ -71,8 +71,12 @@ from dlrover_tpu.models.decode import (
     prefill_exact_row,
     prefill_into_slot,
     prefill_suffix_row,
+    spec_accept_greedy,
+    spec_accept_sampled,
+    verify_step,
 )
 from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
+from dlrover_tpu.serving.speculative import SpeculativeDecoder
 
 
 def _pad_bucket(n: int, lo: int = 16) -> int:
@@ -109,6 +113,7 @@ StepEvent = Tuple[int, List[int], bool]
 
 _CHUNK_PROGRAMS: Dict[Any, Any] = {}
 _ADMIT_PROGRAMS: Dict[Any, Any] = {}
+_SPEC_PROGRAMS: Dict[Any, Any] = {}
 
 
 def _cached_program(cache: Dict[Any, Any], key, build):
@@ -161,6 +166,88 @@ def _build_chunk_program(
         return cache, tok, pos, done, key, emitted.T  # [B, k]
 
     return _run_chunk
+
+
+def _build_spec_program(
+    cfg, pad_id, eos_id, temperature, top_k, top_p
+):
+    """The speculative alternative to the chunk scan: ONE verify
+    forward over K+1 positions per slot, acceptance on device, and
+    the same eos/limit/done discipline the chunk program applies —
+    so a spec step and a chunk step are interchangeable mid-request
+    (the adaptive controller switches between them freely).
+
+    K is static (drafts' shape), so the whole thing is one trace: the
+    host varies only the DATA (per-slot draft tokens and draft_len,
+    zero for slots whose controller disabled speculation — those rows
+    degenerate to a normal one-token step inside the same program).
+    """
+
+    def _warp(logits):
+        logits = logits / temperature
+        if 0 < top_k < logits.shape[-1]:
+            logits = _mask_top_k(logits, top_k)
+        if top_p < 1.0:
+            logits = _mask_top_p(logits, top_p)
+        return logits
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _run_spec(
+        cache, params, tok, pos, done, limit, key, drafts, draft_len
+    ):
+        b, k = drafts.shape
+        tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
+        logits, cache = verify_step(cfg, params, tokens, cache, pos)
+        if temperature <= 0.0:
+            m, extra = spec_accept_greedy(logits, drafts, draft_len)
+        else:
+            key, sub = jax.random.split(key)
+            probs = jax.nn.softmax(_warp(logits), axis=-1)
+            m, extra = spec_accept_sampled(
+                sub, probs, drafts, draft_len
+            )
+        # emitted layout: m accepted drafts, then the extra token
+        # (correction on rejection, bonus on full acceptance), pad
+        # beyond — always K+1 wide, n_emit says how much is real
+        idx = jnp.arange(k + 1)[None, :]
+        drafts_p = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), drafts.dtype)], axis=1
+        )
+        emitted = jnp.where(
+            idx < m[:, None],
+            drafts_p,
+            jnp.where(idx == m[:, None], extra[:, None], pad_id),
+        )
+        # length cap: live slots may emit positions pos+1..limit-1
+        # (the chunk program's pos+2>=limit rule, batched)
+        n_emit = jnp.minimum(
+            m + 1, jnp.maximum(limit - 1 - pos, 0)
+        )
+        if eos_id is not None:
+            eos_mask = (emitted == eos_id) & (idx < n_emit[:, None])
+            has_eos = eos_mask.any(axis=1)
+            n_emit = jnp.where(
+                has_eos, jnp.argmax(eos_mask, axis=1) + 1, n_emit
+            )
+        else:
+            has_eos = jnp.zeros_like(done)
+        n_emit = jnp.where(done, 0, n_emit)
+        emitted = jnp.where(idx < n_emit[:, None], emitted, pad_id)
+        last = jnp.take_along_axis(
+            emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+        )[:, 0]
+        new_tok = jnp.where(n_emit > 0, last, tok)
+        new_pos = pos + n_emit
+        new_done = done | has_eos | (new_pos >= limit - 1)
+        # drafts actually USED (cap may truncate below m) — the
+        # controller should only credit tokens that shipped
+        accepted = jnp.minimum(m, jnp.maximum(n_emit - 1, 0))
+        return (
+            cache, new_tok, new_pos, new_done, key,
+            emitted, n_emit, accepted,
+        )
+
+    return _run_spec
 
 
 def _build_admit_programs(cfg, max_len):
@@ -239,11 +326,25 @@ class ContinuousBatcher:
         kv_quant: bool = False,  # int8 KV cache (~2x slots per HBM)
         prefix_cache_rows: int = 0,  # 0 disables the prefix cache
         prefix_block: int = 16,      # prefix match granularity (tokens)
+        spec_draft_len: int = 0,     # speculative draft width K (0 = off)
+        spec_ngram_max: int = 3,     # longest suffix n-gram the drafter tries
+        spec_ngram_min: int = 1,     # shortest n-gram fallback
+        spec_accept_threshold: float = 0.5,  # EMA acceptance to keep drafting
+        spec_probe_interval: int = 32,  # rounds between disabled-slot probes
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
                 "eos_id and pad_id must differ: the pad emitted by "
                 "finished slots would re-trigger EOS detection"
+            )
+        if spec_draft_len < 0:
+            raise ValueError(
+                f"spec_draft_len must be >= 0, got {spec_draft_len}"
+            )
+        if spec_draft_len >= max_len:
+            raise ValueError(
+                f"spec_draft_len {spec_draft_len} must be < max_len "
+                f"{max_len}"
             )
         _check_positional_capacity(cfg, max_len)
         self.cfg = cfg
@@ -255,8 +356,16 @@ class ContinuousBatcher:
         self.pad_id = pad_id
         self.chunk = chunk
         self.key = jax.random.PRNGKey(seed)
+        # the slot bank over-allocates by the draft width: a verify
+        # dispatch always writes K+1 cells at [pos, pos+K], and a slot
+        # near its cap (pos up to max_len-2) must not have that window
+        # clamp back onto valid cells (dynamic_update_slice clamps the
+        # start; the overflow cells sit at positions no valid query
+        # ever attends, so they are dead by the position mask). With
+        # spec_draft_len=0 the bank is exactly max_len — today's
+        # shapes, today's programs, bit-exact behavior.
         self.cache = init_kv_cache(
-            cfg, n_slots, max_len, quant=kv_quant
+            cfg, n_slots, max_len + spec_draft_len, quant=kv_quant
         )
         # host-side slot state (tiny [B] vectors; shipped per chunk)
         self.tok = np.full(n_slots, pad_id, np.int32)
@@ -296,6 +405,31 @@ class ContinuousBatcher:
             # re-quantizes, which keeps warm admissions byte-identical
             # to cold ones (models/decode.py pool primitives)
             self.pool = init_kv_cache(cfg, prefix_cache_rows, max_len)
+
+        # ---- speculative decoding ---------------------------------------
+        # host drafter + adaptive controller (serving/speculative.py);
+        # the verify program is cached like the chunk program — one
+        # trace per (config, knobs, K), shared across engines
+        self.spec: Optional[SpeculativeDecoder] = None
+        self._run_spec = None
+        if spec_draft_len > 0:
+            self.spec = SpeculativeDecoder(
+                n_slots,
+                spec_draft_len,
+                ngram_max=spec_ngram_max,
+                ngram_min=spec_ngram_min,
+                threshold=spec_accept_threshold,
+                probe_interval=spec_probe_interval,
+            )
+            self._run_spec = _cached_program(
+                _SPEC_PROGRAMS,
+                (cfg, pad_id, eos_id, temperature, top_k, top_p,
+                 spec_draft_len),
+                lambda: _build_spec_program(
+                    cfg, pad_id, eos_id, temperature, top_k, top_p
+                ),
+            )
+        self.spec_draft_len = spec_draft_len
 
         self._run_chunk = _cached_program(
             _CHUNK_PROGRAMS,
@@ -409,6 +543,8 @@ class ContinuousBatcher:
         )
         self.done[slot] = False
         self.slot_req[slot] = req
+        if self.spec is not None:
+            self.spec.begin_slot(slot, req.prompt)
 
     def _admit_with_prefix(self, slot: int, req: _Request, p: int):
         """Prefix-cached admission: install the longest cached
@@ -491,16 +627,28 @@ class ContinuousBatcher:
         return self.n_slots - self.active_count()
 
     def step(self) -> List[StepEvent]:
-        """Admit from the queue into free slots, run ONE chunk, and
-        return (idx, new_tokens, finished) per request that progressed.
-        Returns [] when there is no work. The serving scheduler drives
-        this directly to stream tokens as they land; generate_all()
-        is a drain loop over it."""
+        """Admit from the queue into free slots, run ONE dispatch
+        (chunk scan, or a speculative verify when drafting is on and
+        some slot proposed), and return (idx, new_tokens, finished)
+        per request that progressed. Returns [] when there is no
+        work. The serving scheduler drives this directly to stream
+        tokens as they land; generate_all() is a drain loop over it."""
         for slot in range(self.n_slots):
             if self.done[slot] and self._queue:
                 self._admit(slot, self._queue.popleft())
         if self.done.all():
             return []
+        if self.spec is not None:
+            drafts, dlens = self._collect_drafts()
+            if int(dlens.max()) > 0:
+                return self._dispatch_spec(drafts, dlens)
+            # graceful degradation: every live slot's controller has
+            # drafting off (or nothing matched) — run the plain chunk
+            # scan at full speed; disabled slots re-probe on their
+            # controller's schedule
+        return self._dispatch_chunk()
+
+    def _dispatch_chunk(self) -> List[StepEvent]:
         old_pos = self.pos.copy()
         cache, tok, pos, done, key, emitted = self._run_chunk(
             self.cache,
@@ -517,20 +665,84 @@ class ContinuousBatcher:
         # read-only view, and _admit writes these in place
         self.tok = np.array(tok)
         self.pos = np.array(pos)
-        new_done = np.array(done)
-        emitted = np.asarray(emitted)
+        # live steps form a prefix of the chunk (done is sticky),
+        # and pos advanced once per live step — the first
+        # (new_pos - old_pos) emitted entries are exactly the real
+        # tokens, whatever their values
+        return self._emit_events(
+            np.asarray(emitted), self.pos - old_pos, np.array(done)
+        )
+
+    def _collect_drafts(self):
+        """Host drafting pass: one controller-clamped n-gram proposal
+        per live slot. Padded entries hold token 0 (a valid embedding
+        row — their logits and K/V are dead by draft_len/position
+        masks, but a pad_id of -1 must never reach the gather)."""
+        k = self.spec_draft_len
+        drafts = np.zeros((self.n_slots, k), np.int32)
+        dlens = np.zeros(self.n_slots, np.int32)
+        for slot in range(self.n_slots):
+            if self.done[slot]:
+                continue
+            prop = self.spec.draft(slot)
+            if prop.size:
+                drafts[slot, : prop.size] = prop
+                dlens[slot] = prop.size
+        return drafts, dlens
+
+    def _dispatch_spec(
+        self, drafts: np.ndarray, dlens: np.ndarray
+    ) -> List[StepEvent]:
+        was_live = ~self.done
+        (
+            cache, tok, pos, done, key, emitted, n_emit, accepted
+        ) = self._run_spec(
+            self.cache,
+            self.params,
+            jnp.asarray(self.tok),
+            jnp.asarray(self.pos),
+            jnp.asarray(self.done),
+            jnp.asarray(self.limit),
+            self.key,
+            jnp.asarray(drafts),
+            jnp.asarray(dlens),
+        )
+        self.cache, self.key = cache, key
+        self.tok = np.array(tok)
+        self.pos = np.array(pos)
+        n_emit = np.asarray(n_emit)
+        accepted = np.asarray(accepted)
+        for slot in range(self.n_slots):
+            if was_live[slot]:
+                self.spec.record(
+                    slot,
+                    int(dlens[slot]),
+                    int(accepted[slot]),
+                    int(n_emit[slot]),
+                )
+        return self._emit_events(
+            np.asarray(emitted), n_emit, np.array(done)
+        )
+
+    def _emit_events(
+        self, emitted: np.ndarray, counts: np.ndarray,
+        new_done: np.ndarray,
+    ) -> List[StepEvent]:
+        """Shared post-dispatch bookkeeping: `counts[slot]` leading
+        entries of `emitted[slot]` are the slot's real new tokens."""
         events: List[StepEvent] = []
         for slot in range(self.n_slots):
             req = self.slot_req[slot]
             if req is None or req.done:
                 continue
-            # live steps form a prefix of the chunk (done is
-            # sticky), and pos advanced once per live step — the
-            # first (new_pos - old_pos) emitted entries are
-            # exactly the real tokens, whatever their values
-            delta = int(self.pos[slot] - old_pos[slot])
-            new_toks = [int(t) for t in emitted[slot][:delta]]
+            new_toks = [
+                int(t) for t in emitted[slot][: int(counts[slot])]
+            ]
             req.out.extend(new_toks)
+            if self.spec is not None and new_toks:
+                # whichever path emitted them, the drafter's context
+                # must see every token or proposals go stale
+                self.spec.extend(slot, new_toks)
             finished = bool(new_done[slot])
             if finished:
                 req.done = True

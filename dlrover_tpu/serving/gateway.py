@@ -34,10 +34,52 @@ from typing import Optional
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving.metrics import ServingMetrics
+from dlrover_tpu.serving.replica import NoHealthyReplicasError
 from dlrover_tpu.serving.scheduler import (
     AdmissionError,
     RequestState,
 )
+
+_GENERATE_FIELDS = frozenset(
+    {"tokens", "max_new", "deadline_s", "stream"}
+)
+
+
+def _validate_generate(payload) -> Optional[str]:
+    """Schema check for POST /v1/generate; returns the 400 reason or
+    None. A malformed request must fail loudly at the door — not 500
+    deep in the scheduler, and never be silently clamped into a
+    request the client didn't make."""
+    if not isinstance(payload, dict):
+        return "body must be a JSON object"
+    unknown = set(payload) - _GENERATE_FIELDS
+    if unknown:
+        return f"unknown fields: {sorted(unknown)}"
+    tokens = payload.get("tokens")
+    if not isinstance(tokens, list) or not tokens:
+        return "'tokens' must be a non-empty list of ints"
+    if any(
+        isinstance(t, bool) or not isinstance(t, int) for t in tokens
+    ):
+        return "'tokens' must be a non-empty list of ints"
+    max_new = payload.get("max_new")
+    if max_new is not None and (
+        isinstance(max_new, bool)
+        or not isinstance(max_new, int)
+        or max_new < 1
+    ):
+        return "'max_new' must be a positive int"
+    deadline_s = payload.get("deadline_s")
+    if deadline_s is not None and (
+        isinstance(deadline_s, bool)
+        or not isinstance(deadline_s, (int, float))
+        or deadline_s <= 0
+    ):
+        return "'deadline_s' must be a positive number"
+    stream = payload.get("stream")
+    if stream is not None and not isinstance(stream, bool):
+        return "'stream' must be a bool"
+    return None
 
 
 class ServingGateway:
@@ -101,19 +143,26 @@ class ServingGateway:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    tokens = payload["tokens"]
-                except (KeyError, ValueError, json.JSONDecodeError):
+                except (ValueError, json.JSONDecodeError):
                     self._json(
-                        400,
-                        {"error": "body must be JSON with 'tokens'"},
+                        400, {"error": "body must be valid JSON"}
                     )
+                    return
+                reason = _validate_generate(payload)
+                if reason is not None:
+                    self._json(400, {"error": reason})
                     return
                 try:
                     req = gw.backend.submit(
-                        tokens,
+                        payload["tokens"],
                         max_new=payload.get("max_new"),
                         deadline_s=payload.get("deadline_s"),
                     )
+                except NoHealthyReplicasError as e:
+                    # availability, not backpressure: retrying the
+                    # same replica set cannot help until it scales
+                    self._json(503, {"error": e.reason})
+                    return
                 except AdmissionError as e:
                     self._json(429, {"error": e.reason})
                     return
@@ -184,6 +233,9 @@ class ServingGateway:
         pc = self._prefix_cache()
         if pc is not None:
             out["prefix_cache"] = pc.stats()
+        spec = self._speculative()
+        if spec is not None:
+            out["speculative"] = spec.stats()
         return out
 
     def _prefix_cache(self):
@@ -192,6 +244,12 @@ class ServingGateway:
         aggregates through /metrics instead)."""
         engine = getattr(self.backend, "engine", None)
         return getattr(engine, "prefix_cache", None)
+
+    def _speculative(self):
+        """The backing engine's SpeculativeDecoder, same single-
+        scheduler scoping as _prefix_cache."""
+        engine = getattr(self.backend, "engine", None)
+        return getattr(engine, "spec", None)
 
     @property
     def port(self) -> int:

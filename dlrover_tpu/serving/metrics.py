@@ -71,6 +71,13 @@ class ServingMetrics:
         self._prefix_misses = 0
         self._prefix_evictions = 0
         self._prefix_tokens_reused = 0
+        # speculative-decoding counters: copied from the engine's
+        # SpeculativeDecoder (the monotonic truth) each pump, same
+        # contract as the prefix-cache block above
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rounds = 0
+        self._spec_emitted = 0
 
     # ---- ingestion -------------------------------------------------------
 
@@ -129,6 +136,18 @@ class ServingMetrics:
                 self._prefix_tokens_reused, tokens_reused
             )
 
+    def update_speculative(
+        self, proposed: int, accepted: int, rounds: int, emitted: int
+    ):
+        """Refresh speculative-decoding counters from the engine's
+        SpeculativeDecoder. Running totals with the same max() guard as
+        update_prefix_cache (Prometheus counters must be monotonic)."""
+        with self._lock:
+            self._spec_proposed = max(self._spec_proposed, proposed)
+            self._spec_accepted = max(self._spec_accepted, accepted)
+            self._spec_rounds = max(self._spec_rounds, rounds)
+            self._spec_emitted = max(self._spec_emitted, emitted)
+
     # ---- queries ---------------------------------------------------------
 
     @property
@@ -175,6 +194,30 @@ class ServingMetrics:
     def prefix_tokens_reused(self) -> int:
         with self._lock:
             return self._prefix_tokens_reused
+
+    @property
+    def spec_proposed(self) -> int:
+        with self._lock:
+            return self._spec_proposed
+
+    @property
+    def spec_accepted(self) -> int:
+        with self._lock:
+            return self._spec_accepted
+
+    @property
+    def spec_acceptance_rate(self) -> float:
+        with self._lock:
+            if not self._spec_proposed:
+                return 0.0
+            return self._spec_accepted / self._spec_proposed
+
+    @property
+    def spec_tokens_per_step(self) -> float:
+        with self._lock:
+            if not self._spec_rounds:
+                return 0.0
+            return self._spec_emitted / self._spec_rounds
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -280,6 +323,39 @@ class ServingMetrics:
                 "Prompt tokens whose prefill was skipped via the "
                 "prefix cache.",
                 self._prefix_tokens_reused,
+            )
+            counter(
+                "serving_spec_proposed_total",
+                "Draft tokens proposed by the n-gram drafter.",
+                self._spec_proposed,
+            )
+            counter(
+                "serving_spec_accepted_total",
+                "Draft tokens accepted by target-model verification.",
+                self._spec_accepted,
+            )
+            counter(
+                "serving_spec_rounds_total",
+                "Live slot verify rounds dispatched.",
+                self._spec_rounds,
+            )
+            counter(
+                "serving_spec_emitted_total",
+                "Tokens emitted through the speculative path.",
+                self._spec_emitted,
+            )
+            gauge(
+                "serving_spec_acceptance_rate",
+                "Fraction of proposed draft tokens accepted.",
+                (self._spec_accepted / self._spec_proposed)
+                if self._spec_proposed else 0.0,
+            )
+            gauge(
+                "serving_spec_tokens_per_step",
+                "Per-slot tokens emitted per verify dispatch "
+                "(>1 means speculation is winning).",
+                (self._spec_emitted / self._spec_rounds)
+                if self._spec_rounds else 0.0,
             )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()

@@ -34,6 +34,13 @@ from dlrover_tpu.serving.scheduler import (
 REPLICA_KEY_PREFIX = "serving/replicas/"
 SCALE_HINT_KEY = "serving/scale_hint"
 
+
+class NoHealthyReplicasError(AdmissionError):
+    """Every replica in the pool is unhealthy: routing cannot place
+    the request anywhere. Distinct from plain AdmissionError (a full
+    queue is the client's backpressure problem, HTTP 429; an empty
+    pool is the service's availability problem, HTTP 503)."""
+
 # chaos hook, mirroring agent/node_check.py's MOCK_ERR_RANK
 MOCK_ERR_REPLICA_ENV = "DLROVER_TPU_SERVING_MOCK_ERR_REPLICA"
 
@@ -185,7 +192,11 @@ class ReplicaPool:
             self.healthy_replicas(), key=lambda r: r.load()
         )
         if not candidates:
-            raise AdmissionError("no healthy replicas")
+            # nothing can serve: record a scale-up hint (force bypasses
+            # the cooldown — an empty pool is exactly the emergency the
+            # rate limit must not suppress) before failing the request
+            self.scale_hint(force=True)
+            raise NoHealthyReplicasError("no healthy replicas")
         last_err: Optional[AdmissionError] = None
         for rep in candidates:
             try:

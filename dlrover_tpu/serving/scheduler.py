@@ -282,6 +282,12 @@ class RequestScheduler:
                 self.metrics.update_prefix_cache(
                     pc.hits, pc.misses, pc.evictions, pc.tokens_reused
                 )
+            spec = getattr(self.engine, "spec", None)
+            if spec is not None:
+                self.metrics.update_speculative(
+                    spec.proposed, spec.accepted,
+                    spec.rounds, spec.emitted,
+                )
             return bool(self._waiting) or bool(self._running)
 
     def run_to_completion(self):

@@ -135,12 +135,30 @@ def test_serve_bench_smoke_emits_driver_contract():
         "ttft_cold_ms_p95",
         "ttft_warm_ms_p50",
         "ttft_warm_ms_p95",
+        # speculative phase: the drafting/verify evidence axes
+        "spec_tpot_ms_p50",
+        "spec_baseline_tpot_ms_p50",
+        "spec_accept_rate",
+        "spec_accepted_per_step",
+        "spec_tokens_per_step",
+        "spec_draft_len",
+        "n_spec_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
     assert detail["completed"] == detail["n_requests"]
-    # the tentpole's acceptance floor: most admissions reuse the
+    # the prefix-cache acceptance floor: most admissions reuse the
     # shared prefix, and reuse buys real admission latency
     assert detail["prefix_hit_rate"] > 0.9
     assert detail["ttft_warm_ms_p50"] < detail["ttft_cold_ms_p50"]
     assert detail["prefix_tokens_reused"] > 0
+    # the speculative acceptance floor: on the n-gram-friendly echo
+    # workload, verification must accept more than one draft token per
+    # round AND that must buy real per-token latency — speculation
+    # that can't beat plain decode on its home turf is dead weight
+    assert detail["spec_accepted_per_step"] > 1.0
+    assert (
+        detail["spec_tpot_ms_p50"]
+        < detail["spec_baseline_tpot_ms_p50"]
+    )
+    assert detail["n_spec_requests"] > 0

@@ -1,0 +1,56 @@
+"""Layering lint: dlrover_tpu/serving/ must not import dlrover_tpu.rl.
+
+DEVIATIONS §5 makes the dependency one-way — rl/serve.py imports the
+serving engine, never the reverse — so the serving stack stays usable
+without the RL stack. Until now that rule was enforced only by
+convention; this AST walk makes a violation a test failure with a
+file:line pointer instead of a review comment."""
+
+import ast
+import pathlib
+
+import dlrover_tpu.serving
+
+SERVING_DIR = pathlib.Path(dlrover_tpu.serving.__file__).parent
+FORBIDDEN = "dlrover_tpu.rl"
+
+
+def _violations(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.name
+                if name == FORBIDDEN or name.startswith(
+                    FORBIDDEN + "."
+                ):
+                    out.append((node.lineno, f"import {name}"))
+        elif isinstance(node, ast.ImportFrom):
+            # level>0 is a relative import inside serving/ — it cannot
+            # reach dlrover_tpu.rl without an absolute name
+            mod = node.module or ""
+            if node.level == 0 and (
+                mod == FORBIDDEN or mod.startswith(FORBIDDEN + ".")
+            ):
+                out.append((node.lineno, f"from {mod} import ..."))
+            elif node.level == 0 and mod == "dlrover_tpu":
+                for alias in node.names:
+                    if alias.name == "rl":
+                        out.append(
+                            (node.lineno, "from dlrover_tpu import rl")
+                        )
+    return out
+
+
+def test_serving_never_imports_rl():
+    offenders = []
+    files = sorted(SERVING_DIR.rglob("*.py"))
+    assert files, f"no sources under {SERVING_DIR}"
+    for path in files:
+        for lineno, what in _violations(path):
+            offenders.append(f"{path}:{lineno}: {what}")
+    assert not offenders, (
+        "serving/ must not depend on rl/ (DEVIATIONS §5):\n"
+        + "\n".join(offenders)
+    )

@@ -177,6 +177,131 @@ class TestGatewayConcurrent:
             pool.stop()
 
 
+class TestRequestValidation:
+    def _post(self, port, payload):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", port, timeout=60
+        )
+        try:
+            conn.request("POST", "/v1/generate", json.dumps(payload))
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+        finally:
+            conn.close()
+
+    def test_malformed_requests_get_400_not_500(self, model):
+        """Every malformed body is a 400 with a reason — never a 500
+        from deep in the scheduler and never a silent clamp into a
+        request the client didn't make."""
+        cfg, params = model
+        pool, metrics = _make_pool(cfg, params, n_replicas=1)
+        gw = ServingGateway(pool, metrics=metrics)
+        gw.start()
+        try:
+            p = _prompts((5,), seed=6)[0]
+            bad = [
+                {},                                  # no tokens
+                {"tokens": []},                      # empty
+                {"tokens": "not-a-list"},            # wrong type
+                {"tokens": [1, "two", 3]},           # wrong elem type
+                {"tokens": [1, True]},               # bool is not int
+                {"tokens": p, "max_new": 0},         # non-positive
+                {"tokens": p, "max_new": -4},
+                {"tokens": p, "max_new": "five"},    # wrong type
+                {"tokens": p, "max_new": True},
+                {"tokens": p, "deadline_s": 0},
+                {"tokens": p, "deadline_s": "soon"},
+                {"tokens": p, "stream": "yes"},
+                {"tokens": p, "max_tokens": 4},      # unknown key
+                {"tokens": p, "bogus": 1},
+            ]
+            for payload in bad:
+                status, body = self._post(gw.port, payload)
+                assert status == 400, (payload, status, body)
+                assert "error" in body, payload
+            # and a well-formed request still sails through
+            status, body = self._post(
+                gw.port,
+                {"tokens": p, "max_new": 3, "stream": False},
+            )
+            assert status == 200
+            assert body["tokens"] == lockstep_oracle(
+                cfg, params, p, 3
+            )
+        finally:
+            gw.stop()
+            pool.stop()
+
+    def test_non_json_body_gets_400(self, model):
+        cfg, params = model
+        pool, metrics = _make_pool(cfg, params, n_replicas=1)
+        gw = ServingGateway(pool, metrics=metrics)
+        gw.start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=60
+            )
+            conn.request("POST", "/v1/generate", b"not json {")
+            assert conn.getresponse().status == 400
+            conn.close()
+        finally:
+            gw.stop()
+            pool.stop()
+
+
+class TestAllReplicasUnhealthy:
+    def test_routing_raises_cleanly_and_hints_scale_up(self, model):
+        """An all-unhealthy pool: submit raises the typed error (not a
+        crash), and the emergency scale-up hint lands in the KV store
+        despite the cooldown."""
+        from dlrover_tpu.master.kv_store import KVStoreService
+        from dlrover_tpu.serving.replica import NoHealthyReplicasError
+        from dlrover_tpu.serving.scheduler import AdmissionError
+
+        cfg, params = model
+        kv = KVStoreService()
+        pool, _ = _make_pool(cfg, params, n_replicas=2, kv=kv)
+        try:
+            for rep in pool.replicas():
+                rep.healthy = False
+            with pytest.raises(NoHealthyReplicasError) as ei:
+                pool.submit(_prompts((5,), seed=7)[0], max_new=3)
+            # subclass of AdmissionError: existing 429 handlers would
+            # still catch it if the gateway mapping ever regressed
+            assert isinstance(ei.value, AdmissionError)
+            hint = json.loads(kv.get(SCALE_HINT_KEY).decode())
+            assert hint["direction"] == "up"
+            assert hint["replicas"] == 1
+        finally:
+            pool.stop()
+
+    def test_gateway_maps_to_503(self, model):
+        cfg, params = model
+        pool, metrics = _make_pool(cfg, params, n_replicas=1)
+        gw = ServingGateway(pool, metrics=metrics)
+        gw.start()
+        try:
+            for rep in pool.replicas():
+                rep.healthy = False
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=60
+            )
+            conn.request(
+                "POST",
+                "/v1/generate",
+                json.dumps(
+                    {"tokens": _prompts((5,), seed=8)[0], "max_new": 3}
+                ),
+            )
+            resp = conn.getresponse()
+            assert resp.status == 503
+            assert "error" in json.loads(resp.read())
+            conn.close()
+        finally:
+            gw.stop()
+            pool.stop()
+
+
 class TestScaleHints:
     def test_pressure_writes_scale_up_hint_to_master_kv(self, model):
         """Queue pressure above threshold must land a scale-up hint in
