@@ -23,6 +23,14 @@ publishes acceptance, accepted-per-step, and the TPOT p50 pair. The
 contract lock: speculation must accept >1 draft token per verify round
 AND beat baseline TPOT on this workload, or it is dead weight.
 
+A fourth phase drives the same mixed-length set through a TWO-replica
+pool twice: a steady pass, then a chaos pass where a FaultInjector
+kills replica-0 mid-decode. The contract lock: chaos success rate is
+exactly 1.0 (zero admitted requests lost — stranded work fails over
+and resumes by replay), greedy outputs stay byte-identical to the
+steady pass, and the chaos TTFT p99 stays within a bounded multiple of
+steady-state (failover costs one re-prefill, not a retry storm).
+
 Run (real chip):  python benchmarks/serve_bench.py
 CPU smoke:        DLROVER_TPU_FORCE_CPU=1 python benchmarks/serve_bench.py
 Prints ONE JSON line (the schema tests/test_bench_contract.py pins):
@@ -326,6 +334,91 @@ def main():
     assert spec_out == spec_base_out, "speculative greedy parity broke"
     spec_stats = spec_eng.spec.stats()
 
+    # ---- chaos phase: replica death mid-decode, failover contract -------
+    from dlrover_tpu.serving.chaos import FaultInjector
+    from dlrover_tpu.serving.replica import (
+        InferenceReplica,
+        ReplicaPool,
+    )
+
+    def _chaos_pass(fi):
+        """Drive the main mixed-length set through a 2-replica pool
+        (direct pump loop, no threads: deterministic interleaving and
+        the crash's evacuation runs synchronously inside the victim's
+        own pump). Returns (requests, metrics, ttfts)."""
+        cmetrics = ServingMetrics()
+        cpool = ReplicaPool(metrics=cmetrics)
+        creps = []
+        for i in range(2):
+            tag = f"replica-{i}"
+            ceng = ContinuousBatcher(
+                cfg, params, n_slots=n_slots, max_len=max_len,
+                max_new_tokens=max_new, chunk=chunk, pad_id=-1,
+                chaos=fi, chaos_tag=tag,
+            )
+            csched = RequestScheduler(ceng, slo, metrics=cmetrics)
+            crep = InferenceReplica(tag, csched, chaos=fi)
+            cpool.add(crep)
+            creps.append(crep)
+        # compile warm-up per fresh engine, outside the timed region;
+        # the injector is still quiescent here — the caller arms the
+        # crash plan AFTER warm-up, relative to the step counter the
+        # warm drain advanced
+        for crep in creps:
+            w = crep.scheduler.submit(prompts[0], max_new=2)
+            crep.scheduler.run_to_completion()
+            assert w.state.value == "done"
+        return cpool, creps, cmetrics
+
+    def _drain(creps):
+        for _ in range(100_000):
+            busy = False
+            for crep in creps:
+                busy = crep.scheduler.pump() or busy
+            if not busy:
+                return
+        raise AssertionError("chaos pool did not drain")
+
+    def _run_pool(fi, arm=None):
+        cpool, creps, cmetrics = _chaos_pass(fi)
+        if arm is not None:
+            arm(fi, creps)
+        reqs = [
+            creps[i % 2].scheduler.submit(p, max_new=max_new)
+            for i, p in enumerate(prompts)
+        ]
+        _drain(creps)
+        cttfts = sorted(
+            (r.first_token_ts - r.submit_ts) * 1000.0
+            for r in reqs
+            if r.first_token_ts is not None
+        )
+        return reqs, cmetrics, cttfts
+
+    steady_reqs, _, steady_ttfts = _run_pool(FaultInjector(seed=0))
+
+    def _arm(fi, creps):
+        # warm-up advanced each engine's step counter; aim the crash
+        # a few decode steps past wherever replica-0 is NOW so it
+        # lands mid-drain with work both running and queued
+        fi.crash_replica(
+            "replica-0",
+            at_step=creps[0].scheduler.engine._step_no + 3,
+        )
+
+    chaos_fi = FaultInjector(seed=0)
+    chaos_reqs, chaos_metrics, chaos_ttfts = _run_pool(
+        chaos_fi, arm=_arm
+    )
+    assert chaos_fi.fired, "chaos plan never fired"
+    n_chaos_done = sum(
+        1 for r in chaos_reqs if r.state.value == "done"
+    )
+    chaos_success_rate = n_chaos_done / len(chaos_reqs)
+    chaos_parity_ok = [list(r.tokens) for r in chaos_reqs] == [
+        list(r.tokens) for r in steady_reqs
+    ]
+
     print(
         json.dumps(
             {
@@ -393,6 +486,28 @@ def main():
                     ),
                     "spec_draft_len": spec_k,
                     "n_spec_requests": len(spec_prompts),
+                    # chaos phase: replica death mid-decode
+                    "chaos_success_rate": round(
+                        chaos_success_rate, 3
+                    ),
+                    "chaos_parity_ok": chaos_parity_ok,
+                    "chaos_failovers": chaos_metrics.failovers_total,
+                    "chaos_replica_ejections": (
+                        chaos_metrics.replica_ejections
+                    ),
+                    "chaos_failed_total": chaos_metrics.failed_total,
+                    "steady_ttft_p99_ms": round(
+                        pct(steady_ttfts, 0.99), 2
+                    ),
+                    "chaos_ttft_p99_ms": round(
+                        pct(chaos_ttfts, 0.99), 2
+                    ),
+                    "chaos_ttft_p99_ratio": round(
+                        pct(chaos_ttfts, 0.99)
+                        / max(pct(steady_ttfts, 0.99), 1e-9),
+                        3,
+                    ),
+                    "n_chaos_requests": len(chaos_reqs),
                 },
             }
         ),

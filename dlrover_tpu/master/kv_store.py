@@ -58,6 +58,55 @@ class KVStoreService:
             self._store.clear()
 
 
+class RetryingKV:
+    """Client-side retry wrapper over any KV store (duck-typed
+    set/get or MasterClient's kv_set/kv_get): transient transport
+    errors — ConnectionError/TimeoutError/OSError, the master-blip
+    shapes — are retried with capped exponential backoff before they
+    propagate. This is the serving heartbeat's analogue of the
+    trainer's ckpt-restore fallback: a coordination-plane hiccup must
+    not look like a replica failure.
+
+    Non-transport exceptions pass straight through: a genuine bad
+    call should fail loudly, not retry."""
+
+    TRANSIENT = (ConnectionError, TimeoutError, OSError)
+
+    def __init__(
+        self,
+        kv,
+        retries: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        sleep=time.sleep,
+    ):
+        self._kv = kv
+        self.retries = retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._sleep = sleep
+
+    def _call(self, primary: str, fallback: str, *args):
+        fn = getattr(self._kv, primary, None)
+        if fn is None:
+            fn = getattr(self._kv, fallback)
+        delay = self.backoff_base_s
+        for attempt in range(self.retries + 1):
+            try:
+                return fn(*args)
+            except self.TRANSIENT:
+                if attempt >= self.retries:
+                    raise
+                self._sleep(delay)
+                delay = min(delay * 2.0, self.backoff_max_s)
+
+    def set(self, key: str, value: bytes):
+        return self._call("kv_set", "set", key, value)
+
+    def get(self, key: str) -> bytes:
+        return self._call("kv_get", "get", key)
+
+
 class SyncService:
     """Named barriers across workers.
 

@@ -18,9 +18,22 @@ inference:
   speculative.py — n-gram/prompt-lookup drafting + adaptive per-slot
                  draft-length control for the batched verify program
                  (models/decode.py:verify_step)
+  failover.py  — request-level failover: per-request resume journal,
+                 per-replica circuit breaker, crash evacuation by
+                 replaying prompt+emitted as a (prefix-warm) prefill
+  chaos.py     — deterministic, seed-driven fault injection (replica
+                 crash, slow replica, engine-step exception, flaky
+                 coordination KV) via hooks, not monkeypatching
 """
 
+from dlrover_tpu.serving.chaos import ChaosError, ChaosKV, FaultInjector, ReplicaCrashed
 from dlrover_tpu.serving.engine import ContinuousBatcher, GenerationEngine
+from dlrover_tpu.serving.failover import (
+    CircuitBreaker,
+    FailoverManager,
+    RequestJournal,
+    ResumeTicket,
+)
 from dlrover_tpu.serving.metrics import ServingMetrics
 from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
 from dlrover_tpu.serving.scheduler import (
@@ -44,15 +57,23 @@ from dlrover_tpu.serving.gateway import ServingGateway
 
 __all__ = [
     "AdmissionError",
+    "ChaosError",
+    "ChaosKV",
+    "CircuitBreaker",
     "ContinuousBatcher",
+    "FailoverManager",
+    "FaultInjector",
     "GenerationEngine",
     "InferenceReplica",
     "NgramDrafter",
     "NoHealthyReplicasError",
     "RadixPrefixCache",
+    "ReplicaCrashed",
     "ReplicaPool",
+    "RequestJournal",
     "RequestScheduler",
     "RequestState",
+    "ResumeTicket",
     "ServeRequest",
     "ServingGateway",
     "ServingMetrics",

@@ -1,0 +1,233 @@
+"""Deterministic fault injection for the serving stack.
+
+DLRover's tier-3 test discipline (SURVEY.md §4: kill a worker, assert
+recovery) needs an inference-side equivalent that tests and benches
+can drive WITHOUT monkeypatching engine internals. This module is
+that layer: a `FaultInjector` holds seed-driven fault plans and the
+serving components expose three tiny hooks that consult it —
+
+  - engine dispatch:  `ContinuousBatcher(chaos=..., chaos_tag=...)`
+    calls `on_engine_step(tag, step)` before every dispatch; a plan
+    may raise (`ReplicaCrashed` / any exception) or sleep (slow
+    replica).
+  - health probes:    `InferenceReplica(chaos=...)` consults
+    `probe_ok(tag)`; a crashed tag fails its probes until `revive()`.
+  - coordination KV:  `ChaosKV` wraps any KV client (duck-typed
+    set/get like replica.py's `_kv_set`) and raises per plan — the
+    flaky-master double the heartbeat retry path is tested against.
+
+Every plan is installed up front and fires deterministically: "crash
+at step N" fires at step N, and fuzzed plans (`between=(lo, hi)`)
+draw N once from the injector's own seeded RNG at install time — two
+runs with the same seed and the same install order inject the same
+faults. The injector keeps a `fired` log so tests can assert the
+fault actually landed instead of passing vacuously.
+"""
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+
+class ChaosError(RuntimeError):
+    """Base class for injected faults (so tests can catch injected
+    failures without also swallowing real bugs)."""
+
+
+class ReplicaCrashed(ChaosError):
+    """Injected replica death: the engine raises this mid-serve, and
+    the tag's probes keep failing until `revive()` — the in-process
+    stand-in for a preempted TPU slice / OOM-killed pod."""
+
+
+class KVFlake(ConnectionError):
+    """Injected coordination-KV failure. Subclasses ConnectionError so
+    production retry paths treat it exactly like a real master blip."""
+
+
+class _EngineFault:
+    """One engine-dispatch plan: at `at_step`, raise or crash."""
+
+    def __init__(self, at_step: int, exc: Exception, crash: bool):
+        self.at_step = at_step
+        self.exc = exc
+        self.crash = crash  # crash => probes fail until revive()
+        self.fired = False
+
+
+class FaultInjector:
+    """Seed-driven fault plans + the hooks that fire them.
+
+    Thread-safe: the engine hook runs on scheduler threads, the probe
+    hook on the pool thread, and plan installs on the test thread.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._engine: Dict[str, List[_EngineFault]] = {}
+        # tag -> (delay_s, from_step, until_step)
+        self._slow: Dict[str, Tuple[float, int, int]] = {}
+        self._crashed: set = set()
+        # tag -> [remaining_failures, exception factory]
+        self._kv: Dict[str, List[Any]] = {}
+        self.fired: List[Tuple[str, str, int]] = []  # (kind, tag, step)
+
+    # ---- plan installation ----------------------------------------------
+
+    def _pick_step(
+        self,
+        at_step: Optional[int],
+        between: Optional[Tuple[int, int]],
+    ) -> int:
+        if at_step is not None:
+            return int(at_step)
+        if between is None:
+            raise ValueError("need at_step or between=(lo, hi)")
+        lo, hi = between
+        return int(self._rng.integers(lo, hi))
+
+    def crash_replica(
+        self,
+        tag: str,
+        at_step: Optional[int] = None,
+        between: Optional[Tuple[int, int]] = None,
+    ) -> int:
+        """Kill `tag` at an engine step: the dispatch raises
+        ReplicaCrashed and the tag's probes fail until revive().
+        Returns the (possibly seed-drawn) step so tests can log it."""
+        step = self._pick_step(at_step, between)
+        with self._lock:
+            self._engine.setdefault(tag, []).append(
+                _EngineFault(
+                    step, ReplicaCrashed(f"{tag} crashed @step {step}"),
+                    crash=True,
+                )
+            )
+        return step
+
+    def fail_engine_step(
+        self,
+        tag: str,
+        at_step: Optional[int] = None,
+        between: Optional[Tuple[int, int]] = None,
+        exc: Optional[Exception] = None,
+    ) -> int:
+        """One transient engine-step exception at a step (the XLA
+        error / host OOM shape): fires once, probes stay healthy."""
+        step = self._pick_step(at_step, between)
+        with self._lock:
+            self._engine.setdefault(tag, []).append(
+                _EngineFault(
+                    step,
+                    exc or ChaosError(f"{tag} step {step} failed"),
+                    crash=False,
+                )
+            )
+        return step
+
+    def slow_replica(
+        self,
+        tag: str,
+        delay_s: float,
+        from_step: int = 0,
+        until_step: int = 1 << 30,
+    ) -> None:
+        """Stall every dispatch of `tag` in [from_step, until_step) by
+        `delay_s` — the straggler/preemption-pressure shape."""
+        with self._lock:
+            self._slow[tag] = (float(delay_s), from_step, until_step)
+
+    def flaky_kv(
+        self, tag: str, fail_next: int, exc_type: type = KVFlake
+    ) -> None:
+        """Fail the next `fail_next` KV operations of `tag`."""
+        with self._lock:
+            self._kv[tag] = [int(fail_next), exc_type]
+
+    def revive(self, tag: str) -> None:
+        """Clear the tag's crash state and any unfired engine plans —
+        the replacement pod came up."""
+        with self._lock:
+            self._crashed.discard(tag)
+            self._engine.pop(tag, None)
+            self._slow.pop(tag, None)
+
+    def is_crashed(self, tag: str) -> bool:
+        with self._lock:
+            return tag in self._crashed
+
+    # ---- hooks (called by serving components) ---------------------------
+
+    def on_engine_step(self, tag: str, step: int) -> None:
+        """Engine dispatch hook: may sleep (slow plan) or raise
+        (crash / transient plan). A crashed tag keeps raising on any
+        further dispatch until revive()."""
+        delay = 0.0
+        to_raise: Optional[Exception] = None
+        with self._lock:
+            if tag in self._crashed:
+                to_raise = ReplicaCrashed(f"{tag} is crashed")
+            else:
+                slow = self._slow.get(tag)
+                if slow and slow[1] <= step < slow[2]:
+                    delay = slow[0]
+                for fault in self._engine.get(tag, ()):
+                    if not fault.fired and step >= fault.at_step:
+                        fault.fired = True
+                        if fault.crash:
+                            self._crashed.add(tag)
+                        self.fired.append(("engine", tag, step))
+                        to_raise = fault.exc
+                        break
+        if delay > 0.0:
+            time.sleep(delay)
+        if to_raise is not None:
+            logger.info("chaos: injecting %r at %s step %d",
+                        to_raise, tag, step)
+            raise to_raise
+
+    def probe_ok(self, tag: str) -> bool:
+        """Health-probe hook: False while the tag is crashed."""
+        with self._lock:
+            return tag not in self._crashed
+
+    def on_kv_op(self, tag: str, op: str, key: str) -> None:
+        """Coordination-KV hook: raise while the tag's flaky budget
+        lasts."""
+        with self._lock:
+            plan = self._kv.get(tag)
+            if plan is None or plan[0] <= 0:
+                return
+            plan[0] -= 1
+            self.fired.append(("kv", tag, plan[0]))
+            exc_type = plan[1]
+        raise exc_type(f"injected {op}({key}) failure for {tag}")
+
+
+class ChaosKV:
+    """A KV client double: delegates to `kv` (duck-typed set/get or
+    kv_set/kv_get, like replica.py's `_kv_set`) after consulting the
+    injector — so KV flakiness is injected at the client boundary,
+    not by monkeypatching the store."""
+
+    def __init__(self, kv, chaos: FaultInjector, tag: str = "kv"):
+        self._kv = kv
+        self._chaos = chaos
+        self._tag = tag
+
+    def set(self, key: str, value: bytes):
+        self._chaos.on_kv_op(self._tag, "set", key)
+        if hasattr(self._kv, "kv_set"):
+            return self._kv.kv_set(key, value)
+        return self._kv.set(key, value)
+
+    def get(self, key: str) -> bytes:
+        self._chaos.on_kv_op(self._tag, "get", key)
+        if hasattr(self._kv, "kv_get"):
+            return self._kv.kv_get(key)
+        return self._kv.get(key)

@@ -95,6 +95,9 @@ class _Request:
     max_new: int = 0         # per-request cap (0 = engine default)
     out: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # explicit sampling key (crash resume continues a journaled key
+    # stream); None = the engine draws one from its seed at admission
+    prng_key: Optional[np.ndarray] = None
 
 
 # one step() event: (request idx, tokens emitted this chunk, finished)
@@ -129,23 +132,34 @@ def _cached_program(cache: Dict[Any, Any], key, build):
 def _build_chunk_program(
     cfg, pad_id, eos_id, temperature, top_k, top_p
 ):
-    def _sample(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    def _warp(logits):
         logits = logits / temperature
         if 0 < top_k < logits.shape[-1]:
             logits = _mask_top_k(logits, top_k)
         if top_p < 1.0:
             logits = _mask_top_p(logits, top_p)
-        return jax.random.categorical(key, logits).astype(jnp.int32)
+        return logits
 
+    # `keys` is PER-SLOT ([B, 2] uint32), not one engine-global key:
+    # a slot's noise stream depends only on its own key, never on
+    # batch composition. That is what makes crash resume exact — the
+    # scheduler journals each slot's key after every dispatch, and a
+    # request re-admitted elsewhere with that key draws the same
+    # sample an uncrashed run would have. A live slot burns exactly
+    # one split per scan step (== one per emitted token while live).
     @partial(jax.jit, donate_argnums=(0,), static_argnums=(7,))
-    def _run_chunk(cache, params, tok, pos, done, limit, key, k):
+    def _run_chunk(cache, params, tok, pos, done, limit, keys, k):
         def body(carry, _):
-            cache, tok, pos, done, key = carry
+            cache, tok, pos, done, keys = carry
             logits, cache = decode_step(cfg, params, tok, cache, pos)
-            key, sub = jax.random.split(key)
-            nxt = _sample(logits, sub)
+            if temperature <= 0.0:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                pair = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+                keys, subs = pair[:, 0], pair[:, 1]
+                nxt = jax.vmap(
+                    lambda l, kk: jax.random.categorical(kk, l)
+                )(_warp(logits), subs).astype(jnp.int32)
             nxt = jnp.where(done, pad_id, nxt)
             hit_eos = (
                 (nxt == eos_id)
@@ -158,12 +172,12 @@ def _build_chunk_program(
             new_done = done | hit_eos | (pos + 2 >= limit)
             pos = jnp.where(done, pos, pos + 1)
             tok = jnp.where(done, tok, nxt)
-            return (cache, tok, pos, new_done, key), nxt
+            return (cache, tok, pos, new_done, keys), nxt
 
-        (cache, tok, pos, done, key), emitted = jax.lax.scan(
-            body, (cache, tok, pos, done, key), None, length=k,
+        (cache, tok, pos, done, keys), emitted = jax.lax.scan(
+            body, (cache, tok, pos, done, keys), None, length=k,
         )
-        return cache, tok, pos, done, key, emitted.T  # [B, k]
+        return cache, tok, pos, done, keys, emitted.T  # [B, k]
 
     return _run_chunk
 
@@ -193,7 +207,7 @@ def _build_spec_program(
 
     @partial(jax.jit, donate_argnums=(0,))
     def _run_spec(
-        cache, params, tok, pos, done, limit, key, drafts, draft_len
+        cache, params, tok, pos, done, limit, keys, drafts, draft_len
     ):
         b, k = drafts.shape
         tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
@@ -201,11 +215,19 @@ def _build_spec_program(
         if temperature <= 0.0:
             m, extra = spec_accept_greedy(logits, drafts, draft_len)
         else:
-            key, sub = jax.random.split(key)
+            # per-slot keys, like the chunk program: each row's
+            # accept/resample noise comes from its own key stream
+            pair = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+            keys, subs = pair[:, 0], pair[:, 1]
             probs = jax.nn.softmax(_warp(logits), axis=-1)
-            m, extra = spec_accept_sampled(
-                sub, probs, drafts, draft_len
-            )
+
+            def _row(kk, p, d, l):
+                mm, ee = spec_accept_sampled(
+                    kk, p[None], d[None], l[None]
+                )
+                return mm[0], ee[0]
+
+            m, extra = jax.vmap(_row)(subs, probs, drafts, draft_len)
         # emitted layout: m accepted drafts, then the extra token
         # (correction on rejection, bonus on full acceptance), pad
         # beyond — always K+1 wide, n_emit says how much is real
@@ -243,7 +265,7 @@ def _build_spec_program(
         # controller should only credit tokens that shipped
         accepted = jnp.minimum(m, jnp.maximum(n_emit - 1, 0))
         return (
-            cache, new_tok, new_pos, new_done, key,
+            cache, new_tok, new_pos, new_done, keys,
             emitted, n_emit, accepted,
         )
 
@@ -331,6 +353,8 @@ class ContinuousBatcher:
         spec_ngram_min: int = 1,     # shortest n-gram fallback
         spec_accept_threshold: float = 0.5,  # EMA acceptance to keep drafting
         spec_probe_interval: int = 32,  # rounds between disabled-slot probes
+        chaos=None,                  # serving/chaos.py FaultInjector
+        chaos_tag: str = "engine",   # this engine's tag in fault plans
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -355,7 +379,21 @@ class ContinuousBatcher:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.chunk = chunk
+        self.chaos = chaos
+        self.chaos_tag = chaos_tag
+        self._step_no = 0
+        # knobs reset() needs to rebuild device state after a crash
+        self._kv_quant = kv_quant
+        self._prefix_rows = prefix_cache_rows
+        self._prefix_block = prefix_block
+        self._spec_knobs = (
+            spec_ngram_max, spec_ngram_min,
+            spec_accept_threshold, spec_probe_interval,
+        )
+        # engine key only SEEDS per-request keys (one split per
+        # admission); sampling itself runs on the per-slot keys below
         self.key = jax.random.PRNGKey(seed)
+        self.slot_key = np.zeros((n_slots, 2), np.uint32)
         # the slot bank over-allocates by the draft width: a verify
         # dispatch always writes K+1 cells at [pos, pos+K], and a slot
         # near its cap (pos up to max_len-2) must not have that window
@@ -489,11 +527,17 @@ class ContinuousBatcher:
     # -- admission ---------------------------------------------------------
 
     def submit(
-        self, prompt: Sequence[int], max_new: Optional[int] = None
+        self,
+        prompt: Sequence[int],
+        max_new: Optional[int] = None,
+        prng_key: Optional[np.ndarray] = None,
     ) -> int:
         """Queue one request; returns its index in the output list.
         `max_new` caps THIS request's generation (vLLM-style
-        per-request max_tokens); default is the engine's."""
+        per-request max_tokens); default is the engine's. `prng_key`
+        pins the request's sampling key (a failover re-admission
+        continues the journaled key stream); omitted, the engine
+        draws one from its seed at admission."""
         arr = np.asarray(prompt, np.int32)
         if arr.ndim != 1 or arr.size == 0:
             raise ValueError("prompt must be a non-empty 1-D sequence")
@@ -509,6 +553,11 @@ class ContinuousBatcher:
             )
         req = _Request(
             idx=self._next_idx, prompt=arr, max_new=max_new or 0,
+            prng_key=(
+                None
+                if prng_key is None
+                else np.asarray(prng_key, np.uint32).reshape(2)
+            ),
         )
         self._next_idx += 1
         self._requests[req.idx] = req
@@ -541,6 +590,10 @@ class ContinuousBatcher:
         self.limit[slot] = min(
             p + (req.max_new or self.max_new), self.max_len
         )
+        if req.prng_key is None:
+            self.key, sub = jax.random.split(self.key)
+            req.prng_key = np.asarray(sub, np.uint32)
+        self.slot_key[slot] = req.prng_key
         self.done[slot] = False
         self.slot_req[slot] = req
         if self.spec is not None:
@@ -633,6 +686,13 @@ class ContinuousBatcher:
         per request that progressed. Returns [] when there is no
         work. The serving scheduler drives this directly to stream
         tokens as they land; generate_all() is a drain loop over it."""
+        if self.chaos is not None:
+            # before any admission or dispatch: an injected fault
+            # leaves the queue, ledger and cache untouched, so the
+            # caller can snapshot + evacuate from consistent state
+            step_no = self._step_no
+            self._step_no += 1
+            self.chaos.on_engine_step(self.chaos_tag, step_no)
         for slot in range(self.n_slots):
             if self.done[slot] and self._queue:
                 self._admit(slot, self._queue.popleft())
@@ -650,19 +710,20 @@ class ContinuousBatcher:
 
     def _dispatch_chunk(self) -> List[StepEvent]:
         old_pos = self.pos.copy()
-        cache, tok, pos, done, key, emitted = self._run_chunk(
+        cache, tok, pos, done, keys, emitted = self._run_chunk(
             self.cache,
             self.params,
             jnp.asarray(self.tok),
             jnp.asarray(self.pos),
             jnp.asarray(self.done),
             jnp.asarray(self.limit),
-            self.key,
+            jnp.asarray(self.slot_key),
             self._next_chunk_len(),
         )
-        self.cache, self.key = cache, key
+        self.cache = cache
         # np.array (copy): np.asarray of a jax array is a
         # read-only view, and _admit writes these in place
+        self.slot_key = np.array(keys)
         self.tok = np.array(tok)
         self.pos = np.array(pos)
         # live steps form a prefix of the chunk (done is sticky),
@@ -695,7 +756,7 @@ class ContinuousBatcher:
     ) -> List[StepEvent]:
         was_live = ~self.done
         (
-            cache, tok, pos, done, key, emitted, n_emit, accepted
+            cache, tok, pos, done, keys, emitted, n_emit, accepted
         ) = self._run_spec(
             self.cache,
             self.params,
@@ -703,11 +764,12 @@ class ContinuousBatcher:
             jnp.asarray(self.pos),
             jnp.asarray(self.done),
             jnp.asarray(self.limit),
-            self.key,
+            jnp.asarray(self.slot_key),
             jnp.asarray(drafts),
             jnp.asarray(dlens),
         )
-        self.cache, self.key = cache, key
+        self.cache = cache
+        self.slot_key = np.array(keys)
         self.tok = np.array(tok)
         self.pos = np.array(pos)
         n_emit = np.asarray(n_emit)
@@ -762,6 +824,83 @@ class ContinuousBatcher:
             raise KeyError(f"request {idx} is not pending")
         del self._pending[idx]
         return np.asarray(self._requests.pop(idx).out, np.int32)
+
+    def cancel(self, idx: int) -> None:
+        """Abort a request wherever it is — still queued or live in a
+        slot (client disconnected mid-stream). Frees the slot for the
+        next admission and releases any pinned prefix-cache row; a
+        no-op for unknown/already-retired indices."""
+        req = self._requests.pop(idx, None)
+        self._pending.pop(idx, None)
+        if req is None:
+            return
+        try:
+            self._queue.remove(req)
+        except ValueError:
+            pass
+        req.done = True
+        for slot in range(self.n_slots):
+            if self.slot_req[slot] is req:
+                self.done[slot] = True
+                self.slot_req[slot] = None
+                if self.prefix_cache is not None:
+                    self._release_slot_row(slot)
+                break
+
+    def live_request_keys(self) -> Dict[int, np.ndarray]:
+        """idx -> current per-slot PRNG key for every live request —
+        the scheduler journals these after each pump so a failover
+        re-admission continues the exact key stream."""
+        out: Dict[int, np.ndarray] = {}
+        for slot in range(self.n_slots):
+            req = self.slot_req[slot]
+            if req is not None and not req.done:
+                out[req.idx] = self.slot_key[slot].copy()
+        return out
+
+    def reset(self) -> None:
+        """Rebuild device state from scratch after a crash. A real
+        mid-dispatch failure can leave the donated cache buffer
+        invalid, so restart never trusts it: the KV bank (and prefix
+        pool/radix, and spec drafter state) are re-created, the queue
+        and ledger dropped. Request indices stay monotonic so stale
+        events can never alias a new request. Compiled programs are
+        untouched — they're cached per (config, knobs), not per
+        engine state."""
+        self.cache = init_kv_cache(
+            self.cfg,
+            self.n_slots,
+            self.max_len + self.spec_draft_len,
+            quant=self._kv_quant,
+        )
+        self.tok[:] = self.pad_id
+        self.pos[:] = 0
+        self.limit[:] = 0
+        self.done[:] = True
+        self.slot_key[:] = 0
+        self.slot_req = [None] * self.n_slots
+        self._slot_row = [None] * self.n_slots
+        self._queue.clear()
+        self._requests.clear()
+        self._pending.clear()
+        self._step_no = 0
+        if self.prefix_cache is not None:
+            self.prefix_cache = RadixPrefixCache(
+                self._prefix_rows, block=self._prefix_block
+            )
+            self.pool = init_kv_cache(
+                self.cfg, self._prefix_rows, self.max_len
+            )
+        if self.spec is not None:
+            ng_max, ng_min, thresh, probe = self._spec_knobs
+            self.spec = SpeculativeDecoder(
+                self.n_slots,
+                self.spec_draft_len,
+                ngram_max=ng_max,
+                ngram_min=ng_min,
+                threshold=thresh,
+                probe_interval=probe,
+            )
 
     def generate_all(
         self, prompts: Sequence[Sequence[int]]

@@ -1,0 +1,250 @@
+"""Request-level failover: journal, circuit breaker, re-admission.
+
+The reference DLRover survives node loss mid-job because the master
+owns enough state to rebuild any worker (PAPER.md L4/L6). The serving
+equivalent is far cheaper than live KV-cache migration: a decode-only
+request IS its token history. `RequestJournal` keeps (prompt, tokens
+emitted so far, per-request PRNG key, deadline) for every active
+request; when a replica dies, `FailoverManager` re-admits each
+in-flight request to a healthy replica with prompt+emitted as the new
+prefill and the journaled key as the sampling state. Greedy resume is
+token-for-token identical to an uncrashed run; sampled resume
+continues the exact key stream (the engine burns one split per
+emitted token per slot, see engine.py). The PR-2 prefix cache makes
+the replay a warm, suffix-only prefill on the new replica.
+
+`CircuitBreaker` is the per-replica failure detector the pool drives:
+consecutive probe failures trip it OPEN (ejection), probation probes
+are spaced by exponential backoff, and one healthy probation probe
+closes it again. The first trip re-probes immediately — a replica
+that was ejected by a transient blip re-enters the pool on the very
+next health-check pass; only *failed probations* grow the backoff.
+"""
+
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Consecutive-failure ejection -> exponential-backoff probation.
+
+    CLOSED: healthy; `max_strikes` consecutive `record_failure` calls
+    trip it. OPEN: ejected; `should_probe()` stays False until the
+    backoff deadline. HALF_OPEN: one probe in flight — success closes,
+    failure re-trips with doubled backoff (capped). The first trip
+    uses zero delay so transient blips heal on the next check pass.
+    """
+
+    def __init__(
+        self,
+        max_strikes: int = 2,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_strikes = max_strikes
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._clock = clock
+        self.state = CLOSED
+        self.strikes = 0
+        self._opens = 0  # consecutive trips since last close
+        self._retry_at = 0.0
+
+    def _trip(self) -> None:
+        if self._opens == 0:
+            delay = 0.0
+        else:
+            delay = min(
+                self.backoff_base_s * (2.0 ** (self._opens - 1)),
+                self.backoff_max_s,
+            )
+        self._opens += 1
+        self._retry_at = self._clock() + delay
+        self.state = OPEN
+        self.strikes = 0
+
+    def trip(self) -> None:
+        """Force ejection (engine crash observed — don't wait for the
+        probe loop to accumulate strikes)."""
+        self._trip()
+
+    def record_failure(self) -> None:
+        if self.state == HALF_OPEN:
+            self._trip()
+            return
+        if self.state == OPEN:
+            return
+        self.strikes += 1
+        if self.strikes >= self.max_strikes:
+            self._trip()
+
+    def record_success(self) -> None:
+        self.state = CLOSED
+        self.strikes = 0
+        self._opens = 0
+
+    def should_probe(self) -> bool:
+        """True when the replica should be probed this pass. While
+        OPEN and before the backoff deadline, skip probing entirely;
+        past it, move to HALF_OPEN and allow one probe."""
+        if self.state == CLOSED:
+            return True
+        if self.state == HALF_OPEN:
+            return True
+        if self._clock() >= self._retry_at:
+            self.state = HALF_OPEN
+            return True
+        return False
+
+    @property
+    def retry_in_s(self) -> float:
+        return max(0.0, self._retry_at - self._clock())
+
+
+class ResumeTicket:
+    """Everything needed to re-admit one in-flight request elsewhere:
+    replay prompt (original prompt + tokens emitted so far), remaining
+    token budget, and the journaled PRNG key the resumed slot must
+    continue from."""
+
+    def __init__(
+        self,
+        req: Any,
+        prompt: np.ndarray,
+        remaining_new: int,
+        prng_key: Optional[np.ndarray],
+    ):
+        self.req = req
+        self.prompt = prompt
+        self.remaining_new = remaining_new
+        self.prng_key = prng_key
+
+
+class RequestJournal:
+    """Per-active-request resume state on the scheduler.
+
+    The prompt and emitted tokens already live on the ServeRequest
+    (the stream ledger); what the journal adds is the per-slot PRNG
+    key captured after every pump, so a sampled request resumed on
+    another replica draws the exact noise an uncrashed run would.
+    """
+
+    def __init__(self):
+        self._keys = {}  # id(req) -> np.ndarray [2] uint32
+
+    def open(self, req: Any) -> None:
+        key = getattr(req, "prng_key", None)
+        if key is not None:
+            self._keys[id(req)] = np.asarray(key)
+
+    def record_key(self, req: Any, key: np.ndarray) -> None:
+        self._keys[id(req)] = np.array(key, copy=True)
+
+    def close(self, req: Any) -> None:
+        self._keys.pop(id(req), None)
+
+    def snapshot(self, req: Any) -> ResumeTicket:
+        emitted = list(req.tokens)
+        prompt = np.asarray(req.prompt, dtype=np.int32).reshape(-1)
+        if emitted:
+            prompt = np.concatenate(
+                [prompt, np.asarray(emitted, dtype=np.int32)]
+            )
+        return ResumeTicket(
+            req,
+            prompt,
+            int(req.max_new) - len(emitted),
+            self._keys.get(id(req)),
+        )
+
+
+class FailoverManager:
+    """Moves a dead replica's in-flight requests to healthy ones.
+
+    Wired as each scheduler's `on_failure` callback by ReplicaPool;
+    receives the resume tickets the crashing scheduler snapshotted
+    and re-admits them EDF-first so failover respects the same
+    deadline order admission does. A request is failed (not retried
+    forever) once it exceeds `max_retries` crashes or no healthy
+    replica remains.
+    """
+
+    def __init__(self, pool: Any, max_retries: int = 2):
+        self.pool = pool
+        self.max_retries = max_retries
+
+    def _targets(self, source: Any) -> List[Any]:
+        reps = [
+            r
+            for r in self.pool.replicas()
+            if r.scheduler is not source
+            and r.healthy
+            and not r.scheduler.crashed
+        ]
+        reps.sort(key=lambda r: r.load())
+        return reps
+
+    def on_scheduler_failure(
+        self,
+        scheduler: Any,
+        tickets: Sequence[ResumeTicket],
+        exc: BaseException,
+    ) -> None:
+        metrics = self.pool.metrics
+        for rep in self.pool.replicas():
+            if rep.scheduler is scheduler:
+                rep.healthy = False
+                breaker = self.pool.breakers.get(rep.id)
+                if breaker is not None:
+                    breaker.trip()
+                if metrics is not None:
+                    metrics.replica_ejected()
+                logger.warning(
+                    "replica %s ejected after engine failure: %r",
+                    rep.id,
+                    exc,
+                )
+                break
+        for ticket in sorted(
+            tickets, key=lambda t: t.req.deadline
+        ):
+            req = ticket.req
+            if ticket.remaining_new <= 0:
+                # crashed after its last token: it is already done
+                req._end_done()
+                if metrics is not None:
+                    metrics.request_completed()
+                continue
+            req.retries += 1
+            if req.retries > self.max_retries:
+                req._end_failed()
+                if metrics is not None:
+                    metrics.request_failed()
+                continue
+            placed = False
+            for rep in self._targets(scheduler):
+                try:
+                    placed = rep.scheduler.readmit(req, ticket)
+                except Exception:
+                    continue
+                if placed:
+                    if metrics is not None:
+                        metrics.failover()
+                    break
+                # readmit() returned False: deadline already passed
+                # and the scheduler shed it — do not try elsewhere
+                placed = True
+                break
+            if not placed:
+                req._end_failed()
+                if metrics is not None:
+                    metrics.request_failed()

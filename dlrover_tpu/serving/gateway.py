@@ -197,8 +197,12 @@ class ServingGateway:
                         ).encode()
                         + b"\n"
                     )
-                except BrokenPipeError:
-                    pass  # client went away; scheduler finishes anyway
+                except (BrokenPipeError, ConnectionResetError):
+                    # client went away mid-stream: cancel the request
+                    # so its slot (and any pinned prefix-cache row)
+                    # frees NOW instead of decoding tokens nobody
+                    # will read
+                    gw._cancel(req)
 
             def _blocking(self, req):
                 if not req.wait(timeout=gw.stream_timeout_s):
@@ -206,6 +210,12 @@ class ServingGateway:
                     return
                 if req.state is RequestState.SHED:
                     self._json(503, gw._trailer(req))
+                    return
+                if req.state is RequestState.FAILED:
+                    # crashed past its retry budget: the service
+                    # dropped admitted work — a server error, not
+                    # client backpressure
+                    self._json(500, gw._trailer(req))
                     return
                 self._json(
                     200, {"tokens": req.tokens, **gw._trailer(req)}
@@ -216,6 +226,22 @@ class ServingGateway:
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _cancel(req) -> None:
+        """Best-effort cancellation on client disconnect: the request
+        knows which scheduler currently hosts it (failover may have
+        moved it since submit). Never raises back into the stream
+        handler — the connection is already gone."""
+        sched = getattr(req, "scheduler", None)
+        if sched is None:
+            return
+        try:
+            sched.cancel(req)
+        except Exception:  # noqa: BLE001
+            logger.exception(
+                "cancel after disconnect failed for request %d", req.id
+            )
 
     @staticmethod
     def _trailer(req) -> dict:

@@ -62,6 +62,12 @@ class ServingMetrics:
         self._shed_total = 0
         self._rejected_total = 0
         self._tokens_total = 0
+        # failover / lifecycle counters
+        self._failed_total = 0
+        self._cancelled_total = 0
+        self._failovers_total = 0
+        self._replica_ejections = 0
+        self._replica_readmissions = 0
         # (tokens, ts) window for the tokens/sec rate gauge
         self._token_events: Deque[Tuple[int, float]] = deque(maxlen=512)
         # prefix-cache counters: copied verbatim from the engine's
@@ -96,6 +102,28 @@ class ServingMetrics:
     def request_completed(self):
         with self._lock:
             self._completed_total += 1
+
+    def request_failed(self):
+        with self._lock:
+            self._failed_total += 1
+
+    def request_cancelled(self):
+        with self._lock:
+            self._cancelled_total += 1
+
+    def failover(self):
+        """One in-flight request successfully re-admitted elsewhere
+        after its replica died."""
+        with self._lock:
+            self._failovers_total += 1
+
+    def replica_ejected(self):
+        with self._lock:
+            self._replica_ejections += 1
+
+    def replica_readmitted(self):
+        with self._lock:
+            self._replica_readmissions += 1
 
     def observe_ttft(self, ms: float):
         with self._lock:
@@ -174,6 +202,31 @@ class ServingMetrics:
     def tokens_total(self) -> int:
         with self._lock:
             return self._tokens_total
+
+    @property
+    def failed_total(self) -> int:
+        with self._lock:
+            return self._failed_total
+
+    @property
+    def cancelled_total(self) -> int:
+        with self._lock:
+            return self._cancelled_total
+
+    @property
+    def failovers_total(self) -> int:
+        with self._lock:
+            return self._failovers_total
+
+    @property
+    def replica_ejections(self) -> int:
+        with self._lock:
+            return self._replica_ejections
+
+    @property
+    def replica_readmissions(self) -> int:
+        with self._lock:
+            return self._replica_readmissions
 
     @property
     def queue_depth(self) -> int:
@@ -297,6 +350,31 @@ class ServingMetrics:
                 "serving_requests_rejected_total",
                 "Requests rejected at admission.",
                 self._rejected_total,
+            )
+            counter(
+                "serving_requests_failed_total",
+                "Requests failed after exhausting failover retries.",
+                self._failed_total,
+            )
+            counter(
+                "serving_requests_cancelled_total",
+                "Requests cancelled (client disconnected).",
+                self._cancelled_total,
+            )
+            counter(
+                "serving_failovers_total",
+                "In-flight requests re-admitted after replica death.",
+                self._failovers_total,
+            )
+            counter(
+                "serving_replica_ejections_total",
+                "Replicas ejected by crash or circuit breaker.",
+                self._replica_ejections,
+            )
+            counter(
+                "serving_replica_readmissions_total",
+                "Ejected replicas re-admitted after probation.",
+                self._replica_readmissions,
             )
             counter(
                 "serving_tokens_total",

@@ -25,6 +25,12 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.master.kv_store import RetryingKV
+from dlrover_tpu.serving.failover import (
+    OPEN,
+    CircuitBreaker,
+    FailoverManager,
+)
 from dlrover_tpu.serving.scheduler import (
     AdmissionError,
     RequestScheduler,
@@ -69,10 +75,16 @@ class InferenceReplica:
         replica_id: str,
         scheduler: RequestScheduler,
         kv=None,
+        chaos=None,
+        kv_retries: int = 3,
+        kv_backoff_s: float = 0.05,
     ):
         self.id = replica_id
         self.scheduler = scheduler
         self.kv = kv
+        self.chaos = chaos
+        self.kv_retries = kv_retries
+        self.kv_backoff_s = kv_backoff_s
         self.healthy = True
         self.strikes = 0
 
@@ -83,8 +95,27 @@ class InferenceReplica:
         return REPLICA_KEY_PREFIX + self.id
 
     def register(self):
-        if self.kv is not None:
-            _kv_set(self.kv, self.kv_key, self._meta())
+        """Write this replica's entry, retrying transient KV errors
+        with capped exponential backoff (RetryingKV). Exhausted
+        retries are logged, not raised: a master blip must not crash
+        the heartbeat/pool thread — the entry just goes stale until
+        the next beat (the master-side reader's dead-replica signal
+        anyway)."""
+        if self.kv is None:
+            return
+        rkv = RetryingKV(
+            self.kv,
+            retries=self.kv_retries,
+            backoff_base_s=self.kv_backoff_s,
+        )
+        try:
+            rkv.set(self.kv_key, self._meta())
+        except RetryingKV.TRANSIENT:
+            logger.warning(
+                "replica %s registration still failing after %d "
+                "retries (master unreachable?)",
+                self.id, self.kv_retries, exc_info=True,
+            )
 
     def heartbeat(self):
         """Refresh the registration with live load (the master-side
@@ -108,9 +139,17 @@ class InferenceReplica:
 
     def probe(self) -> bool:
         """One health probe: the scheduler's driver thread is live (if
-        started) and its queue answers. Chaos tests force a failure
-        via DLROVER_TPU_SERVING_MOCK_ERR_REPLICA=<id>."""
+        started) and its queue answers. Chaos faults come in two
+        flavors: the env knob DLROVER_TPU_SERVING_MOCK_ERR_REPLICA=<id>
+        (agent/node_check.py's MOCK_ERR_RANK idiom) and a
+        serving/chaos.py injector whose crash plans fail this tag's
+        probes until revive(). A crashed scheduler is NOT a probe
+        failure by itself — check_replicas handles it via restart()."""
         if os.environ.get(MOCK_ERR_REPLICA_ENV, "") == self.id:
+            return False
+        if self.chaos is not None and not self.chaos.probe_ok(
+            self.chaos_tag
+        ):
             return False
         t = self.scheduler._thread
         if t is not None and not t.is_alive():
@@ -121,6 +160,28 @@ class InferenceReplica:
         except Exception:  # noqa: BLE001 — any engine error = unhealthy
             logger.exception("replica %s probe failed", self.id)
             return False
+
+    @property
+    def chaos_tag(self) -> str:
+        """The tag fault plans address this replica by: the engine's
+        chaos tag when the engine is chaos-wired (so ONE crash plan
+        covers both the dispatch and the probe), else the replica
+        id."""
+        eng = self.scheduler.engine
+        if getattr(eng, "chaos", None) is not None:
+            return eng.chaos_tag
+        return self.id
+
+    def restart(self) -> bool:
+        """Rebuild a crashed scheduler/engine and re-register. Called
+        from the pool's probation path once probes pass again."""
+        try:
+            self.scheduler.restart()
+        except Exception:  # noqa: BLE001
+            logger.exception("replica %s restart failed", self.id)
+            return False
+        self.register()
+        return True
 
     def load(self) -> float:
         """Routing weight: waiting pressure plus slot occupancy, so an
@@ -147,11 +208,32 @@ class ReplicaPool:
         max_strikes: int = 2,
         hint_cooldown_s: float = 10.0,
         advisor: Optional[Callable[[dict], None]] = None,
+        metrics=None,
+        clock: Callable[[], float] = time.monotonic,
+        failover: bool = True,
+        max_retries: int = 2,
+        breaker_backoff_base_s: float = 0.5,
+        breaker_backoff_max_s: float = 30.0,
     ):
         self.kv = kv
         self.max_strikes = max_strikes
         self.hint_cooldown_s = hint_cooldown_s
         self.advisor = advisor
+        self.metrics = metrics
+        self._clock = clock
+        self.breaker_backoff_base_s = breaker_backoff_base_s
+        self.breaker_backoff_max_s = breaker_backoff_max_s
+        # per-replica circuit breakers: consecutive-failure ejection,
+        # exponential-backoff probation, one clean probe to re-admit
+        self.breakers: Dict[str, CircuitBreaker] = {}
+        # request-level failover: wired as each added scheduler's
+        # on_failure so a crashing engine's in-flight requests are
+        # re-admitted on healthy peers instead of failing
+        self.manager: Optional[FailoverManager] = (
+            FailoverManager(self, max_retries=max_retries)
+            if failover
+            else None
+        )
         self._lock = threading.Lock()
         self._replicas: Dict[str, InferenceReplica] = {}
         self._last_hint_ts = 0.0
@@ -160,11 +242,23 @@ class ReplicaPool:
 
     # ---- membership ------------------------------------------------------
 
+    def _new_breaker(self) -> CircuitBreaker:
+        return CircuitBreaker(
+            max_strikes=self.max_strikes,
+            backoff_base_s=self.breaker_backoff_base_s,
+            backoff_max_s=self.breaker_backoff_max_s,
+            clock=self._clock,
+        )
+
     def add(self, replica: InferenceReplica):
         if replica.kv is None:
             replica.kv = self.kv
         with self._lock:
             self._replicas[replica.id] = replica
+            self.breakers[replica.id] = self._new_breaker()
+        sched = replica.scheduler
+        if self.manager is not None and sched.on_failure is None:
+            sched.on_failure = self.manager.on_scheduler_failure
         replica.register()
 
     def remove(self, replica_id: str) -> Optional[InferenceReplica]:
@@ -210,24 +304,63 @@ class ReplicaPool:
     # ---- health + scaling ------------------------------------------------
 
     def check_replicas(self):
-        """One health round: consecutive probe failures accumulate
-        strikes; `max_strikes` marks the replica unhealthy (and out of
-        routing); a passing probe heals it."""
+        """One health round, per-replica isolated: a replica whose
+        probe (or heartbeat) RAISES must not abort the rest of the
+        pass or the background loop — the exception counts as that
+        replica's failed probe and the round continues."""
         for rep in self.replicas():
-            if rep.probe():
-                rep.strikes = 0
-                if not rep.healthy:
-                    logger.info("replica %s recovered", rep.id)
+            try:
+                self._check_one(rep)
+            except Exception:  # noqa: BLE001 — isolate per replica
+                logger.exception(
+                    "health check failed for replica %s", rep.id
+                )
+
+    def _check_one(self, rep: InferenceReplica):
+        """Breaker-driven health step for one replica.
+
+        CLOSED: probe normally; `max_strikes` consecutive failures
+        trip the breaker (ejection from routing). OPEN: skip probing
+        entirely until the exponential-backoff deadline — a dead
+        replica must not eat a probe (and a heartbeat write) every
+        pass. Past the deadline, HALF_OPEN: one probation probe. A
+        clean probe re-admits the replica — restarting its scheduler
+        first if it crashed (engine reset, empty queue). A failed
+        probation re-trips with doubled backoff."""
+        breaker = self.breakers.get(rep.id)
+        if breaker is None:  # replica added behind the pool's back
+            breaker = self.breakers[rep.id] = self._new_breaker()
+        if not breaker.should_probe():
+            return
+        try:
+            ok = rep.probe()
+        except Exception:  # noqa: BLE001 — a raising probe = failed
+            logger.exception("replica %s probe raised", rep.id)
+            ok = False
+        if ok and rep.scheduler.crashed:
+            # probes pass again (fault cleared) but the engine died
+            # mid-serve: probation includes the rebuild
+            ok = rep.restart()
+        if ok:
+            breaker.record_success()
+            rep.strikes = 0
+            if not rep.healthy:
+                logger.info("replica %s recovered", rep.id)
                 rep.healthy = True
-            else:
-                rep.strikes += 1
-                if rep.strikes >= self.max_strikes and rep.healthy:
-                    rep.healthy = False
-                    logger.warning(
-                        "replica %s unhealthy after %d strikes",
-                        rep.id, rep.strikes,
-                    )
+                if self.metrics is not None:
+                    self.metrics.replica_readmitted()
             rep.heartbeat()
+        else:
+            breaker.record_failure()
+            rep.strikes = breaker.strikes
+            if breaker.state == OPEN and rep.healthy:
+                rep.healthy = False
+                if self.metrics is not None:
+                    self.metrics.replica_ejected()
+                logger.warning(
+                    "replica %s ejected (breaker open, retry in "
+                    "%.2fs)", rep.id, breaker.retry_in_s,
+                )
 
     def aggregate_pressure(self) -> float:
         reps = self.healthy_replicas()

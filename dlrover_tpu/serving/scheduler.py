@@ -36,6 +36,7 @@ import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving.engine import ContinuousBatcher
+from dlrover_tpu.serving.failover import RequestJournal, ResumeTicket
 from dlrover_tpu.serving.metrics import ServingMetrics
 
 
@@ -53,6 +54,8 @@ class RequestState(enum.Enum):
     RUNNING = "running"
     DONE = "done"
     SHED = "shed"
+    FAILED = "failed"        # crashed and exhausted its retry budget
+    CANCELLED = "cancelled"  # client went away mid-stream
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,9 +91,29 @@ class ServeRequest:
         self.tokens: List[int] = []
         self.first_token_ts: Optional[float] = None
         self.finish_ts: Optional[float] = None
+        # failover state: the scheduler currently hosting the request
+        # (re-pointed on re-admission), crash count, and the PRNG key
+        # the next admission must continue from (None = engine draws)
+        self.scheduler: Optional["RequestScheduler"] = None
+        self.retries = 0
+        self.prng_key: Optional[np.ndarray] = None
         # chunks of newly emitted tokens; None terminates the stream
         self.stream: "queue.Queue[Optional[List[int]]]" = queue.Queue()
         self._finished = threading.Event()
+
+    def engine_spec(self):
+        """(prompt, max_new) for the next engine admission. After a
+        crash the already-emitted tokens become part of the prompt —
+        resume is a replay-prefill, not a re-generate — and the
+        budget shrinks by what already shipped."""
+        if not self.tokens:
+            return self.prompt, self.max_new
+        return (
+            np.concatenate(
+                [self.prompt, np.asarray(self.tokens, np.int32)]
+            ),
+            self.max_new - len(self.tokens),
+        )
 
     def iter_stream(
         self, timeout: Optional[float] = None
@@ -107,10 +130,25 @@ class ServeRequest:
         return self._finished.wait(timeout)
 
     def _end(self, state: RequestState, ts: float):
+        if self.finish_ts is not None:  # idempotent across failover
+            return
         self.state = state
         self.finish_ts = ts
         self.stream.put(None)
         self._finished.set()
+
+    def _end_done(self):
+        """FailoverManager path: the crash landed after the request's
+        last token — it is complete, not failed."""
+        self._end(RequestState.DONE, _req_clock(self))
+
+    def _end_failed(self):
+        self._end(RequestState.FAILED, _req_clock(self))
+
+
+def _req_clock(req: ServeRequest) -> float:
+    sched = req.scheduler
+    return sched._clock() if sched is not None else time.monotonic()
 
 
 class RequestScheduler:
@@ -126,6 +164,7 @@ class RequestScheduler:
         slo: Optional[SloConfig] = None,
         metrics: Optional[ServingMetrics] = None,
         clock=time.monotonic,
+        on_failure=None,
     ):
         self.engine = engine
         self.slo = slo or SloConfig()
@@ -133,10 +172,21 @@ class RequestScheduler:
         self._clock = clock
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
-        # EDF heap of (deadline, id, request)
+        # EDF heap of (deadline, seq, request). The tiebreak is a
+        # scheduler-local sequence, NOT req.id: a failover-readmitted
+        # request carries its id from ANOTHER scheduler, and a
+        # collision would fall through to comparing ServeRequests.
         self._waiting: List[Any] = []
+        self._seq = 0
         self._running: Dict[int, ServeRequest] = {}  # engine idx -> req
         self._next_id = 0
+        # crash handling: the journal holds per-request resume keys;
+        # `on_failure(scheduler, tickets, exc)` — wired to the pool's
+        # FailoverManager — re-homes in-flight work when the engine
+        # raises. Without a callback, affected requests end FAILED.
+        self.journal = RequestJournal()
+        self.on_failure = on_failure
+        self.crashed = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -154,6 +204,9 @@ class RequestScheduler:
         slo = self.slo
         want = max_new or min(self.engine.max_new, slo.max_new_tokens)
         with self._cond:
+            if self.crashed:
+                self.metrics.request_rejected()
+                raise AdmissionError("replica crashed, pending restart")
             if len(self._waiting) >= slo.max_queue_depth:
                 self.metrics.request_rejected()
                 raise AdmissionError(
@@ -190,7 +243,9 @@ class RequestScheduler:
                 submit_ts=now,
             )
             self._next_id += 1
-            heapq.heappush(self._waiting, (req.deadline, req.id, req))
+            req.scheduler = self
+            heapq.heappush(self._waiting, (req.deadline, self._seq, req))
+            self._seq += 1
             self.metrics.request_submitted()
             self.metrics.set_queue_depth(len(self._waiting))
             self._cond.notify_all()
@@ -219,10 +274,19 @@ class RequestScheduler:
 
     def _shed_expired(self, now: float):
         """Shed every WAITING request whose deadline already passed
-        (the heap is deadline-ordered, so they sit at the front)."""
-        while self._waiting and self._waiting[0][0] <= now:
-            _, _, req = heapq.heappop(self._waiting)
+        (the heap is deadline-ordered, so they sit at the front).
+        Cancelled entries linger in the heap until they surface here
+        or at admission (lazy removal) — just drop them."""
+        while self._waiting:
+            deadline, _, req = self._waiting[0]
+            if req.state is not RequestState.QUEUED:
+                heapq.heappop(self._waiting)
+                continue
+            if deadline > now:
+                break
+            heapq.heappop(self._waiting)
             req._end(RequestState.SHED, now)
+            self.journal.close(req)
             self.metrics.request_shed()
             logger.info(
                 "shed request %d: deadline passed %.3fs ago in queue",
@@ -232,21 +296,48 @@ class RequestScheduler:
     def pump(self) -> bool:
         """One scheduling iteration: shed expired, admit EDF into free
         slots, decode one chunk, stream the emitted tokens. Returns
-        True while work remains."""
+        True while work remains.
+
+        If the engine raises (injected fault or real failure), the
+        scheduler marks itself crashed, snapshots every in-flight
+        request into resume tickets, and hands them to `on_failure`
+        OUTSIDE its own lock (the failover manager re-admits them on
+        peer schedulers, which take their locks)."""
+        failure = None
         with self._cond:
+            if self.crashed:
+                return False
             now = self._clock()
             self._shed_expired(now)
-            # admit only up to the engine's free slots so EDF order,
-            # not engine-internal FIFO, decides dispatch
-            while (
-                self._waiting
-                and self.engine.queue_len() < self.engine.free_slots()
-            ):
-                _, _, req = heapq.heappop(self._waiting)
-                idx = self.engine.submit(req.prompt, max_new=req.max_new)
-                req.state = RequestState.RUNNING
-                self._running[idx] = req
-            events = self.engine.step() if self.engine.has_work() else []
+            try:
+                # admit only up to the engine's free slots so EDF
+                # order, not engine-internal FIFO, decides dispatch
+                while (
+                    self._waiting
+                    and self.engine.queue_len() < self.engine.free_slots()
+                ):
+                    _, _, req = heapq.heappop(self._waiting)
+                    if req.state is not RequestState.QUEUED:
+                        continue  # cancelled while waiting
+                    prompt, remaining = req.engine_spec()
+                    idx = self.engine.submit(
+                        prompt,
+                        max_new=remaining,
+                        prng_key=req.prng_key,
+                    )
+                    req.state = RequestState.RUNNING
+                    self._running[idx] = req
+                    self.journal.open(req)
+                events = (
+                    self.engine.step() if self.engine.has_work() else []
+                )
+            except Exception as exc:
+                failure = (self._crash_locked(), exc)
+                events = []
+        if failure is not None:
+            self._dispatch_failure(failure[0], failure[1])
+            return False
+        with self._cond:
             now = self._clock()
             for idx, new_toks, finished in events:
                 req = self._running.get(idx)
@@ -264,6 +355,7 @@ class RequestScheduler:
                 if finished:
                     self.engine.retire(idx)
                     del self._running[idx]
+                    self.journal.close(req)
                     if (
                         req.first_token_ts is not None
                         and len(req.tokens) > 1
@@ -275,6 +367,12 @@ class RequestScheduler:
                         )
                     req._end(RequestState.DONE, now)
                     self.metrics.request_completed()
+            # journal the post-dispatch per-slot keys: this is the
+            # PRNG state a failover re-admission must continue from
+            for idx, key in self.engine.live_request_keys().items():
+                live = self._running.get(idx)
+                if live is not None:
+                    self.journal.record_key(live, key)
             self.metrics.set_queue_depth(len(self._waiting))
             self.metrics.set_active_requests(len(self._running))
             pc = getattr(self.engine, "prefix_cache", None)
@@ -289,6 +387,103 @@ class RequestScheduler:
                     spec.rounds, spec.emitted,
                 )
             return bool(self._waiting) or bool(self._running)
+
+    # ---- failover --------------------------------------------------------
+
+    def _crash_locked(self) -> List[ResumeTicket]:
+        """Under the lock: mark crashed and snapshot every in-flight
+        request (running AND still-queued) into resume tickets. The
+        engine's device state is not trusted after this — restart()
+        rebuilds it."""
+        self.crashed = True
+        tickets = []
+        for req in self._running.values():
+            tickets.append(self.journal.snapshot(req))
+        self._running.clear()
+        while self._waiting:
+            _, _, req = heapq.heappop(self._waiting)
+            if req.state is RequestState.QUEUED:
+                tickets.append(self.journal.snapshot(req))
+        self.journal = RequestJournal()
+        self.metrics.set_queue_depth(0)
+        self.metrics.set_active_requests(0)
+        return tickets
+
+    def _dispatch_failure(
+        self, tickets: List[ResumeTicket], exc: BaseException
+    ):
+        logger.error(
+            "engine failure with %d in-flight request(s): %r",
+            len(tickets), exc,
+        )
+        if self.on_failure is not None:
+            try:
+                self.on_failure(self, tickets, exc)
+                return
+            except Exception:
+                logger.exception("failover callback failed")
+        now = self._clock()
+        for t in tickets:
+            if t.req.finish_ts is None:
+                t.req._end(RequestState.FAILED, now)
+                self.metrics.request_failed()
+
+    def readmit(self, req: ServeRequest, ticket: ResumeTicket) -> bool:
+        """Accept a request evacuated from a crashed peer. Bypasses
+        the queue-depth bound — failing over admitted work beats
+        429ing it — but still honours the deadline: an already-late
+        request is shed here (returns False), never decoded. The
+        journaled key is pinned so the resumed slot continues the
+        exact sampling stream."""
+        with self._cond:
+            if self.crashed:
+                raise AdmissionError("replica crashed, pending restart")
+            now = self._clock()
+            if req.deadline <= now:
+                req._end(RequestState.SHED, now)
+                self.metrics.request_shed()
+                return False
+            if ticket.prng_key is not None:
+                req.prng_key = np.asarray(ticket.prng_key, np.uint32)
+            req.scheduler = self
+            req.state = RequestState.QUEUED
+            heapq.heappush(self._waiting, (req.deadline, self._seq, req))
+            self._seq += 1
+            self.metrics.set_queue_depth(len(self._waiting))
+            self._cond.notify_all()
+            return True
+
+    def cancel(self, req: ServeRequest) -> bool:
+        """Abort a request (client disconnected): frees its slot and
+        any prefix-cache pin immediately instead of decoding tokens
+        nobody reads. Queued entries are removed lazily from the
+        heap. Returns False if the request already ended."""
+        with self._cond:
+            if req.state is RequestState.RUNNING:
+                for idx, r in list(self._running.items()):
+                    if r is req:
+                        self.engine.cancel(idx)
+                        del self._running[idx]
+                        break
+            elif req.state is not RequestState.QUEUED:
+                return False
+            self.journal.close(req)
+            req._end(RequestState.CANCELLED, self._clock())
+            self.metrics.request_cancelled()
+            return True
+
+    def restart(self) -> None:
+        """Bring a crashed scheduler back: rebuild the engine's
+        device state from scratch and clear the crashed flag. The
+        background thread (if any) stays up throughout — it idles
+        while crashed and resumes pumping here."""
+        with self._cond:
+            self.engine.reset()
+            self._waiting.clear()
+            self._running.clear()
+            self.journal = RequestJournal()
+            self.crashed = False
+            self._cond.notify_all()
 
     def run_to_completion(self):
         """Drain everything submitted so far (tests/bench path)."""

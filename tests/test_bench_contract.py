@@ -143,6 +143,16 @@ def test_serve_bench_smoke_emits_driver_contract():
         "spec_tokens_per_step",
         "spec_draft_len",
         "n_spec_requests",
+        # chaos phase: the crash-safety evidence axes
+        "chaos_success_rate",
+        "chaos_parity_ok",
+        "chaos_failovers",
+        "chaos_replica_ejections",
+        "chaos_failed_total",
+        "steady_ttft_p99_ms",
+        "chaos_ttft_p99_ms",
+        "chaos_ttft_p99_ratio",
+        "n_chaos_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -162,3 +172,14 @@ def test_serve_bench_smoke_emits_driver_contract():
         < detail["spec_baseline_tpot_ms_p50"]
     )
     assert detail["n_spec_requests"] > 0
+    # the crash-safety acceptance floor: a replica killed mid-decode
+    # loses ZERO admitted requests, resumed greedy streams are
+    # byte-identical to the steady run, and failover's latency cost is
+    # one re-prefill — bounded, not a retry storm
+    assert detail["chaos_success_rate"] == 1.0
+    assert detail["chaos_parity_ok"] is True
+    assert detail["chaos_failovers"] >= 1
+    assert detail["chaos_replica_ejections"] >= 1
+    assert detail["chaos_failed_total"] == 0
+    assert 0.0 < detail["chaos_ttft_p99_ratio"] <= 25.0
+    assert detail["n_chaos_requests"] > 0
