@@ -48,9 +48,34 @@ Two driving modes share one loop body:
   into free slots, run ONE chunk, and return per-request token
   deltas as they are emitted. The scheduler streams these to
   clients and `retire()`s finished requests.
+
+Device residency + async dispatch (the perf layer over both modes):
+
+- Slot state (`tok`/`pos`/`done`/`limit`/`slot_key`) lives on device
+  between dispatches; admissions and cancels apply as tiny jit'd
+  scatter updates instead of re-uploading five host arrays per
+  chunk. Host numpy mirrors of the same state (same attribute
+  names) keep `_admit`/scheduler decisions host-cheap; they are
+  refreshed ONLY from a dispatch's fetched outputs, never by a
+  fresh blocking copy — `_to_host` is the module's single
+  device→host materialization point (tests/test_layering.py lints
+  this).
+- `async_depth=1` pipelines one dispatch deep: dispatch N is
+  enqueued via JAX async dispatch with `copy_to_host_async()`
+  started on its outputs, and `step()` returns the events of
+  dispatch N-1 — so the host's event emission, streaming, journaling
+  and the next drafting/admission pass overlap dispatch N's device
+  compute instead of serializing with it. `async_depth=0` (default)
+  harvests in the same call: bit-exact legacy behavior, and the
+  parity oracle for the async path. Either way the dispatch
+  SEQUENCE is identical — drafting and admission always see the
+  fully-harvested state of dispatch N-1 before dispatch N is built —
+  so greedy streams are byte-identical across depths (DEVIATIONS
+  §9 records the staleness contract this leaves the scheduler).
 """
 
 import dataclasses
+import time
 from collections import deque
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -323,6 +348,69 @@ def _build_admit_programs(cfg, max_len):
     }
 
 
+# ---------------------------------------------------------------------------
+# Device-resident slot state. The [B]-vector state lives on device
+# between dispatches; these scatter programs are the ONLY way host
+# events (admission, cancel, failover re-key) reach it. `slot` and the
+# scalar values are traced, so each program compiles once per bank
+# shape — never per slot or per request. The buffers are tiny, so
+# nothing here donates: a cancel may land while a dispatch's outputs
+# still have a pending copy_to_host_async, and donating such a buffer
+# would race the copy.
+
+
+@jax.jit
+def _state_admit_prog(tok, pos, done, limit, keys,
+                      slot, tok_v, pos_v, limit_v, key_v):
+    return (
+        tok.at[slot].set(tok_v),
+        pos.at[slot].set(pos_v),
+        done.at[slot].set(False),
+        limit.at[slot].set(limit_v),
+        keys.at[slot].set(key_v),
+    )
+
+
+@jax.jit
+def _state_cancel_prog(done, slot):
+    return done.at[slot].set(True)
+
+
+def _to_host(*arrays) -> Tuple[np.ndarray, ...]:
+    """THE designated fetch helper: the only place in this module a
+    device array may materialize on the host. Blocking lives here by
+    design — in async mode the copies were started with
+    copy_to_host_async() at dispatch, so this completes them instead
+    of issuing fresh synchronous D2H transfers. np.array (copy, not
+    view): the results become the writable host mirrors that
+    _admit/cancel mutate in place."""
+    return tuple(np.array(a) for a in arrays)
+
+
+def _start_host_copy(arrays) -> None:
+    """Begin non-blocking D2H copies on a dispatch's outputs; the
+    harvest's _to_host then completes them after the host has had the
+    device span to do real work."""
+    for a in arrays:
+        start = getattr(a, "copy_to_host_async", None)
+        if start is not None:
+            start()
+
+
+@dataclasses.dataclass
+class _Inflight:
+    """One dispatched-but-not-harvested device step: the output
+    arrays (host copies already in flight) plus the host-side context
+    needed to turn them into events at harvest time."""
+
+    kind: str                       # "chunk" | "spec"
+    arrays: tuple                   # device outputs, fetch order
+    dispatched_at: float            # perf_counter at enqueue
+    old_pos: Optional[np.ndarray] = None    # chunk: pos at dispatch
+    dlens: Optional[np.ndarray] = None      # spec: drafted lengths
+    was_live: Optional[np.ndarray] = None   # spec: live at dispatch
+
+
 class ContinuousBatcher:
     """Greedy/sampling rollouts over a slot bank.
 
@@ -355,6 +443,7 @@ class ContinuousBatcher:
         spec_probe_interval: int = 32,  # rounds between disabled-slot probes
         chaos=None,                  # serving/chaos.py FaultInjector
         chaos_tag: str = "engine",   # this engine's tag in fault plans
+        async_depth: int = 0,        # 1 = one-deep pipelined dispatch
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -369,6 +458,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"spec_draft_len {spec_draft_len} must be < max_len "
                 f"{max_len}"
+            )
+        if async_depth not in (0, 1):
+            raise ValueError(
+                f"async_depth must be 0 (sync) or 1 (one-deep "
+                f"pipeline), got {async_depth}"
             )
         _check_positional_capacity(cfg, max_len)
         self.cfg = cfg
@@ -405,11 +499,29 @@ class ContinuousBatcher:
         self.cache = init_kv_cache(
             cfg, n_slots, max_len + spec_draft_len, quant=kv_quant
         )
-        # host-side slot state (tiny [B] vectors; shipped per chunk)
+        # host MIRRORS of the slot state (tiny [B] vectors). The truth
+        # lives on device in self._dev; these track it so admission
+        # and scheduler decisions (_next_chunk_len, free_slots,
+        # live_request_keys) never block on a device read. Mirrors are
+        # written by _admit/cancel (whose values are host-known) and
+        # refreshed from each dispatch's fetched outputs in _harvest.
         self.tok = np.full(n_slots, pad_id, np.int32)
         self.pos = np.zeros(n_slots, np.int32)
         self.limit = np.zeros(n_slots, np.int32)
         self.done = np.ones(n_slots, bool)   # all free initially
+        self.async_depth = async_depth
+        self._dev = self._device_state()
+        # the one dispatched-but-unharvested device step (async mode)
+        self._inflight: Optional[_Inflight] = None
+        # step-latency micro-stats (metrics.py exposition): host work,
+        # time blocked on the device, and how much device span the
+        # host work hid (the overlap the async mode exists to buy)
+        self._stat_host_ms = 0.0
+        self._stat_wait_ms = 0.0
+        self._stat_span_ms = 0.0
+        self._stat_overlap_ms = 0.0
+        self._stat_dispatches = 0
+        self._wait_this_step = 0.0
         self.slot_req: List[Optional[_Request]] = [None] * n_slots
         self._queue: deque = deque()
         # ledger: idx -> request, plus the order generate_all returns.
@@ -486,6 +598,18 @@ class ContinuousBatcher:
         self._admit_warm_fn = admit["warm"]
         self._admit_hit_fn = admit["hit"]
         self._publish_fn = admit["publish"]
+
+    def _device_state(self) -> Dict[str, Any]:
+        """Upload the host mirrors once; from here on the device
+        copies advance through the chunk/spec programs and the
+        scatter programs — never by per-dispatch re-upload."""
+        return {
+            "tok": jnp.asarray(self.tok),
+            "pos": jnp.asarray(self.pos),
+            "done": jnp.asarray(self.done),
+            "limit": jnp.asarray(self.limit),
+            "keys": jnp.asarray(self.slot_key),
+        }
 
     def _next_chunk_len(self) -> int:
         """Dispatch size: `chunk` steps, shortened only when EVERY
@@ -595,6 +719,17 @@ class ContinuousBatcher:
             req.prng_key = np.asarray(sub, np.uint32)
         self.slot_key[slot] = req.prng_key
         self.done[slot] = False
+        # mirror the admission onto the device copies as one scatter
+        # (a failover re-admission's journaled key rides in key_v —
+        # the resume re-key is this same program, not a re-upload)
+        d = self._dev
+        d["tok"], d["pos"], d["done"], d["limit"], d["keys"] = (
+            _state_admit_prog(
+                d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
+                slot, int(self.tok[slot]), p - 1,
+                int(self.limit[slot]), self.slot_key[slot],
+            )
+        )
         self.slot_req[slot] = req
         if self.spec is not None:
             self.spec.begin_slot(slot, req.prompt)
@@ -665,8 +800,14 @@ class ContinuousBatcher:
     # -- the loop ----------------------------------------------------------
 
     def has_work(self) -> bool:
-        """True while any slot is live or the queue holds requests."""
-        return bool(self._queue) or not self.done.all()
+        """True while any slot is live, the queue holds requests, or
+        a dispatch is still in flight (async mode: its events have
+        not surfaced yet, so one more step() is owed)."""
+        return (
+            bool(self._queue)
+            or not self.done.all()
+            or self._inflight is not None
+        )
 
     def queue_len(self) -> int:
         """Requests waiting for a slot (excludes live slots)."""
@@ -679,112 +820,185 @@ class ContinuousBatcher:
     def free_slots(self) -> int:
         return self.n_slots - self.active_count()
 
-    def step(self) -> List[StepEvent]:
-        """Admit from the queue into free slots, run ONE dispatch
-        (chunk scan, or a speculative verify when drafting is on and
-        some slot proposed), and return (idx, new_tokens, finished)
-        per request that progressed. Returns [] when there is no
-        work. The serving scheduler drives this directly to stream
-        tokens as they land; generate_all() is a drain loop over it."""
-        if self.chaos is not None:
-            # before any admission or dispatch: an injected fault
-            # leaves the queue, ledger and cache untouched, so the
-            # caller can snapshot + evacuate from consistent state
-            step_no = self._step_no
-            self._step_no += 1
-            self.chaos.on_engine_step(self.chaos_tag, step_no)
-        for slot in range(self.n_slots):
-            if self.done[slot] and self._queue:
-                self._admit(slot, self._queue.popleft())
-        if self.done.all():
-            return []
-        if self.spec is not None:
-            drafts, dlens = self._collect_drafts()
-            if int(dlens.max()) > 0:
-                return self._dispatch_spec(drafts, dlens)
-            # graceful degradation: every live slot's controller has
-            # drafting off (or nothing matched) — run the plain chunk
-            # scan at full speed; disabled slots re-probe on their
-            # controller's schedule
-        return self._dispatch_chunk()
+    def drain_inflight(self) -> None:
+        """Abandon any dispatched-but-unharvested step. Evacuation
+        calls this before snapshotting: the journal and request
+        outputs then reflect exactly the last HARVESTED dispatch (a
+        consistent pair), and failover replay regenerates whatever
+        the abandoned dispatch would have emitted, byte-identically,
+        from the journaled per-slot keys."""
+        self._inflight = None
 
-    def _dispatch_chunk(self) -> List[StepEvent]:
-        old_pos = self.pos.copy()
+    def step_stats(self) -> Dict[str, float]:
+        """Cumulative step-latency micro-stats for metrics exposition:
+        host_ms (host-side work inside step(), waits excluded),
+        device_wait_ms (time blocked on device results), dispatches,
+        and overlap_ratio = hidden device span / total device span —
+        ~0 in sync mode, approaching 1 when the host fully hides the
+        device under async dispatch."""
+        ratio = (
+            self._stat_overlap_ms / self._stat_span_ms
+            if self._stat_span_ms > 0
+            else 0.0
+        )
+        return {
+            "host_ms": self._stat_host_ms,
+            "device_wait_ms": self._stat_wait_ms,
+            "dispatches": float(self._stat_dispatches),
+            "overlap_ratio": ratio,
+        }
+
+    def step(self) -> List[StepEvent]:
+        """One engine iteration. Sync (`async_depth=0`): admit, run
+        ONE dispatch, harvest it, return its events — the legacy
+        contract. Async (`async_depth=1`): harvest the PREVIOUS
+        dispatch first (its host copies were started at enqueue, so
+        the wait is only whatever device time the host failed to
+        hide), admit/draft from that fully-refreshed state, enqueue
+        the next dispatch without blocking on it, and return the
+        harvested events — so the caller streams/journals dispatch
+        N-1 while the device computes dispatch N. Returns [] when
+        there is no work. Either way drafting and admission see the
+        same state sequence, so the dispatches (and the emitted token
+        streams) are byte-identical across depths; only WHEN events
+        surface shifts by one call."""
+        t0 = time.perf_counter()
+        self._wait_this_step = 0.0
+        try:
+            if self.chaos is not None:
+                # before any admission or dispatch: an injected fault
+                # leaves the queue, ledger and cache untouched, so the
+                # caller can snapshot + evacuate from consistent state
+                step_no = self._step_no
+                self._step_no += 1
+                self.chaos.on_engine_step(self.chaos_tag, step_no)
+            events = self._harvest()
+            for slot in range(self.n_slots):
+                if self.done[slot] and self._queue:
+                    self._admit(slot, self._queue.popleft())
+            if not self.done.all():
+                if self.spec is not None:
+                    drafts, dlens = self._collect_drafts()
+                    if int(dlens.max()) > 0:
+                        self._dispatch_spec(drafts, dlens)
+                    else:
+                        # graceful degradation: every live slot's
+                        # controller has drafting off (or nothing
+                        # matched) — plain chunk scan at full speed;
+                        # disabled slots re-probe on schedule
+                        self._dispatch_chunk()
+                else:
+                    self._dispatch_chunk()
+                if self.async_depth == 0:
+                    # events is always [] here: sync mode harvested
+                    # at the END of the previous step
+                    events = self._harvest()
+        except Exception:
+            # a raising step (injected fault or real failure) orphans
+            # any in-flight dispatch: its results must never surface
+            # later — the caller snapshots from the last HARVESTED
+            # state, and failover replay regenerates the lost tokens
+            self._inflight = None
+            raise
+        self._stat_host_ms += (
+            (time.perf_counter() - t0) * 1e3 - self._wait_this_step
+        )
+        return events
+
+    def _dispatch_chunk(self) -> None:
+        d = self._dev
+        k = self._next_chunk_len()
         cache, tok, pos, done, keys, emitted = self._run_chunk(
-            self.cache,
-            self.params,
-            jnp.asarray(self.tok),
-            jnp.asarray(self.pos),
-            jnp.asarray(self.done),
-            jnp.asarray(self.limit),
-            jnp.asarray(self.slot_key),
-            self._next_chunk_len(),
+            self.cache, self.params,
+            d["tok"], d["pos"], d["done"], d["limit"], d["keys"], k,
         )
         self.cache = cache
-        # np.array (copy): np.asarray of a jax array is a
-        # read-only view, and _admit writes these in place
-        self.slot_key = np.array(keys)
-        self.tok = np.array(tok)
-        self.pos = np.array(pos)
-        # live steps form a prefix of the chunk (done is sticky),
-        # and pos advanced once per live step — the first
+        d.update(tok=tok, pos=pos, done=done, keys=keys)
+        # live steps form a prefix of the chunk (done is sticky), and
+        # pos advances once per live step — at harvest the first
         # (new_pos - old_pos) emitted entries are exactly the real
         # tokens, whatever their values
-        return self._emit_events(
-            np.asarray(emitted), self.pos - old_pos, np.array(done)
+        self._enqueue_fetch(
+            _Inflight(
+                kind="chunk",
+                arrays=(tok, pos, done, keys, emitted),
+                dispatched_at=0.0,
+                old_pos=self.pos.copy(),
+            )
         )
 
     def _collect_drafts(self):
-        """Host drafting pass: one controller-clamped n-gram proposal
-        per live slot. Padded entries hold token 0 (a valid embedding
-        row — their logits and K/V are dead by draft_len/position
-        masks, but a pad_id of -1 must never reach the gather)."""
-        k = self.spec_draft_len
-        drafts = np.zeros((self.n_slots, k), np.int32)
-        dlens = np.zeros(self.n_slots, np.int32)
-        for slot in range(self.n_slots):
-            if self.done[slot]:
-                continue
-            prop = self.spec.draft(slot)
-            if prop.size:
-                drafts[slot, : prop.size] = prop
-                dlens[slot] = prop.size
-        return drafts, dlens
+        """Host drafting pass, batched in speculative.py: the per-slot
+        proposal loop runs only over live slots and the padded [B, K]
+        assembly is vectorized (draft_batch), so the step hot path no
+        longer pays an O(n_slots) Python loop per dispatch."""
+        return self.spec.draft_batch(self.done)
 
     def _dispatch_spec(
         self, drafts: np.ndarray, dlens: np.ndarray
-    ) -> List[StepEvent]:
-        was_live = ~self.done
+    ) -> None:
+        d = self._dev
         (
             cache, tok, pos, done, keys, emitted, n_emit, accepted
         ) = self._run_spec(
-            self.cache,
-            self.params,
-            jnp.asarray(self.tok),
-            jnp.asarray(self.pos),
-            jnp.asarray(self.done),
-            jnp.asarray(self.limit),
-            jnp.asarray(self.slot_key),
-            jnp.asarray(drafts),
-            jnp.asarray(dlens),
+            self.cache, self.params,
+            d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
+            jnp.asarray(drafts), jnp.asarray(dlens),
         )
         self.cache = cache
-        self.slot_key = np.array(keys)
-        self.tok = np.array(tok)
-        self.pos = np.array(pos)
-        n_emit = np.asarray(n_emit)
-        accepted = np.asarray(accepted)
-        for slot in range(self.n_slots):
-            if was_live[slot]:
-                self.spec.record(
-                    slot,
-                    int(dlens[slot]),
-                    int(accepted[slot]),
-                    int(n_emit[slot]),
-                )
-        return self._emit_events(
-            np.asarray(emitted), n_emit, np.array(done)
+        d.update(tok=tok, pos=pos, done=done, keys=keys)
+        self._enqueue_fetch(
+            _Inflight(
+                kind="spec",
+                arrays=(
+                    tok, pos, done, keys, emitted, n_emit, accepted
+                ),
+                dispatched_at=0.0,
+                dlens=dlens,
+                was_live=~self.done,
+            )
         )
+
+    def _enqueue_fetch(self, pend: _Inflight) -> None:
+        _start_host_copy(pend.arrays)
+        pend.dispatched_at = time.perf_counter()
+        self._inflight = pend
+
+    def _harvest(self) -> List[StepEvent]:
+        """Complete the in-flight dispatch's host copies, refresh the
+        mirrors, and turn its outputs into events. [] when nothing is
+        in flight. The wait measured here is the step BUBBLE: device
+        time the host had nothing to overlap with."""
+        pend = self._inflight
+        self._inflight = None
+        if pend is None:
+            return []
+        w0 = time.perf_counter()
+        host = _to_host(*pend.arrays)
+        w1 = time.perf_counter()
+        wait_ms = (w1 - w0) * 1e3
+        span_ms = (w1 - pend.dispatched_at) * 1e3
+        self._wait_this_step += wait_ms
+        self._stat_wait_ms += wait_ms
+        self._stat_span_ms += span_ms
+        self._stat_overlap_ms += max(span_ms - wait_ms, 0.0)
+        self._stat_dispatches += 1
+        if pend.kind == "chunk":
+            tok, pos, done, keys, emitted = host
+            counts = pos - pend.old_pos
+        else:
+            tok, pos, done, keys, emitted, n_emit, accepted = host
+            counts = n_emit
+            for slot in range(self.n_slots):
+                if pend.was_live[slot]:
+                    self.spec.record(
+                        slot,
+                        int(pend.dlens[slot]),
+                        int(accepted[slot]),
+                        int(n_emit[slot]),
+                    )
+        self.tok, self.pos, self.slot_key = tok, pos, keys
+        return self._emit_events(emitted, counts, done)
 
     def _emit_events(
         self, emitted: np.ndarray, counts: np.ndarray,
@@ -813,6 +1027,14 @@ class ContinuousBatcher:
             if new_toks or finished:
                 events.append((req.idx, new_toks, finished))
         self.done = new_done
+        # a cancel that landed while this dispatch was in flight set
+        # the mirror before the dispatch's (older) done could overwrite
+        # it — re-assert it, or the freed slot would resurrect (the
+        # device copy already carries the cancel: its scatter chained
+        # onto this dispatch's output)
+        for slot in range(self.n_slots):
+            if self.slot_req[slot] is None:
+                self.done[slot] = True
         return events
 
     def retire(self, idx: int) -> np.ndarray:
@@ -842,6 +1064,13 @@ class ContinuousBatcher:
         for slot in range(self.n_slots):
             if self.slot_req[slot] is req:
                 self.done[slot] = True
+                # one scatter onto the CURRENT device done — if a
+                # dispatch is in flight this chains after it, so the
+                # slot is freed on device no later than the harvest
+                # that frees it on host
+                self._dev["done"] = _state_cancel_prog(
+                    self._dev["done"], slot
+                )
                 self.slot_req[slot] = None
                 if self.prefix_cache is not None:
                     self._release_slot_row(slot)
@@ -878,6 +1107,11 @@ class ContinuousBatcher:
         self.limit[:] = 0
         self.done[:] = True
         self.slot_key[:] = 0
+        # fresh device copies too — the crash may have struck with a
+        # dispatch in flight; its outputs (and the in-flight record)
+        # must never leak into the restarted engine
+        self._dev = self._device_state()
+        self._inflight = None
         self.slot_req = [None] * self.n_slots
         self._slot_row = [None] * self.n_slots
         self._queue.clear()

@@ -136,6 +136,15 @@ class RequestJournal:
     (the stream ledger); what the journal adds is the per-slot PRNG
     key captured after every pump, so a sampled request resumed on
     another replica draws the exact noise an uncrashed run would.
+
+    Async dispatch (engine `async_depth=1`) changes nothing here by
+    construction: keys are journaled from the engine's host mirrors,
+    which only ever advance at harvest time — the same moment
+    req.tokens grows — so (tokens, key) always describe the same
+    last-harvested dispatch. A crash with a dispatch still in flight
+    abandons that dispatch (the scheduler drains it before
+    snapshotting); replay regenerates its tokens byte-identically
+    from the journaled key.
     """
 
     def __init__(self):

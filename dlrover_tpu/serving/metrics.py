@@ -84,6 +84,14 @@ class ServingMetrics:
         self._spec_accepted = 0
         self._spec_rounds = 0
         self._spec_emitted = 0
+        # step-latency micro-stats: copied from the engine's
+        # step_stats() each pump. host/wait are cumulative ms
+        # counters; overlap_ratio is a gauge (hidden device span /
+        # total device span — ~0 sync, toward 1 under async dispatch)
+        self._step_host_ms = 0.0
+        self._step_device_wait_ms = 0.0
+        self._step_dispatches = 0
+        self._step_overlap_ratio = 0.0
 
     # ---- ingestion -------------------------------------------------------
 
@@ -175,6 +183,25 @@ class ServingMetrics:
             self._spec_accepted = max(self._spec_accepted, accepted)
             self._spec_rounds = max(self._spec_rounds, rounds)
             self._spec_emitted = max(self._spec_emitted, emitted)
+
+    def update_step_timing(
+        self, host_ms: float, device_wait_ms: float,
+        dispatches: int, overlap_ratio: float,
+    ):
+        """Refresh step-latency stats from the engine's step_stats().
+        The time totals and dispatch count get the same max() monotonic
+        guard as the blocks above; overlap_ratio is a gauge and is set
+        directly (it legitimately moves both ways as traffic shifts
+        between sync-like and fully-hidden regimes)."""
+        with self._lock:
+            self._step_host_ms = max(self._step_host_ms, host_ms)
+            self._step_device_wait_ms = max(
+                self._step_device_wait_ms, device_wait_ms
+            )
+            self._step_dispatches = max(
+                self._step_dispatches, int(dispatches)
+            )
+            self._step_overlap_ratio = overlap_ratio
 
     # ---- queries ---------------------------------------------------------
 
@@ -271,6 +298,26 @@ class ServingMetrics:
             if not self._spec_rounds:
                 return 0.0
             return self._spec_emitted / self._spec_rounds
+
+    @property
+    def step_host_ms(self) -> float:
+        with self._lock:
+            return self._step_host_ms
+
+    @property
+    def step_device_wait_ms(self) -> float:
+        with self._lock:
+            return self._step_device_wait_ms
+
+    @property
+    def step_dispatches(self) -> int:
+        with self._lock:
+            return self._step_dispatches
+
+    @property
+    def step_overlap_ratio(self) -> float:
+        with self._lock:
+            return self._step_overlap_ratio
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -434,6 +481,29 @@ class ServingMetrics:
                 "(>1 means speculation is winning).",
                 (self._spec_emitted / self._spec_rounds)
                 if self._spec_rounds else 0.0,
+            )
+            counter(
+                "serving_step_host_ms_total",
+                "Host-side time inside engine step() (drafting, "
+                "admission, event emission), ms, waits excluded.",
+                f"{self._step_host_ms:.6g}",
+            )
+            counter(
+                "serving_step_device_wait_ms_total",
+                "Time the host spent blocked on device results "
+                "(the step bubble), ms.",
+                f"{self._step_device_wait_ms:.6g}",
+            )
+            counter(
+                "serving_dispatches_total",
+                "Device dispatches harvested.",
+                self._step_dispatches,
+            )
+            gauge(
+                "serving_step_overlap_ratio",
+                "Fraction of device span hidden behind host work "
+                "(~0 synchronous, toward 1 under async dispatch).",
+                self._step_overlap_ratio,
             )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()

@@ -386,6 +386,13 @@ class RequestScheduler:
                     spec.proposed, spec.accepted,
                     spec.rounds, spec.emitted,
                 )
+            step_stats = getattr(self.engine, "step_stats", None)
+            if step_stats is not None:
+                st = step_stats()
+                self.metrics.update_step_timing(
+                    st["host_ms"], st["device_wait_ms"],
+                    int(st["dispatches"]), st["overlap_ratio"],
+                )
             return bool(self._waiting) or bool(self._running)
 
     # ---- failover --------------------------------------------------------
@@ -396,6 +403,14 @@ class RequestScheduler:
         engine's device state is not trusted after this — restart()
         rebuilds it."""
         self.crashed = True
+        # abandon any async-dispatched-but-unharvested step FIRST:
+        # journal and req.tokens then describe the same (last
+        # harvested) dispatch, and replay regenerates the rest.
+        # step() already drops its own in-flight record when it
+        # raises; this guards the paths that crash between steps.
+        drain = getattr(self.engine, "drain_inflight", None)
+        if drain is not None:
+            drain()
         tickets = []
         for req in self._running.values():
             tickets.append(self.journal.snapshot(req))

@@ -241,7 +241,21 @@ class SpeculativeDecoder:
     RadixPrefixCache's): `rounds` counts live SLOT-rounds, so
     `tokens_per_step` = emitted/rounds is per-slot tokens per verify
     dispatch — >1.0 means speculation is beating one-token-per-step
-    decoding."""
+    decoding.
+
+    Staleness contract under async dispatch (engine `async_depth=1`):
+    the engine harvests dispatch N-1 — including the `extend`/`record`
+    calls for its emitted tokens — BEFORE drafting for dispatch N, so
+    the drafter's context for any dispatch is exactly the full token
+    history through the previous one. That is the same context the
+    synchronous path sees: drafts, controller decisions, and therefore
+    acceptance counters are byte-identical across depths (pinned by
+    tests/test_serving_speculative.py). What shifts is only WHEN the
+    host learns an outcome — one step() call later — never what the
+    drafter conditions on. Verification makes output correctness
+    independent of draft quality regardless, but this contract is what
+    keeps the STATS (and the controller's adaptive k trajectory)
+    deterministic too."""
 
     def __init__(
         self,
@@ -273,6 +287,35 @@ class SpeculativeDecoder:
         if k <= 0:
             return np.empty(0, np.int32)
         return self.drafter.propose(slot, k)
+
+    def draft_batch(self, done_mask: np.ndarray):
+        """Drafts for every live slot as one padded [n_slots, k]
+        batch (the engine's pre-dispatch pass). Proposal itself is
+        per-slot (each drafter index is independent), but the padded
+        assembly is vectorized so the engine's hot path does no
+        per-slot Python bookkeeping. Padded entries hold token 0 — a
+        valid embedding row; their logits and K/V are dead by the
+        draft_len/position masks, but a pad_id of -1 must never reach
+        the gather."""
+        n_slots = len(self.controller._slots)
+        k = self.draft_len
+        drafts = np.zeros((n_slots, k), np.int32)
+        dlens = np.zeros(n_slots, np.int32)
+        live = np.flatnonzero(~np.asarray(done_mask))
+        props = [self.draft(int(s)) for s in live]
+        if props:
+            lens = np.fromiter(
+                (p.size for p in props), np.int32, len(props)
+            )
+            dlens[live] = lens
+            if int(lens.max()) > 0:
+                fill = np.arange(k)[None, :] < lens[:, None]
+                buf = np.zeros((len(live), k), np.int32)
+                buf[fill] = np.concatenate(
+                    [p for p in props if p.size]
+                )
+                drafts[live] = buf
+        return drafts, dlens
 
     def extend(self, slot: int, tokens: Sequence[int]) -> None:
         self.drafter.extend(slot, tokens)

@@ -143,6 +143,12 @@ def test_serve_bench_smoke_emits_driver_contract():
         "spec_tokens_per_step",
         "spec_draft_len",
         "n_spec_requests",
+        # overlap phase: the async-dispatch evidence axes
+        "sync_tpot_ms_p50",
+        "async_tpot_ms_p50",
+        "async_overlap_ratio",
+        "async_parity_ok",
+        "chaos_async_depth",
         # chaos phase: the crash-safety evidence axes
         "chaos_success_rate",
         "chaos_parity_ok",
@@ -172,6 +178,16 @@ def test_serve_bench_smoke_emits_driver_contract():
         < detail["spec_baseline_tpot_ms_p50"]
     )
     assert detail["n_spec_requests"] > 0
+    # the async-dispatch acceptance floor: pipelining one deep must
+    # buy real per-token latency (host work hides behind the device),
+    # actually hide a nonzero fraction of the device span, and NEVER
+    # change a single emitted byte on any engine variant
+    assert (
+        detail["async_tpot_ms_p50"] < detail["sync_tpot_ms_p50"]
+    )
+    assert detail["async_overlap_ratio"] > 0.0
+    assert detail["async_parity_ok"] is True
+    assert detail["chaos_async_depth"] == 1
     # the crash-safety acceptance floor: a replica killed mid-decode
     # loses ZERO admitted requests, resumed greedy streams are
     # byte-identical to the steady run, and failover's latency cost is

@@ -1,10 +1,16 @@
-"""Layering lint: dlrover_tpu/serving/ must not import dlrover_tpu.rl.
+"""Layering lints, enforced by AST walk instead of review comments.
 
-DEVIATIONS §5 makes the dependency one-way — rl/serve.py imports the
-serving engine, never the reverse — so the serving stack stays usable
-without the RL stack. Until now that rule was enforced only by
-convention; this AST walk makes a violation a test failure with a
-file:line pointer instead of a review comment."""
+1. dlrover_tpu/serving/ must not import dlrover_tpu.rl. DEVIATIONS §5
+   makes the dependency one-way — rl/serve.py imports the serving
+   engine, never the reverse — so the serving stack stays usable
+   without the RL stack.
+2. serving/engine.py must not materialize device arrays outside the
+   ONE designated fetch helper (`_to_host`) and the functions that
+   legitimately touch host data (admission, retire, reset, drain).
+   The async dispatch design (DEVIATIONS §9) depends on the step hot
+   path never issuing a fresh blocking device->host copy — a stray
+   np.array(<jax array>) would silently serialize host and device
+   again, and nothing but this lint would notice."""
 
 import ast
 import pathlib
@@ -53,4 +59,77 @@ def test_serving_never_imports_rl():
     assert not offenders, (
         "serving/ must not depend on rl/ (DEVIATIONS §5):\n"
         + "\n".join(offenders)
+    )
+
+
+# functions in engine.py allowed to materialize host arrays: the ONE
+# designated device fetch point, plus the host-data paths (prompt
+# normalization at submit, PRNG-key capture at admit, output-list
+# conversion at retire/drain) that never touch a dispatch result
+_HOST_COPY_ALLOWED = {
+    "_to_host",
+    "submit",
+    "_admit",
+    "retire",
+    "generate_all",
+}
+
+# calls that synchronously materialize a device array on host
+_HOST_COPY_CALLS = {
+    ("np", "array"),
+    ("np", "asarray"),
+    ("np", "copy"),
+    ("numpy", "array"),
+    ("numpy", "asarray"),
+    ("numpy", "copy"),
+    ("jax", "device_get"),
+}
+
+
+def _host_copy_calls(tree):
+    """(lineno, call, enclosing-function-name) for every potentially
+    blocking host materialization; enclosing name is None at module
+    scope."""
+    out = []
+
+    def visit(node, owner):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            owner = node.name
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and (f.value.id, f.attr) in _HOST_COPY_CALLS
+            ):
+                out.append(
+                    (node.lineno, f"{f.value.id}.{f.attr}", owner)
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, owner)
+
+    visit(tree, None)
+    return out
+
+
+def test_engine_host_copies_only_in_designated_fetch_helper():
+    path = SERVING_DIR / "engine.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    offenders = [
+        f"{path}:{lineno}: {call} in {owner or '<module>'}()"
+        for lineno, call, owner in _host_copy_calls(tree)
+        if owner not in _HOST_COPY_ALLOWED
+    ]
+    assert not offenders, (
+        "engine.py must fetch device arrays only through _to_host "
+        "(async dispatch contract, DEVIATIONS §9) — a blocking "
+        "np.array/np.asarray/jax.device_get on the step path "
+        "re-serializes host and device:\n" + "\n".join(offenders)
+    )
+    # the lint must actually see the designated helper — if _to_host
+    # is renamed this test should fail loudly, not pass vacuously
+    assert any(
+        owner == "_to_host" for _, _, owner in _host_copy_calls(tree)
     )

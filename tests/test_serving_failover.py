@@ -351,16 +351,29 @@ class TestFailoverParity:
             {"kv_quant": True},
             {"prefix_cache_rows": 4},
             {"spec_draft_len": 4},
+            {"async_depth": 1},
+            {"async_depth": 1, "kv_quant": True},
+            {"async_depth": 1, "prefix_cache_rows": 4},
+            {"async_depth": 1, "spec_draft_len": 4},
         ],
-        ids=["plain", "int8", "prefix", "spec"],
+        ids=[
+            "plain", "int8", "prefix", "spec",
+            "async", "async-int8", "async-prefix", "async-spec",
+        ],
     )
     def test_greedy_parity_sweep(self, model, fuzz_seed, engine_kw):
         """Deep sweep: fuzzed crash steps x engine variants (int8 KV,
-        prefix-warm resume, speculative decoding) — replay-resume must
-        be byte-exact under every KV/decode discipline."""
+        prefix-warm resume, speculative decoding, async dispatch) —
+        replay-resume must be byte-exact under every KV/decode
+        discipline. The reference always runs SYNCHRONOUS
+        (async_depth stripped): the sync path is the parity oracle
+        the pipelined path must reproduce, crashes and all."""
         cfg, params = model
         prompts = _prompts((5, 9, 3, 7), seed=fuzz_seed)
-        want = _reference(cfg, params, prompts, engine_kw)
+        ref_kw = {
+            k: v for k, v in engine_kw.items() if k != "async_depth"
+        }
+        want = _reference(cfg, params, prompts, ref_kw)
         reqs, metrics, _ = self._crash_run(
             cfg, params, prompts, fuzz_seed, engine_kw
         )
@@ -368,6 +381,25 @@ class TestFailoverParity:
             assert r.state is RequestState.DONE
             assert r.tokens == want[tuple(p)]
         assert metrics.failed_total == 0
+
+    def test_async_crash_parity_vs_sync_reference(self, model):
+        """Cheap always-on cousin of the sweep: a replica running
+        async_depth=1 killed mid-decode (possibly with a dispatch in
+        flight — it is abandoned, journal stays at last harvest) must
+        still complete every request byte-identical to an uncrashed
+        SYNCHRONOUS run."""
+        cfg, params = model
+        prompts = _prompts((5, 9, 3, 7), seed=2)
+        want = _reference(cfg, params, prompts)
+        reqs, metrics, _ = self._crash_run(
+            cfg, params, prompts, fuzz_seed=0,
+            engine_kw={"async_depth": 1},
+        )
+        for p, r in zip(prompts, reqs):
+            assert r.state is RequestState.DONE
+            assert r.tokens == want[tuple(p)]
+        assert metrics.failed_total == 0
+        assert metrics.failovers_total >= 1
 
     def test_sampled_resume_continues_journaled_key(self, model):
         """Sampled crash resume: the journaled per-slot PRNG key moves
@@ -472,6 +504,92 @@ class TestFailoverParity:
 
 # ---------------------------------------------------------------------------
 # breaker-driven probation: ejection -> backoff -> restart -> re-admit
+
+
+class TestAsyncParity:
+    """async_depth=1 must be an invisible optimization: the same
+    interleaving of submit/cancel/step against depth 0 and depth 1
+    engines yields byte-identical streams for every surviving
+    request. Cancelled requests are excluded from the byte compare —
+    a cancel landing between a dispatch and its harvest legitimately
+    truncates the stream one dispatch earlier than the sync engine
+    would (the tokens existed on device but were never surfaced) —
+    but their side effects (freed slot, admission order) must still
+    leave every OTHER stream untouched."""
+
+    def _interleaved(self, cfg, params, depth, seed, engine_kw=None):
+        rng = np.random.default_rng(seed)
+        eng = _engine(
+            cfg, params, n_slots=2, async_depth=depth,
+            **(engine_kw or {}),
+        )
+        prompts = _prompts((5, 9, 3, 7, 4, 6, 8, 5), seed=seed)
+        emitted = {}
+        submitted = []
+        cancelled = set()
+        pi = 0
+        # the op sequence depends only on (rng, host-deterministic
+        # bookkeeping), never on step() results — so both depths
+        # replay the exact same interleaving
+        for _ in range(120):
+            r = rng.random()
+            if r < 0.35 and pi < len(prompts):
+                idx = eng.submit(prompts[pi])
+                submitted.append(idx)
+                emitted[idx] = []
+                pi += 1
+            elif r < 0.5 and submitted:
+                victim = submitted[
+                    int(rng.integers(len(submitted)))
+                ]
+                if victim not in cancelled:
+                    eng.cancel(victim)
+                    cancelled.add(victim)
+            else:
+                for idx, toks, _fin in eng.step():
+                    emitted[idx].extend(toks)
+        while eng.has_work():
+            for idx, toks, _fin in eng.step():
+                emitted[idx].extend(toks)
+        survivors = {
+            i: t for i, t in emitted.items() if i not in cancelled
+        }
+        return survivors, cancelled
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+    def test_fuzzed_submit_cancel_interleaving_parity(
+        self, model, seed
+    ):
+        cfg, params = model
+        sync, sync_cancelled = self._interleaved(
+            cfg, params, 0, seed
+        )
+        async_, async_cancelled = self._interleaved(
+            cfg, params, 1, seed
+        )
+        assert async_cancelled == sync_cancelled
+        assert async_.keys() == sync.keys()
+        for idx in sync:
+            assert async_[idx] == sync[idx], (
+                f"seed={seed} request {idx} diverged across depths"
+            )
+
+    @pytest.mark.parametrize(
+        "engine_kw",
+        [{"spec_draft_len": 4}, {"prefix_cache_rows": 4}],
+        ids=["spec", "prefix"],
+    )
+    def test_fuzzed_interleaving_parity_variants(
+        self, model, engine_kw
+    ):
+        cfg, params = model
+        sync, _ = self._interleaved(
+            cfg, params, 0, 7, engine_kw
+        )
+        async_, _ = self._interleaved(
+            cfg, params, 1, 7, engine_kw
+        )
+        assert async_ == sync
 
 
 class TestProbationCycle:

@@ -500,6 +500,55 @@ class TestMetricsEndpoint:
             gw.stop()
             sched.stop()
 
+    def test_step_timing_exposition(self, model):
+        """The dispatch micro-metrics reach /metrics: host vs device
+        time per step, the dispatch counter, and the overlap-ratio
+        gauge the async mode exists to move."""
+        cfg, params = model
+        eng = ContinuousBatcher(
+            cfg, params, n_slots=2, max_len=64, max_new_tokens=8,
+            chunk=4, pad_id=-1, async_depth=1,
+        )
+        metrics = ServingMetrics()
+        sched = RequestScheduler(eng, SloConfig(), metrics=metrics)
+        sched.start()
+        gw = ServingGateway(sched, metrics=metrics)
+        gw.start()
+        try:
+            toks, trailer = _post_stream(
+                gw.port, _prompts((6,), seed=4)[0], max_new=4
+            )
+            assert trailer["state"] == "done"
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=30
+            )
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+            conn.close()
+            for needle in (
+                "# TYPE serving_step_host_ms_total counter",
+                "# TYPE serving_step_device_wait_ms_total counter",
+                "# TYPE serving_dispatches_total counter",
+                "# TYPE serving_step_overlap_ratio gauge",
+            ):
+                assert needle in text, text
+            vals = {
+                ln.split()[0]: float(ln.split()[1])
+                for ln in text.splitlines()
+                if ln and not ln.startswith("#")
+                and ln.split()[0].startswith("serving_")
+            }
+            # one request of 4 tokens at chunk=4 is at least one real
+            # dispatch, and its host-side step work takes nonzero time
+            assert vals["serving_dispatches_total"] >= 1
+            assert vals["serving_step_host_ms_total"] > 0.0
+            assert vals["serving_step_device_wait_ms_total"] >= 0.0
+            assert 0.0 <= vals["serving_step_overlap_ratio"] <= 1.0
+            assert metrics.step_dispatches >= 1
+        finally:
+            gw.stop()
+            sched.stop()
+
 
 @pytest.mark.slow
 class TestGatewaySoak:
