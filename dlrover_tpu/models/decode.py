@@ -603,6 +603,325 @@ def pool_put_row(
     return out
 
 
+# ---------------------------------------------------------------------------
+# paged KV primitives (serving/engine.py's kv_layout="paged")
+#
+# The paged layout replaces the dense per-slot bank [L, B, M, KV, hd]
+# with a global page POOL [L, n_pages, page_size, KV, hd] plus a
+# per-slot page TABLE [B, P] of physical page ids (P = M / page_size;
+# logical cell m of slot b lives at pool[:, table[b, m // ps], m % ps]).
+# Slots no longer own M cells each — they own only the pages their
+# request actually touches, and radix prefix hits SHARE pages by
+# pointing two tables at the same physical ids (ref-counted host-side
+# by serving/paged_kv.PageAllocator; copy-on-write when a shared page
+# is appended into).
+#
+# Byte parity with the dense bank is the design invariant: the paged
+# forward gathers each layer's pages into the dense [B, M, KV, hd]
+# view and runs the IDENTICAL `_cached_attention` — same einsums, same
+# mask, same softmax — so `kv_layout="paged"` produces bit-identical
+# tokens to `kv_layout="dense"`. Cells a table maps to the trash page
+# (or stale pages) surface garbage the position mask zeroes exactly.
+# On a real TPU the S==1 decode step swaps the gathered view for the
+# Pallas paged-attention kernel (ops/paged_attention.py) that streams
+# physical pages without materializing the view.
+# ---------------------------------------------------------------------------
+
+
+def init_page_pool(
+    cfg, n_pages: int, page_size: int, quant: bool = False
+) -> Dict[str, jax.Array]:
+    """The global page pool: [L, n_pages, page_size, KV, hd] (+ per
+    [page, cell, head] bf16 scales when quant — the same per-vector
+    int8 scheme as init_kv_cache, so quantized bytes match the dense
+    bank's for the same values). Page id 0 is the TRASH page by
+    engine convention: retired/done slots' table rows point there so
+    frozen rewrites land somewhere no live table reads."""
+    kv_heads = getattr(cfg, "n_kv_heads", cfg.n_heads)
+    shape = (cfg.n_layers, n_pages, page_size, kv_heads, cfg.head_dim)
+    if not quant:
+        return {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+        }
+    scale_shape = shape[:-1] + (1,)
+    return {
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.zeros(scale_shape, jnp.bfloat16),
+        "v_scale": jnp.zeros(scale_shape, jnp.bfloat16),
+    }
+
+
+def _paged_view(
+    layer_pool: Dict[str, jax.Array], table: jax.Array
+) -> Dict[str, jax.Array]:
+    """Gather one layer's pages into the dense [B, M, KV, ...] view
+    (M = P * page_size) — the shape `_cached_attention` attends over.
+    A pure gather; whatever dead pages hold is masked exactly."""
+    out = {}
+    for name, arr in layer_pool.items():
+        g = arr[table]  # [B, P, page_size, KV, ...]
+        out[name] = g.reshape((g.shape[0], -1) + g.shape[3:])
+    return out
+
+
+def _write_pages_and_attend(
+    q, k, v, layer_pool, table, positions, head_dim
+):
+    """The paged counterpart of `_write_cache_and_attend`: scatter
+    this chunk's K/V into the slot's PAGES (row b, chunk position s →
+    pool[table[b, pos//ps], pos%ps]) and attend over the gathered
+    dense view with the identical position-masked attention.
+
+    Within a chunk a row's positions are distinct, and across rows
+    live tables never share a writable page (the allocator CoWs
+    shared pages before handing them to a writer) — the only scatter
+    collisions are done/retired rows parked on the trash page, whose
+    cells no live mask ever admits. Quantized pools quantize the
+    chunk with the same `_kv_quantize` as the dense write path, so
+    the stored bytes are identical either way."""
+    ps = layer_pool["k"].shape[1]
+    pids = jnp.take_along_axis(table, positions // ps, axis=1)
+    offs = positions % ps
+    out_pool = dict(layer_pool)
+    if "k_scale" in layer_pool:
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+        writes = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+    else:
+        writes = {"k": k, "v": v}
+    for name, upd in writes.items():
+        arr = layer_pool[name]
+        out_pool[name] = arr.at[pids, offs].set(upd.astype(arr.dtype))
+    s = q.shape[1]
+    if s == 1:
+        from dlrover_tpu.ops import paged_attention as pa
+
+        q1 = q[:, 0]
+        if pa.use_kernel(q1, out_pool, table):
+            lengths = positions[:, 0] + 1
+            attn = pa.paged_attention(
+                q1, out_pool, table, lengths,
+                scale=float(head_dim) ** -0.5, impl="kernel",
+            )
+            return attn[:, None], out_pool
+    view = _paged_view(out_pool, table)
+    attn = _cached_attention(
+        q, view, positions, float(head_dim) ** -0.5
+    )
+    return attn, out_pool
+
+
+def _block_paged(
+    cfg, x, layer_params, layer_pool, table, positions
+):
+    """Llama block over paged KV — identical projections/residuals to
+    `_block`; only the cache write + view differ."""
+    lp = _compute_weights(cfg, layer_params)
+    h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
+    q, k, v = _attn_qkv(cfg, None, h, lp, positions)
+    attn, layer_pool = _write_pages_and_attend(
+        q, k, v, layer_pool, table, positions, cfg.head_dim
+    )
+    x = _attn_residual(cfg, None, x, attn, lp)
+    x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
+    return x, layer_pool
+
+
+def _block_gpt_paged(cfg, x, lp, layer_pool, table, positions):
+    from dlrover_tpu.models import gpt
+
+    q, k, v = gpt._attn_qkv(cfg, x, lp)
+    attn, layer_pool = _write_pages_and_attend(
+        q, k, v, layer_pool, table, positions, cfg.head_dim
+    )
+    x = gpt._attn_residual(cfg, x, attn, lp)
+    x = gpt._mlp_residual(cfg, x, lp)
+    return x, layer_pool
+
+
+def _forward_paged(cfg, params, tokens, pool, table, positions):
+    """tokens [B, S] → logits [B, S, V] over the paged pool; the
+    layer scan mirrors `_forward_cached` (the pool pytree scans over
+    its leading layer axis; the table is shared by every layer)."""
+    gpt = _is_gpt(cfg)
+    if gpt:
+        x = (
+            params["wte"].astype(cfg.dtype)[tokens]
+            + params["wpe"].astype(cfg.dtype)[positions]
+        )
+        block = _block_gpt_paged
+    else:
+        x = params["embed"]["weight"].astype(cfg.dtype)[tokens]
+        block = _block_paged
+
+    def body(carry, inp):
+        h = carry
+        layer_params, layer_pool = inp
+        h, layer_pool = block(
+            cfg, h, layer_params, layer_pool, table, positions
+        )
+        return h, layer_pool
+
+    x, pool_new = jax.lax.scan(
+        body, x, (params["layers"], dict(pool))
+    )
+    if gpt:
+        from dlrover_tpu.models.gpt import _layer_norm
+
+        x = _layer_norm(
+            x, params["lnf_g"], params["lnf_b"], cfg.norm_eps
+        )
+        head = params["wte"].astype(cfg.dtype).T
+    else:
+        x = _rms_norm(
+            x, params["final_norm"]["scale"], cfg.norm_eps
+        )
+        head = _head_matrix(cfg, params)
+    logits = (x @ head).astype(jnp.float32)
+    return logits, pool_new
+
+
+def paged_decode_step(
+    cfg, params, token: jax.Array, pool, table, pos
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One cached step over paged KV → (logits [B, V], pool). The
+    paged twin of `decode_step` ([B] per-slot positions only — the
+    paged layout exists for continuous batching)."""
+    pos = jnp.asarray(pos, jnp.int32)
+    positions = pos[:, None]
+    logits, pool = _forward_paged(
+        cfg, params, token[:, None], pool, table, positions
+    )
+    return logits[:, 0], pool
+
+
+def paged_verify_step(
+    cfg, params, tokens: jax.Array, pool, table, pos
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Batched speculative verify over paged KV — the paged twin of
+    `verify_step`. The engine sizes each request's page run for
+    limit - 1 + draft_len cells so the clamped write window lands in
+    owned (or trash) pages, never a neighbour's."""
+    b, s = tokens.shape
+    pos = jnp.asarray(pos, jnp.int32)
+    positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    logits, pool = _forward_paged(
+        cfg, params, tokens, pool, table, positions
+    )
+    return logits, pool
+
+
+def gather_pool_view(
+    pool: Dict[str, jax.Array], table: jax.Array
+) -> Dict[str, jax.Array]:
+    """Gather EVERY layer's pages into the dense bank layout
+    [L, B, M, ...] (M = P * page_size) — the exact pytree
+    `decode_step`/`verify_step` consume. One materialized copy per
+    call; the chunk program amortizes it over a whole scan (a
+    per-step gather would copy the full cache once PER TOKEN, the
+    dominant paged overhead on backends without the Pallas kernel)."""
+    out = {}
+    for name, arr in pool.items():
+        g = arr[:, table]  # [L, B, P, page_size, ...]
+        out[name] = g.reshape(g.shape[:2] + (-1,) + g.shape[4:])
+    return out
+
+
+def scatter_pool_window(
+    pool: Dict[str, jax.Array],
+    view: Dict[str, jax.Array],
+    table: jax.Array,
+    start,          # [B] first logical cell each row may have written
+    width: int,     # STATIC window width (chunk k, or draft K+1)
+) -> Dict[str, jax.Array]:
+    """Write the view's cells at logical positions start_b+[0, width)
+    back into their physical pages — the inverse of
+    `gather_pool_view`, restricted to the only window a dispatch can
+    touch (a chunk scan writes at most `k` cells past each row's
+    entry position; a verify writes K+1). Unwritten window cells
+    carry their own gathered values, so scattering them is the
+    identity; rows parked on the trash page collide there with other
+    parked rows, which no live mask ever reads. Positions clamp to
+    the last cell exactly like the dense bank's write does."""
+    ps = pool["k"].shape[2]
+    m = view["k"].shape[2]
+    start = jnp.asarray(start, jnp.int32)
+    positions = jnp.minimum(
+        start[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :],
+        m - 1,
+    )  # [B, W]
+    pids = jnp.take_along_axis(table, positions // ps, axis=1)
+    offs = positions % ps
+    idx = positions[None, :, :, None, None]  # broadcast L, KV, tail
+    out = {}
+    for name, arr in pool.items():
+        cells = jnp.take_along_axis(view[name], idx, axis=2)
+        out[name] = arr.at[:, pids, offs].set(cells)
+    return out
+
+
+def paged_install_row(
+    pool: Dict[str, jax.Array],
+    row_cache: Dict[str, jax.Array],
+    table_row: jax.Array,   # [P] page ids for the receiving slot
+    start,                  # traced scalar: first cell to install
+    length: int,            # STATIC cell count (the suffix bucket)
+) -> Dict[str, jax.Array]:
+    """Install cells [start, start+length) of an exact (fp32) cache
+    row into the pages `table_row` maps them to — the paged twin of
+    `install_exact_row` (cold admission installs the whole prompt
+    bucket at start=0; warm admission installs only the suffix, the
+    shared prefix pages are already populated). Quantizes on the way
+    in when the pool is int8 — per-VECTOR scales make quantizing the
+    slice equal to slicing the quantized whole, so the installed
+    bytes match the dense bank's cold path exactly. `length` is
+    static (one program per suffix bucket), `start` traced."""
+    ps = pool["k"].shape[2]
+    start = jnp.asarray(start, jnp.int32)
+    positions = start + jnp.arange(length, dtype=jnp.int32)  # [Sb]
+    pids = table_row[positions // ps]
+    offs = positions % ps
+    src = {}
+    for name in ("k", "v"):
+        arr = row_cache[name]  # [L, 1, M, KV, hd]
+        sl = jax.lax.dynamic_slice(
+            arr,
+            (0, 0, start, 0, 0),
+            (arr.shape[0], 1, length) + arr.shape[3:],
+        )
+        src[name] = sl[:, 0]  # [L, Sb, KV, hd]
+    if "k_scale" in pool:
+        kq, ks = _kv_quantize(src["k"])
+        vq, vs = _kv_quantize(src["v"])
+        src = {"k": kq, "v": vq, "k_scale": ks, "v_scale": vs}
+    out = {}
+    for name, arr in pool.items():
+        out[name] = arr.at[:, pids, offs].set(
+            src[name].astype(arr.dtype)
+        )
+    return out
+
+
+def pool_copy_page(
+    pool: Dict[str, jax.Array], src, dst
+) -> Dict[str, jax.Array]:
+    """Copy physical page `src` onto `dst` across every layer — the
+    device half of copy-on-write (the allocator hands the writer a
+    fresh page preloaded with the shared page's cells). Traced
+    src/dst: one compiled program covers every CoW."""
+    out = {}
+    for name, arr in pool.items():
+        out[name] = arr.at[:, dst].set(
+            jax.lax.dynamic_slice(
+                arr, (0, src) + (0,) * (arr.ndim - 2),
+                (arr.shape[0], 1) + arr.shape[2:],
+            )[:, 0]
+        )
+    return out
+
+
 def _mask_top_k(logits: jax.Array, k: int) -> jax.Array:
     """Keep the k highest logits per row; the rest become -inf. Static
     k, so the top_k + threshold compare stays one fused XLA program.

@@ -20,6 +20,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5; accept
+# both so the kernels (and their interpret-mode tests) run on either
+CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 NEG_INF = -1e30
 # Blocks as large as the VMEM budget allows: the 1024^2 score tile
 # measured 2.2x faster than 128^2 at head_dim 64 on v5e (grid-step
@@ -53,8 +59,13 @@ def _vmem_estimate(bq: int, bk: int, d: int) -> int:
 def auto_blocks(s_q: int, s_k: int, d: int) -> Tuple[int, int]:
     """Pick (block_q, block_k) for the shapes: as large as the VMEM
     budget allows given head_dim d. Returns (0, 0) when no block >= 128
-    divides the sequence (then the caller must use the XLA reference)."""
-    bq = _pick_block(s_q, _MAX_BLOCK)
+    divides the sequence (then the caller must use the XLA reference).
+
+    s_q == 1 is the KV-cache decode shape: block_q is the whole
+    (one-row) query axis — legal because a block dim that MATCHES the
+    array dim needs no (8, 128) tiling — and only the key axis blocks.
+    """
+    bq = 1 if s_q == 1 else _pick_block(s_q, _MAX_BLOCK)
     bk = _pick_block(s_k, _MAX_BLOCK)
     while max(bq, bk) >= 256 and _vmem_estimate(bq, bk, d) > _VMEM_BUDGET:
         if bq >= bk:
@@ -76,10 +87,14 @@ def supports(q, k, segment_ids=None, block_q=None, block_k=None) -> bool:
     # to beat the XLA reference
     if d % 8 != 0 or d < 32 or d > 512:
         return False
-    if s_q != s_k:
-        # the kernel's causal mask is top-left aligned; cross-length
-        # (KV-cache decode) needs the bottom-right offset the XLA
-        # reference applies — don't take the flash path
+    if s_q != s_k and s_q != 1:
+        # the kernel's causal mask is top-left aligned; general
+        # cross-length attention needs the bottom-right offset the XLA
+        # reference applies — don't take the flash path. The s_q == 1
+        # decode shape is the EXCEPTION: a single query at the
+        # bottom-right row attends every key, so causal masking
+        # degenerates to no mask at all and the kernel handles it
+        # (the paged-attention decode gate reuses this).
         return False
     auto_q, auto_k = auto_blocks(s_q, s_k, d)
     bq = block_q or auto_q
@@ -196,7 +211,7 @@ def _fwd(q, k, v, causal, scale, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=(
                 "parallel", "parallel", "parallel", "arbitrary"
             ),
@@ -361,7 +376,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k):
                                lambda b, h, qi, ki: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=(
                 "parallel", "parallel", "parallel", "arbitrary"
             ),
@@ -404,7 +419,7 @@ def _bwd(q, k, v, o, lse, do, causal, scale, block_q, block_k):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=(
                 "parallel", "parallel", "parallel", "arbitrary"
             ),
@@ -455,11 +470,18 @@ def flash_attention(
     block_q/block_k default to the VMEM-budget auto choice (auto_blocks);
     pass explicit sizes only for tuning experiments."""
     if causal and q.shape[1] != k.shape[1]:
-        raise ValueError(
-            "flash_attention causal masking requires equal q/k lengths "
-            f"(got {q.shape[1]} vs {k.shape[1]}); use the XLA reference "
-            "path for KV-cache decode"
-        )
+        if q.shape[1] == 1:
+            # single-query decode: the query sits at the bottom-right
+            # row of the (1, s_k) score matrix, where the causal mask
+            # keeps every column — run the kernel unmasked (identical
+            # math, no per-block mask work)
+            causal = False
+        else:
+            raise ValueError(
+                "flash_attention causal masking requires equal q/k "
+                f"lengths (got {q.shape[1]} vs {k.shape[1]}) unless "
+                "q_len == 1 (decode); use the XLA reference path"
+            )
     if block_q is None or block_k is None:
         auto_q, auto_k = auto_blocks(
             q.shape[1], k.shape[1], q.shape[-1]

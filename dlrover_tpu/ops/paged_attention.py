@@ -1,0 +1,288 @@
+"""Pallas TPU paged-attention decode kernel (vLLM PagedAttention, TPU
+re-design).
+
+The serving engine's paged KV layout (serving/engine.py kv_layout=
+"paged") stores K/V in a global page pool `[n_pages, page_size, KV,
+hd]` per layer; each batch row owns a page TABLE `[P]` of physical
+page ids covering logical positions [i*page_size, (i+1)*page_size).
+Decode attention must gather a row's pages and attend a single query
+over them — this module provides both halves:
+
+- `paged_attention(..., impl="reference")`: gather the pages into a
+  dense [B, M, KV, hd] view and run EXACTLY the grouped-einsum masked
+  softmax that models/decode.py's `_cached_attention` runs on the
+  dense slot bank (same shapes, same ops, same reduction widths).
+  This is the byte-parity workhorse: the paged engine is bit-identical
+  to the dense oracle because the attention FORMULATION is identical
+  — pages only change where the bytes live, never what is computed.
+  Masked columns contribute exact-zero probability whatever garbage a
+  trash/stale page holds, so the gather may read anything dead.
+- `paged_attention(..., impl="kernel")`: a Pallas kernel in the
+  flash_attention.py online-softmax style that never materializes the
+  dense view: the page table rides in as a SCALAR-PREFETCH operand
+  (pltpu.PrefetchScalarGridSpec), so the BlockSpec index map resolves
+  page ids before the body runs and the pipeline streams pages
+  HBM→VMEM directly. int8 pools dequantize inside the inner loop
+  (fused into the score/accumulate dots — the cache reads stay int8
+  in HBM, halving decode's memory-bound byte traffic). interpret=True
+  on CPU keeps tier-1 runnable.
+- `impl="auto"`: the kernel on real TPU when `supports()` passes,
+  else the reference. CPU tier-1 therefore always runs the reference
+  — which is what makes the engine parity sweep deterministic.
+
+The single-query shape gate reuses ops/flash_attention.supports()
+(fixed to accept q_len == 1 decode shapes): head_dim lane/tile
+constraints are identical between the two kernels.
+"""
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dlrover_tpu.ops import flash_attention as fa
+
+NEG_INF = -1e30
+
+
+def supports(q, pages: Dict, table) -> bool:
+    """Whether the Pallas kernel handles these shapes. `q` is the
+    [B, H, hd] single-token query, `pages` the per-layer pool dict,
+    `table` the [B, P] page table. Reuses flash_attention's q_len==1
+    gate for the head_dim constraints, then checks the page axis."""
+    b, h, d = q.shape
+    n_pages, page_size, kv, _ = pages["k"].shape
+    # flash's single-query gate owns the d / GQA lane constraints; the
+    # key-side "sequence" a page kernel streams is one page long
+    q_probe = jax.ShapeDtypeStruct((b, 1, h, d), q.dtype)
+    k_probe = jax.ShapeDtypeStruct((b, 1, kv, d), q.dtype)
+    if not fa.supports(q_probe, k_probe, block_q=1, block_k=1):
+        return False
+    # a page is the kernel's key block: Mosaic wants the penultimate
+    # block dim to tile 8 lanes (or match the array dim, which it does
+    # by construction) — small pages still lower, but below 8 the
+    # grid overhead swamps the work
+    if page_size < 8:
+        return False
+    if table.ndim != 2 or table.shape[0] != b:
+        return False
+    return True
+
+
+def use_kernel(q, pages: Dict, table) -> bool:
+    """Static (trace-time) dispatch decision for the engine: the
+    kernel only on a real TPU backend — CPU always takes the
+    reference, which is the byte-parity formulation."""
+    if jax.default_backend() != "tpu":
+        return False
+    return supports(q, pages, table)
+
+
+# ---------------------------------------------------------------------------
+# reference: gather + the dense-bank attention formulation
+# ---------------------------------------------------------------------------
+
+
+def gather_pages(pages: Dict, table) -> Dict:
+    """Materialize the dense [B, M, KV, ...] view of each row's pages
+    (M = P * page_size). A pure read: XLA lowers it to a gather, no
+    pool mutation. Rows of `table` pointing at the trash page (or at
+    stale pages) surface garbage that the position mask must hide —
+    which it does, exactly (masked softmax columns are 0.0)."""
+    out = {}
+    for name, arr in pages.items():
+        g = arr[table]  # [B, P, page_size, KV, ...]
+        out[name] = g.reshape((g.shape[0], -1) + g.shape[3:])
+    return out
+
+
+def _reference(q, pages, table, lengths, scale):
+    """The dense-bank formulation on the gathered view — kept
+    OP-FOR-OP identical to models/decode.py::_cached_attention (same
+    grouped einsum, same mask, same softmax axis) so the paged engine
+    can be byte-compared against the dense oracle. q: [B, H, hd],
+    single decode query per row at position lengths-1."""
+    view = gather_pages(pages, table)
+    k_cache, v_cache = view["k"], view["v"]
+    if "k_scale" in view:
+        k_cache = (
+            k_cache.astype(q.dtype) * view["k_scale"].astype(q.dtype)
+        )
+        v_cache = (
+            v_cache.astype(q.dtype) * view["v_scale"].astype(q.dtype)
+        )
+    b, h, hd = q.shape
+    m = k_cache.shape[1]
+    kv = k_cache.shape[2]
+    n_rep = h // kv
+    qg = q.reshape(b, 1, kv, n_rep, hd)
+    scores = jnp.einsum(
+        "bskrd,bmkd->bkrsm", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    cols = jnp.arange(m)[None, None, None, None, :]
+    rows = (lengths - 1)[:, None, None, None, None]
+    scores = jnp.where(cols <= rows, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkrsm,bmkd->bskrd", p, v_cache)
+    return out.reshape(b, h, hd)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_kernel(table_ref, len_ref,  # scalar-prefetch operands
+                  q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr,
+                  *, scale, page_size, num_pages, n_rep, quant):
+    """Grid (B, KV, P): one invocation attends query row b's rep-group
+    of kv head h over physical page table[b, p]. Online softmax in
+    VMEM scratch across the page axis (sequential 'arbitrary' dim);
+    pages past the row's valid length are skipped whole."""
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[bi]
+
+    @pl.when(pi * page_size < length)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)       # [n_rep, hd]
+        if quant:
+            # [page, hd] int8 blocks, [page, 1] scales: the dequant
+            # multiply fuses into the VMEM-resident f32 staging that
+            # the dots read — HBM traffic stays int8
+            k_q, k_s, v_q, v_s = (
+                k_ref[0][0, :, 0], k_ref[1][0][0, :, 0],
+                v_ref[0][0, :, 0], v_ref[1][0][0, :, 0],
+            )
+            k = k_q.astype(jnp.float32) * k_s.astype(jnp.float32)
+            v = v_q.astype(jnp.float32) * v_s.astype(jnp.float32)
+        else:
+            k = k_ref[0][0, :, 0].astype(jnp.float32)  # [page, hd]
+            v = v_ref[0][0, :, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                  # [n_rep, page]
+        cols = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(cols < length, s, NEG_INF)
+        # scratch rows are padded to the 8-sublane minimum; the live
+        # online-softmax state is the leading n_rep rows
+        m_prev = m_scr[:n_rep, :1]
+        l_prev = l_scr[:n_rep, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:n_rep] = acc_scr[:n_rep] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:n_rep] = jnp.broadcast_to(m_new, (n_rep, m_scr.shape[1]))
+        l_scr[:n_rep] = jnp.broadcast_to(l_new, (n_rep, l_scr.shape[1]))
+
+    @pl.when(pi == num_pages - 1)
+    def _finalize():
+        l = l_scr[:n_rep, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:n_rep] / l).astype(o_ref.dtype)
+
+
+def _kernel(q, pages, table, lengths, scale):
+    """q [B, H, hd] → [B, H, hd]. The page table and lengths ride as
+    scalar-prefetch operands so the k/v BlockSpec index maps can
+    dereference table[b, p] — the pipeline then streams the PHYSICAL
+    pages, never a gathered copy."""
+    b, h, hd = q.shape
+    n_pages, page_size, kv, _ = pages["k"].shape
+    n_rep = h // kv
+    num_pages = table.shape[1]
+    quant = "k_scale" in pages
+    qg = q.reshape(b, kv, n_rep, hd)
+
+    def q_map(bi, hi, pi, tab, lens):
+        return (bi, hi, 0, 0)
+
+    def kv_map(bi, hi, pi, tab, lens):
+        return (tab[bi, pi], 0, hi, 0)
+
+    kv_spec = pl.BlockSpec((1, page_size, 1, hd), kv_map)
+    sc_spec = pl.BlockSpec((1, page_size, 1, 1), kv_map)
+    in_specs = [pl.BlockSpec((1, 1, n_rep, hd), q_map)]
+    operands = [qg]
+    if quant:
+        in_specs += [
+            (kv_spec, (sc_spec,)), (kv_spec, (sc_spec,)),
+        ]
+        operands += [
+            (pages["k"], (pages["k_scale"],)),
+            (pages["v"], (pages["v_scale"],)),
+        ]
+    else:
+        in_specs += [(kv_spec,), (kv_spec,)]
+        operands += [(pages["k"],), (pages["v"],)]
+
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, page_size=page_size,
+        num_pages=num_pages, n_rep=n_rep, quant=quant,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kv, num_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, n_rep, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((max(n_rep, 8), 128), jnp.float32),
+            pltpu.VMEM((max(n_rep, 8), 128), jnp.float32),
+            pltpu.VMEM((max(n_rep, 8), hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv, n_rep, hd), q.dtype),
+        compiler_params=fa.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=fa._interpret(),
+    )(table.astype(jnp.int32), lengths.astype(jnp.int32), *operands)
+    return out.reshape(b, h, hd)
+
+
+def paged_attention(
+    q: jax.Array,           # [B, H, hd] — one decode query per row
+    pages: Dict[str, jax.Array],
+    table: jax.Array,       # [B, P] physical page ids
+    lengths: jax.Array,     # [B] valid cells per row (query at len-1)
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Single-query attention over paged KV. impl: "reference" (the
+    dense-bank byte-parity formulation over a gathered view), "kernel"
+    (Pallas, pages streamed via scalar-prefetched table), or "auto"
+    (kernel on TPU when supported, else reference)."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    if impl == "reference":
+        return _reference(q, pages, table, lengths, scale)
+    if impl == "kernel":
+        return _kernel(q, pages, table, lengths, scale)
+    if impl != "auto":
+        raise ValueError(f"unknown impl {impl!r}")
+    if use_kernel(q, pages, table):
+        return _kernel(q, pages, table, lengths, scale)
+    return _reference(q, pages, table, lengths, scale)

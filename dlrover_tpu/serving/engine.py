@@ -89,16 +89,28 @@ from dlrover_tpu.models.decode import (
     _mask_top_k,
     _mask_top_p,
     decode_step,
+    gather_pool_view,
     init_kv_cache,
+    init_page_pool,
     install_exact_row,
+    paged_decode_step,
+    paged_install_row,
+    paged_verify_step,
+    pool_copy_page,
     pool_put_row,
     pool_take_row,
     prefill_exact_row,
     prefill_into_slot,
     prefill_suffix_row,
+    scatter_pool_window,
     spec_accept_greedy,
     spec_accept_sampled,
     verify_step,
+)
+from dlrover_tpu.serving.paged_kv import (
+    TRASH_PAGE,
+    OutOfPages,
+    PageAllocator,
 )
 from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
 from dlrover_tpu.serving.speculative import SpeculativeDecoder
@@ -123,6 +135,12 @@ class _Request:
     # explicit sampling key (crash resume continues a journaled key
     # stream); None = the engine draws one from its seed at admission
     prng_key: Optional[np.ndarray] = None
+    # set by preempt-and-swap: the request was swapped to host and
+    # re-queued for resume-by-replay (paged layout, pool pressure)
+    preempted: bool = False
+    # how many of `out` are already folded into `prompt` by earlier
+    # preemptions — a second preemption must not re-append them
+    folded: int = 0
 
 
 # one step() event: (request idx, tokens emitted this chunk, finished)
@@ -172,39 +190,111 @@ def _build_chunk_program(
     # request re-admitted elsewhere with that key draws the same
     # sample an uncrashed run would have. A live slot burns exactly
     # one split per scan step (== one per emitted token while live).
+    # The post-logits advance is shared between the dense and paged
+    # variants (same ops, same order), so the two layouts sample,
+    # stop and cap identically — the byte-parity contract of
+    # kv_layout="paged" reduces to the forward producing identical
+    # logits, which the gathered-view attention guarantees.
+    def _advance(logits, tok, pos, done, limit, keys):
+        if temperature <= 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            pair = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+            keys, subs = pair[:, 0], pair[:, 1]
+            nxt = jax.vmap(
+                lambda l, kk: jax.random.categorical(kk, l)
+            )(_warp(logits), subs).astype(jnp.int32)
+        nxt = jnp.where(done, pad_id, nxt)
+        hit_eos = (
+            (nxt == eos_id)
+            if eos_id is not None
+            else jnp.zeros_like(done)
+        )
+        # tokens generated through this step = pos+2-prompt_len
+        # (carry enters at prompt_len-1), so the length cap
+        # limit = prompt_len + max_new fires at pos+2 >= limit
+        new_done = done | hit_eos | (pos + 2 >= limit)
+        pos = jnp.where(done, pos, pos + 1)
+        tok = jnp.where(done, tok, nxt)
+        return tok, pos, new_done, keys, nxt
+
     @partial(jax.jit, donate_argnums=(0,), static_argnums=(7,))
     def _run_chunk(cache, params, tok, pos, done, limit, keys, k):
         def body(carry, _):
             cache, tok, pos, done, keys = carry
             logits, cache = decode_step(cfg, params, tok, cache, pos)
-            if temperature <= 0.0:
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            else:
-                pair = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
-                keys, subs = pair[:, 0], pair[:, 1]
-                nxt = jax.vmap(
-                    lambda l, kk: jax.random.categorical(kk, l)
-                )(_warp(logits), subs).astype(jnp.int32)
-            nxt = jnp.where(done, pad_id, nxt)
-            hit_eos = (
-                (nxt == eos_id)
-                if eos_id is not None
-                else jnp.zeros_like(done)
+            tok, pos, done, keys, nxt = _advance(
+                logits, tok, pos, done, limit, keys
             )
-            # tokens generated through this step = pos+2-prompt_len
-            # (carry enters at prompt_len-1), so the length cap
-            # limit = prompt_len + max_new fires at pos+2 >= limit
-            new_done = done | hit_eos | (pos + 2 >= limit)
-            pos = jnp.where(done, pos, pos + 1)
-            tok = jnp.where(done, tok, nxt)
-            return (cache, tok, pos, new_done, keys), nxt
+            return (cache, tok, pos, done, keys), nxt
 
         (cache, tok, pos, done, keys), emitted = jax.lax.scan(
             body, (cache, tok, pos, done, keys), None, length=k,
         )
         return cache, tok, pos, done, keys, emitted.T  # [B, k]
 
-    return _run_chunk
+    # paged twin: the page POOL is the donated cache argument; the
+    # page table rides as a read-only operand (it changes only via
+    # host-side admission/CoW scatters, never inside a chunk). Done
+    # rows route through the trash page INSIDE the program (their
+    # frozen rewrites land where no live table reads), so releasing a
+    # finished slot's pages is pure host accounting — no table-parking
+    # dispatch on the finish/retire/preempt path.
+    # Two executions of the same math, chosen at build time:
+    #   TPU — per-step paged_decode_step, whose S==1 path streams
+    #   physical pages through the Pallas paged-attention kernel
+    #   without materializing a dense view;
+    #   elsewhere — gather the dense view ONCE, run the scan body the
+    #   dense program uses (byte parity by construction: it IS the
+    #   dense program over the same bytes), and scatter the k-wide
+    #   written window back to pages afterwards. A per-step gather
+    #   would copy the full cache once per token — the difference
+    #   between ~parity and >2x dense TPOT on the CPU smoke.
+    on_tpu = jax.default_backend() == "tpu"
+
+    @partial(jax.jit, donate_argnums=(0,), static_argnums=(8,))
+    def _run_chunk_paged(
+        pool, table, params, tok, pos, done, limit, keys, k
+    ):
+        # done-at-entry rows read and write the trash page (page 0);
+        # rows finishing MID-chunk still own their pages (the host
+        # frees them only after harvesting this dispatch), so their
+        # remaining frozen rewrites stay in-bounds either way
+        table = jnp.where(done[:, None], 0, table)
+        if on_tpu:
+            def body(carry, _):
+                pool, tok, pos, done, keys = carry
+                logits, pool = paged_decode_step(
+                    cfg, params, tok, pool, table, pos
+                )
+                tok, pos, done, keys, nxt = _advance(
+                    logits, tok, pos, done, limit, keys
+                )
+                return (pool, tok, pos, done, keys), nxt
+
+            (pool, tok, pos, done, keys), emitted = jax.lax.scan(
+                body, (pool, tok, pos, done, keys), None, length=k,
+            )
+            return pool, tok, pos, done, keys, emitted.T  # [B, k]
+
+        view = gather_pool_view(pool, table)
+        start = pos
+
+        def body(carry, _):
+            cache, tok, pos, done, keys = carry
+            logits, cache = decode_step(cfg, params, tok, cache, pos)
+            tok, pos, done, keys, nxt = _advance(
+                logits, tok, pos, done, limit, keys
+            )
+            return (cache, tok, pos, done, keys), nxt
+
+        (view, tok, pos, done, keys), emitted = jax.lax.scan(
+            body, (view, tok, pos, done, keys), None, length=k,
+        )
+        pool = scatter_pool_window(pool, view, table, start, k)
+        return pool, tok, pos, done, keys, emitted.T  # [B, k]
+
+    return {"dense": _run_chunk, "paged": _run_chunk_paged}
 
 
 def _build_spec_program(
@@ -230,13 +320,10 @@ def _build_spec_program(
             logits = _mask_top_p(logits, top_p)
         return logits
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def _run_spec(
-        cache, params, tok, pos, done, limit, keys, drafts, draft_len
+    def _accept(
+        logits, tok, pos, done, limit, keys, drafts, draft_len
     ):
         b, k = drafts.shape
-        tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
-        logits, cache = verify_step(cfg, params, tokens, cache, pos)
         if temperature <= 0.0:
             m, extra = spec_accept_greedy(logits, drafts, draft_len)
         else:
@@ -290,11 +377,54 @@ def _build_spec_program(
         # controller should only credit tokens that shipped
         accepted = jnp.minimum(m, jnp.maximum(n_emit - 1, 0))
         return (
-            cache, new_tok, new_pos, new_done, keys,
-            emitted, n_emit, accepted,
+            new_tok, new_pos, new_done, keys, emitted, n_emit,
+            accepted,
         )
 
-    return _run_spec
+    @partial(jax.jit, donate_argnums=(0,))
+    def _run_spec(
+        cache, params, tok, pos, done, limit, keys, drafts, draft_len
+    ):
+        tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
+        logits, cache = verify_step(cfg, params, tokens, cache, pos)
+        out = _accept(
+            logits, tok, pos, done, limit, keys, drafts, draft_len
+        )
+        return (cache,) + out
+
+    # paged twin — identical acceptance, with the chunk program's
+    # build-time split: per-step paged_verify_step on TPU (page-native
+    # writes), gather/dense-verify/scatter-back elsewhere (one view
+    # copy per dispatch instead of one per step; a verify is a single
+    # step, so this is cost-neutral — it exists so both programs share
+    # one execution strategy per backend)
+    on_tpu = jax.default_backend() == "tpu"
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _run_spec_paged(
+        pool, table, params, tok, pos, done, limit, keys, drafts,
+        draft_len,
+    ):
+        tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
+        # same trash-routing as the chunk program: done rows never
+        # touch live pages, so page release needs no device dispatch
+        table = jnp.where(done[:, None], 0, table)
+        if on_tpu:
+            logits, pool = paged_verify_step(
+                cfg, params, tokens, pool, table, pos
+            )
+        else:
+            view = gather_pool_view(pool, table)
+            logits, view = verify_step(cfg, params, tokens, view, pos)
+            pool = scatter_pool_window(
+                pool, view, table, pos, tokens.shape[1]
+            )
+        out = _accept(
+            logits, tok, pos, done, limit, keys, drafts, draft_len
+        )
+        return (pool,) + out
+
+    return {"dense": _run_spec, "paged": _run_spec_paged}
 
 
 def _build_admit_programs(cfg, max_len):
@@ -339,12 +469,53 @@ def _build_admit_programs(cfg, max_len):
     def _publish_fn(pool, work, row):
         return pool_put_row(pool, work, row)
 
+    # ---- paged-layout admissions (kv_layout="paged") ----------------
+    # Same exact-fp32 working rows, but the install half scatters into
+    # the slot's PAGES instead of copying a dense bank row — and a
+    # warm admission scatters ONLY the suffix cells (the shared prefix
+    # pages are already populated; the table points at them for free).
+    # There is no paged "hit" program at all: a full-prefix hit is
+    # pure host bookkeeping plus at most one page CoW copy.
+
+    # Each admit program also installs the slot's table row in the
+    # SAME dispatch (table.at[slot].set) — a separate _table_row_prog
+    # call would add a device round-trip per admission, which lands
+    # between other slots' decode chunks and shows up directly in
+    # their TPOT. The table is not donated (see the state-scatter
+    # comment below: a cancel-time reset may race a pending async
+    # host copy).
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _paged_cold_fn(pages, table, params, prompt, slot, table_row):
+        row = prefill_exact_row(cfg, params, prompt, max_len)
+        pages = paged_install_row(
+            pages, row, table_row, 0, prompt.shape[0]
+        )
+        return pages, table.at[slot].set(table_row), row
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _paged_warm_fn(pages, table, pool, params, suffix, slot,
+                       table_row, row, start):
+        work = pool_take_row(pool, row)
+        work = prefill_suffix_row(cfg, params, suffix, work, start)
+        pages = paged_install_row(
+            pages, work, table_row, start, suffix.shape[0]
+        )
+        return pages, table.at[slot].set(table_row), work
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _page_copy_fn(pages, src, dst):
+        return pool_copy_page(pages, src, dst)
+
     return {
         "admit": _admit_fn,
         "cold": _admit_cold_fn,
         "warm": _admit_warm_fn,
         "hit": _admit_hit_fn,
         "publish": _publish_fn,
+        "paged_cold": _paged_cold_fn,
+        "paged_warm": _paged_warm_fn,
+        "page_copy": _page_copy_fn,
     }
 
 
@@ -374,6 +545,24 @@ def _state_admit_prog(tok, pos, done, limit, keys,
 @jax.jit
 def _state_cancel_prog(done, slot):
     return done.at[slot].set(True)
+
+
+# page-table scatters (kv_layout="paged"): the device table [B, P] is
+# part of the resident state — full-hit admissions set a whole row,
+# CoW patches one entry. Release paths need NO scatter: the chunk and
+# verify programs route done rows through the trash page themselves.
+# Like the state scatters above, nothing donates: a scatter may land
+# while a dispatch's outputs still have a pending async host copy.
+
+
+@jax.jit
+def _table_row_prog(table, slot, vals):
+    return table.at[slot].set(vals)
+
+
+@jax.jit
+def _table_entry_prog(table, slot, idx, val):
+    return table.at[slot, idx].set(val)
 
 
 def _to_host(*arrays) -> Tuple[np.ndarray, ...]:
@@ -444,6 +633,10 @@ class ContinuousBatcher:
         chaos=None,                  # serving/chaos.py FaultInjector
         chaos_tag: str = "engine",   # this engine's tag in fault plans
         async_depth: int = 0,        # 1 = one-deep pipelined dispatch
+        kv_layout: str = "dense",    # "dense" bank | "paged" pool
+        page_size: int = 0,          # cells per page (0 = auto pow2)
+        n_pages: int = 0,            # pool size (0 = dense-equivalent)
+        swap_headroom: int = 1,      # free pages the scheduler keeps
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -496,9 +689,75 @@ class ContinuousBatcher:
         # ever attends, so they are dead by the position mask). With
         # spec_draft_len=0 the bank is exactly max_len — today's
         # shapes, today's programs, bit-exact behavior.
-        self.cache = init_kv_cache(
-            cfg, n_slots, max_len + spec_draft_len, quant=kv_quant
-        )
+        if kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'dense' or 'paged', got "
+                f"{kv_layout!r}"
+            )
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
+        bank_len = max_len + spec_draft_len
+        if self._paged:
+            # auto page size: the largest power of two <= 16 dividing
+            # the bank length (and the prefix block, so a matched
+            # prefix is always a whole number of pages)
+            if page_size <= 0:
+                page_size = 16
+                while page_size > 1 and (
+                    bank_len % page_size
+                    or (
+                        prefix_cache_rows > 0
+                        and prefix_block % page_size
+                    )
+                ):
+                    page_size //= 2
+            if bank_len % page_size:
+                raise ValueError(
+                    f"page_size {page_size} must divide max_len + "
+                    f"spec_draft_len = {bank_len}: a slot's logical "
+                    "cells must map onto whole pages"
+                )
+            if prefix_cache_rows > 0 and prefix_block % page_size:
+                raise ValueError(
+                    f"page_size {page_size} must divide prefix_block "
+                    f"{prefix_block}: shared prefixes must cover "
+                    "whole pages or sharing cannot be copy-free"
+                )
+            per_slot = bank_len // page_size
+            if n_pages <= 0:
+                # dense-equivalent capacity (+ the trash page): same
+                # HBM as the dense bank, oversubscription comes from
+                # setting n_pages lower
+                n_pages = n_slots * per_slot + 1
+            if n_pages < per_slot + 1:
+                raise ValueError(
+                    f"n_pages {n_pages} cannot back a single maximal "
+                    f"request ({per_slot} pages + the trash page)"
+                )
+            self.page_size = page_size
+            self.n_pages = n_pages
+            self.swap_headroom = max(0, swap_headroom)
+            self._pages_per_slot = per_slot
+            self.allocator = PageAllocator(n_pages, page_size)
+            self.page_pool = init_page_pool(
+                cfg, n_pages, page_size, quant=kv_quant
+            )
+            # all rows start on the trash page (page 0); after that
+            # the programs trash-route done rows on their own, so the
+            # host only ever scatters rows at admission/CoW
+            self._table = jnp.zeros((n_slots, per_slot), jnp.int32)
+            self._slot_pages: List[List[int]] = [
+                [] for _ in range(n_slots)
+            ]
+            # published radix row -> its ref-counted page run
+            self._row_pages: Dict[int, List[int]] = {}
+            self._swap_preemptions = 0
+            self._swap_resumes = 0
+            self.cache = None
+        else:
+            self.cache = init_kv_cache(
+                cfg, n_slots, bank_len, quant=kv_quant
+            )
         # host MIRRORS of the slot state (tiny [B] vectors). The truth
         # lives on device in self._dev; these track it so admission
         # and scheduler decisions (_next_chunk_len, free_slots,
@@ -548,8 +807,12 @@ class ContinuousBatcher:
         # pool row pinned per slot while its request is in flight
         self._slot_row: List[Optional[int]] = [None] * n_slots
         if prefix_cache_rows > 0:
+            # paged: eviction of a published prefix must drop the
+            # run's page refs, or evicted prefixes leak pool pages
             self.prefix_cache = RadixPrefixCache(
-                prefix_cache_rows, block=prefix_block
+                prefix_cache_rows,
+                block=prefix_block,
+                on_evict=self._on_prefix_evict if self._paged else None,
             )
             # exact dtype even when the slot bank is int8: install
             # re-quantizes, which keeps warm admissions byte-identical
@@ -578,7 +841,7 @@ class ContinuousBatcher:
                 lambda: _build_spec_program(
                     cfg, pad_id, eos_id, temperature, top_k, top_p
                 ),
-            )
+            )[self.kv_layout]
         self.spec_draft_len = spec_draft_len
 
         self._run_chunk = _cached_program(
@@ -587,7 +850,7 @@ class ContinuousBatcher:
             lambda: _build_chunk_program(
                 cfg, pad_id, eos_id, temperature, top_k, top_p
             ),
-        )
+        )[self.kv_layout]
         admit = _cached_program(
             _ADMIT_PROGRAMS,
             (cfg, max_len),
@@ -598,6 +861,9 @@ class ContinuousBatcher:
         self._admit_warm_fn = admit["warm"]
         self._admit_hit_fn = admit["hit"]
         self._publish_fn = admit["publish"]
+        self._paged_cold_fn = admit["paged_cold"]
+        self._paged_warm_fn = admit["paged_warm"]
+        self._page_copy_fn = admit["page_copy"]
 
     def _device_state(self) -> Dict[str, Any]:
         """Upload the host mirrors once; from here on the device
@@ -696,7 +962,12 @@ class ContinuousBatcher:
 
     def _admit(self, slot: int, req: _Request):
         p = len(req.prompt)
-        if self.prefix_cache is None:
+        if self._paged:
+            if req.preempted:
+                req.preempted = False
+                self._swap_resumes += 1
+            self._admit_paged(slot, req, p)
+        elif self.prefix_cache is None:
             bucket = min(_pad_bucket(p), self.max_len)
             self.cache = self._admit_fn(
                 self.cache,
@@ -796,6 +1067,273 @@ class ContinuousBatcher:
         if row is not None:
             self.prefix_cache.release(row)
             self._slot_row[slot] = None
+
+    # -- paged admission (kv_layout="paged") -------------------------------
+
+    def _on_prefix_evict(self, row: int) -> None:
+        """Radix eviction callback: the published prefix's page run
+        drops its reference — pages nobody else holds return to the
+        free list (no device work; the bytes just become dead)."""
+        run = self._row_pages.pop(row, None)
+        if run:
+            self.allocator.free(run)
+
+    def _request_pages(self, req: _Request) -> int:
+        """Exact page need for a request: its OWN limit (prompt plus
+        its token budget, capped at max_len), not max_len — short
+        requests stop stranding the tail of a dense row. The highest
+        cell ever written is limit-1+K (a frozen done slot rewrites
+        its last cell; a verify window extends K past it)."""
+        p = len(req.prompt)
+        limit = min(p + (req.max_new or self.max_new), self.max_len)
+        return (
+            (limit - 1 + self.spec_draft_len) // self.page_size + 1
+        )
+
+    def _admit_paged(self, slot: int, req: _Request, p: int):
+        """Paged admission: size the request's page run off its OWN
+        limit (not max_len — short requests stop stranding the tail
+        of a dense row), point the leading table entries at any
+        matched prefix's pages copy-free, allocate the rest, and
+        install only the cells the shared pages don't already hold.
+        Pool pressure is resolved inline: evict unreferenced prefix
+        runs, then preempt-and-swap the coldest live request."""
+        pc = self.prefix_cache
+        n_need = self._request_pages(req)
+        matched, row, start = 0, None, 0
+        if pc is not None:
+            matched, row = pc.match(req.prompt)
+            start = min(matched, p)
+            while (
+                start > 0
+                and start + _pad_bucket(p - start) > self.max_len
+            ):
+                start -= pc.block
+            start = max(start, 0)
+            if row is None or row not in self._row_pages:
+                start = 0
+        shared: List[int] = []
+        if start > 0:
+            # pin the matched row BEFORE any reclaim can run: an
+            # eviction pass must never free the run we are sharing
+            pc.acquire(row)
+            self._slot_row[slot] = row
+            shared = self._row_pages[row][: start // self.page_size]
+            self.allocator.share(shared)
+        try:
+            own = self._alloc_pages(n_need - len(shared))
+        except OutOfPages:
+            if shared:
+                self.allocator.free(shared)
+                self._release_slot_row(slot)
+            raise
+        run = shared + own
+        self._slot_pages[slot] = run
+        full_hit = pc is not None and start >= p and start > 0
+        if full_hit:
+            # the write frontier (cell p-1, rewritten by the first
+            # chunk step) sits inside the last shared page: CoW it
+            # now, while the copy still reads the publisher's bytes
+            self._cow_frontier(slot, p)
+        # numpy on purpose: the jit dispatch transfers it with the
+        # call instead of an extra eager device op per admission
+        vals = np.full(self._pages_per_slot, TRASH_PAGE, np.int32)
+        vals[: len(run)] = run
+        work = None
+        if full_hit:
+            # no install program at all: the table row is the only
+            # device write a full-prefix hit needs
+            self._table = _table_row_prog(self._table, slot, vals)
+            if pc is not None:
+                pc.record_admission(start)
+        elif start > 0:
+            suffix = self._pad_to(
+                req.prompt[start:], _pad_bucket(p - start)
+            )
+            self.page_pool, self._table, work = self._paged_warm_fn(
+                self.page_pool,
+                self._table,
+                self.pool,
+                self.params,
+                suffix,
+                slot,
+                vals,
+                row,
+                start,
+            )
+            pc.record_admission(start)
+        else:
+            bucket = min(_pad_bucket(p), self.max_len)
+            self.page_pool, self._table, work = self._paged_cold_fn(
+                self.page_pool,
+                self._table,
+                self.params,
+                self._pad_to(req.prompt, bucket),
+                slot,
+                vals,
+            )
+            if pc is not None:
+                pc.record_admission(0)
+        # publish AFTER install (the published pages must hold the
+        # installed bytes): the run's leading pages become the radix
+        # entry's run by ref-count alone — publish copies the fp32
+        # work row into the prefix pool (the suffix-prefill source)
+        # but never copies K/V into or out of the page pool
+        if pc is not None and work is not None:
+            publish_len = pc.aligned_len(p)
+            if publish_len > matched:
+                new_row, is_new = pc.insert(req.prompt[:publish_len])
+                if is_new:
+                    pub = list(run[: publish_len // self.page_size])
+                    self.allocator.share(pub)
+                    self._row_pages[new_row] = pub
+                    self.pool = self._publish_fn(
+                        self.pool, work, new_row
+                    )
+        # whoever now shares the frontier page (a publish of a
+        # page-aligned prompt), the SLOT must own its copy before
+        # decode rewrites cell p-1
+        self._cow_frontier(slot, p)
+
+    def _alloc_pages(self, n: int) -> List[int]:
+        """Allocate with reclaim: on a dry pool, evict LRU
+        unreferenced prefix runs first (free memory nobody is using),
+        then preempt-and-swap live requests until the allocation
+        fits. Raises OutOfPages only when nothing is left to
+        reclaim."""
+        while True:
+            try:
+                return self.allocator.alloc(n)
+            except OutOfPages:
+                if not self._reclaim_pages():
+                    raise
+
+    def _reclaim_pages(self) -> bool:
+        """One reclaim step. Eviction is strictly cheaper than
+        preemption (no replay), so prefix runs go first."""
+        pc = self.prefix_cache
+        if pc is not None and pc.evict_lru():
+            return True  # _on_prefix_evict freed the run
+        slot = self._pick_preempt_slot()
+        if slot is None:
+            return False
+        self._preempt_slot(slot)
+        return True
+
+    def _pick_preempt_slot(self) -> Optional[int]:
+        """Coldest live slot = the smallest resident KV footprint
+        (fewest decoded cells): cheapest to swap out and replay.
+        Deterministic tie-break by slot index keeps parity sweeps
+        reproducible."""
+        best, best_pos = None, None
+        for slot in range(self.n_slots):
+            req = self.slot_req[slot]
+            if req is None or self.done[slot]:
+                continue
+            if best_pos is None or int(self.pos[slot]) < best_pos:
+                best, best_pos = slot, int(self.pos[slot])
+        return best
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Swap a live request out to host: its device state IS
+        reconstructible from host data (prompt + emitted tokens +
+        current PRNG key — the PR-4 resume-by-replay contract), so
+        'swap' means free the pages and re-queue a replay request at
+        the front. Greedy replay is byte-identical; sampled replay
+        continues the exact key stream (seed-stable, the crash-
+        failover contract)."""
+        req = self.slot_req[slot]
+        emitted = np.asarray(req.out[req.folded :], np.int32)
+        if emitted.size:
+            req.prompt = np.concatenate([req.prompt, emitted])
+        req.folded = len(req.out)
+        # same absolute cap: replay generates exactly the tokens the
+        # uninterrupted run still owed
+        req.max_new = max(int(self.limit[slot]) - len(req.prompt), 1)
+        req.prng_key = self.slot_key[slot].copy()
+        req.preempted = True
+        self._release_slot_pages(slot)
+        if self.prefix_cache is not None:
+            self._release_slot_row(slot)
+        self.slot_req[slot] = None
+        self.done[slot] = True
+        self._dev["done"] = _state_cancel_prog(self._dev["done"], slot)
+        self._queue.appendleft(req)
+        self._swap_preemptions += 1
+
+    def _release_slot_pages(self, slot: int) -> None:
+        """Drop a slot's page run — pure host accounting. No device
+        dispatch: the chunk/verify programs route done rows through
+        the trash page themselves (the device done flag is set before
+        or by the same dispatch that finishes the slot), so the stale
+        table row is harmless until admission overwrites it."""
+        run = self._slot_pages[slot]
+        if run:
+            self.allocator.free(run)
+            self._slot_pages[slot] = []
+
+    def _cow_frontier(self, slot: int, p: int) -> None:
+        """Ensure the slot exclusively owns the page holding its
+        write frontier (cell p-1). Shared — by a full-prefix hit or
+        a page-aligned publish — means one page copy: the slot gets
+        a fresh page preloaded with the shared page's cells, readers
+        keep the original. This is the ONLY CoW site: every cell the
+        slot writes later lives in pages past every published run."""
+        run = self._slot_pages[slot]
+        idx = (p - 1) // self.page_size
+        if idx >= len(run):
+            return
+        page = run[idx]
+        if self.allocator.refcount(page) <= 1:
+            return
+        while True:
+            try:
+                fresh, copied = self.allocator.cow(page)
+                break
+            except OutOfPages:
+                if not self._reclaim_pages():
+                    raise
+        if copied:
+            self.page_pool = self._page_copy_fn(
+                self.page_pool, page, fresh
+            )
+            run[idx] = fresh
+            self._table = _table_entry_prog(
+                self._table, slot, idx, fresh
+            )
+
+    def admission_headroom_ok(self) -> bool:
+        """Memory-aware admission gate for the scheduler: True when a
+        worst-case admission fits the free pool (plus swap_headroom
+        slack) without evicting or preempting. Admission past a False
+        still SUCCEEDS — the engine reclaims inline — this only lets
+        the scheduler prefer queue-waiting over swap-thrash while
+        other requests are draining. Dense layout: always True."""
+        if not self._paged:
+            return True
+        # count admissions the engine has accepted but not yet stepped
+        # (their pages are not allocated yet, so free_pages alone
+        # would happily over-admit a whole burst in one pump). Queued
+        # requests' needs are EXACT — prompt and budget are known at
+        # submit — so a dense-equivalent pool still fills every slot
+        # in one pump; only the unknown next request is worst-cased.
+        pending = sum(self._request_pages(r) for r in self._queue)
+        want = min(
+            self._pages_per_slot + self.swap_headroom,
+            self.allocator.capacity,
+        )
+        return self.allocator.free_pages >= pending + want
+
+    def paged_stats(self) -> Dict[str, float]:
+        """Page-pool telemetry for ServingMetrics / the gateway:
+        occupancy, sharing ratio, CoW copies, preempt/swap counters.
+        {} under the dense layout."""
+        if not self._paged:
+            return {}
+        s = self.allocator.stats()
+        s["swap_preemptions"] = float(self._swap_preemptions)
+        s["swap_resumes"] = float(self._swap_resumes)
+        return s
 
     # -- the loop ----------------------------------------------------------
 
@@ -908,11 +1446,20 @@ class ContinuousBatcher:
     def _dispatch_chunk(self) -> None:
         d = self._dev
         k = self._next_chunk_len()
-        cache, tok, pos, done, keys, emitted = self._run_chunk(
-            self.cache, self.params,
-            d["tok"], d["pos"], d["done"], d["limit"], d["keys"], k,
-        )
-        self.cache = cache
+        if self._paged:
+            pool, tok, pos, done, keys, emitted = self._run_chunk(
+                self.page_pool, self._table, self.params,
+                d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
+                k,
+            )
+            self.page_pool = pool
+        else:
+            cache, tok, pos, done, keys, emitted = self._run_chunk(
+                self.cache, self.params,
+                d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
+                k,
+            )
+            self.cache = cache
         d.update(tok=tok, pos=pos, done=done, keys=keys)
         # live steps form a prefix of the chunk (done is sticky), and
         # pos advances once per live step — at harvest the first
@@ -938,14 +1485,24 @@ class ContinuousBatcher:
         self, drafts: np.ndarray, dlens: np.ndarray
     ) -> None:
         d = self._dev
-        (
-            cache, tok, pos, done, keys, emitted, n_emit, accepted
-        ) = self._run_spec(
-            self.cache, self.params,
-            d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
-            jnp.asarray(drafts), jnp.asarray(dlens),
-        )
-        self.cache = cache
+        if self._paged:
+            (
+                pool, tok, pos, done, keys, emitted, n_emit, accepted
+            ) = self._run_spec(
+                self.page_pool, self._table, self.params,
+                d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
+                jnp.asarray(drafts), jnp.asarray(dlens),
+            )
+            self.page_pool = pool
+        else:
+            (
+                cache, tok, pos, done, keys, emitted, n_emit, accepted
+            ) = self._run_spec(
+                self.cache, self.params,
+                d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
+                jnp.asarray(drafts), jnp.asarray(dlens),
+            )
+            self.cache = cache
         d.update(tok=tok, pos=pos, done=done, keys=keys)
         self._enqueue_fetch(
             _Inflight(
@@ -1022,6 +1579,12 @@ class ContinuousBatcher:
             finished = bool(new_done[slot])
             if finished:
                 req.done = True
+                if self._paged:
+                    # free the run immediately (not at retire): the
+                    # tokens are on host, the KV is dead — the pages
+                    # back the NEXT admission. The programs already
+                    # route this done row's rewrites to trash.
+                    self._release_slot_pages(slot)
                 if self.prefix_cache is not None:
                     self._release_slot_row(slot)
             if new_toks or finished:
@@ -1045,7 +1608,24 @@ class ContinuousBatcher:
         if idx not in self._pending:
             raise KeyError(f"request {idx} is not pending")
         del self._pending[idx]
-        return np.asarray(self._requests.pop(idx).out, np.int32)
+        req = self._requests.pop(idx)
+        # one-step slot cleanup: whatever path got us here (normal
+        # finish, publish-back failure, scheduler-side abandonment),
+        # retire leaves NO pinned prefix row, page run, or slot
+        # occupancy behind — a failed publish must never leak a ref
+        # count until LRU pressure finds it
+        for slot in range(self.n_slots):
+            if self.slot_req[slot] is req:
+                self.slot_req[slot] = None
+                self.done[slot] = True
+                self._dev["done"] = _state_cancel_prog(
+                    self._dev["done"], slot
+                )
+                if self._paged:
+                    self._release_slot_pages(slot)
+                if self.prefix_cache is not None:
+                    self._release_slot_row(slot)
+        return np.asarray(req.out, np.int32)
 
     def cancel(self, idx: int) -> None:
         """Abort a request wherever it is — still queued or live in a
@@ -1072,6 +1652,8 @@ class ContinuousBatcher:
                     self._dev["done"], slot
                 )
                 self.slot_req[slot] = None
+                if self._paged:
+                    self._release_slot_pages(slot)
                 if self.prefix_cache is not None:
                     self._release_slot_row(slot)
                 break
@@ -1096,12 +1678,27 @@ class ContinuousBatcher:
         events can never alias a new request. Compiled programs are
         untouched — they're cached per (config, knobs), not per
         engine state."""
-        self.cache = init_kv_cache(
-            self.cfg,
-            self.n_slots,
-            self.max_len + self.spec_draft_len,
-            quant=self._kv_quant,
-        )
+        if self._paged:
+            # the donated pool buffer is as untrustworthy as a donated
+            # dense bank — rebuild pool, allocator, and tables, and
+            # drop every host-side run record with them
+            self.allocator = PageAllocator(self.n_pages, self.page_size)
+            self.page_pool = init_page_pool(
+                self.cfg, self.n_pages, self.page_size,
+                quant=self._kv_quant,
+            )
+            self._table = jnp.zeros(
+                (self.n_slots, self._pages_per_slot), jnp.int32
+            )
+            self._slot_pages = [[] for _ in range(self.n_slots)]
+            self._row_pages = {}
+        else:
+            self.cache = init_kv_cache(
+                self.cfg,
+                self.n_slots,
+                self.max_len + self.spec_draft_len,
+                quant=self._kv_quant,
+            )
         self.tok[:] = self.pad_id
         self.pos[:] = 0
         self.limit[:] = 0
@@ -1120,7 +1717,9 @@ class ContinuousBatcher:
         self._step_no = 0
         if self.prefix_cache is not None:
             self.prefix_cache = RadixPrefixCache(
-                self._prefix_rows, block=self._prefix_block
+                self._prefix_rows,
+                block=self._prefix_block,
+                on_evict=self._on_prefix_evict if self._paged else None,
             )
             self.pool = init_kv_cache(
                 self.cfg, self._prefix_rows, self.max_len

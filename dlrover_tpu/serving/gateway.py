@@ -262,6 +262,9 @@ class ServingGateway:
         spec = self._speculative()
         if spec is not None:
             out["speculative"] = spec.stats()
+        paged = self._paged()
+        if paged:
+            out["paged_kv"] = paged
         return out
 
     def _prefix_cache(self):
@@ -276,6 +279,13 @@ class ServingGateway:
         scheduler scoping as _prefix_cache."""
         engine = getattr(self.backend, "engine", None)
         return getattr(engine, "spec", None)
+
+    def _paged(self) -> dict:
+        """The backing engine's page-pool stats ({} under the dense
+        layout), same single-scheduler scoping as _prefix_cache."""
+        engine = getattr(self.backend, "engine", None)
+        stats = getattr(engine, "paged_stats", None)
+        return stats() if callable(stats) else {}
 
     @property
     def port(self) -> int:

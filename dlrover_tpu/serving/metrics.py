@@ -92,6 +92,19 @@ class ServingMetrics:
         self._step_device_wait_ms = 0.0
         self._step_dispatches = 0
         self._step_overlap_ratio = 0.0
+        # page-pool counters/gauges: copied from the engine's
+        # paged_stats() each pump (kv_layout="paged" only — all zero
+        # under the dense bank)
+        self._paged_occupancy = 0.0
+        self._paged_shared_ratio = 0.0
+        self._paged_used_pages = 0
+        self._paged_capacity = 0
+        self._paged_pages_allocated = 0
+        self._paged_pages_freed = 0
+        self._paged_pages_shared = 0
+        self._paged_cow_copies = 0
+        self._paged_swap_preemptions = 0
+        self._paged_swap_resumes = 0
 
     # ---- ingestion -------------------------------------------------------
 
@@ -202,6 +215,41 @@ class ServingMetrics:
                 self._step_dispatches, int(dispatches)
             )
             self._step_overlap_ratio = overlap_ratio
+
+    def update_paged(self, stats: Dict[str, float]):
+        """Refresh page-pool telemetry from the engine's paged_stats().
+        Occupancy/sharing are gauges (set directly); the page and swap
+        totals are counters with the same max() monotonic guard as the
+        blocks above."""
+        with self._lock:
+            self._paged_occupancy = float(stats.get("occupancy", 0.0))
+            self._paged_shared_ratio = float(
+                stats.get("shared_ratio", 0.0)
+            )
+            self._paged_used_pages = int(stats.get("used_pages", 0))
+            self._paged_capacity = int(stats.get("n_pages", 0))
+            self._paged_pages_allocated = max(
+                self._paged_pages_allocated,
+                int(stats.get("pages_allocated", 0)),
+            )
+            self._paged_pages_freed = max(
+                self._paged_pages_freed, int(stats.get("pages_freed", 0))
+            )
+            self._paged_pages_shared = max(
+                self._paged_pages_shared,
+                int(stats.get("pages_shared", 0)),
+            )
+            self._paged_cow_copies = max(
+                self._paged_cow_copies, int(stats.get("cow_copies", 0))
+            )
+            self._paged_swap_preemptions = max(
+                self._paged_swap_preemptions,
+                int(stats.get("swap_preemptions", 0)),
+            )
+            self._paged_swap_resumes = max(
+                self._paged_swap_resumes,
+                int(stats.get("swap_resumes", 0)),
+            )
 
     # ---- queries ---------------------------------------------------------
 
@@ -318,6 +366,31 @@ class ServingMetrics:
     def step_overlap_ratio(self) -> float:
         with self._lock:
             return self._step_overlap_ratio
+
+    @property
+    def paged_occupancy(self) -> float:
+        with self._lock:
+            return self._paged_occupancy
+
+    @property
+    def paged_shared_ratio(self) -> float:
+        with self._lock:
+            return self._paged_shared_ratio
+
+    @property
+    def paged_cow_copies(self) -> int:
+        with self._lock:
+            return self._paged_cow_copies
+
+    @property
+    def paged_swap_preemptions(self) -> int:
+        with self._lock:
+            return self._paged_swap_preemptions
+
+    @property
+    def paged_swap_resumes(self) -> int:
+        with self._lock:
+            return self._paged_swap_resumes
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -504,6 +577,58 @@ class ServingMetrics:
                 "Fraction of device span hidden behind host work "
                 "(~0 synchronous, toward 1 under async dispatch).",
                 self._step_overlap_ratio,
+            )
+            gauge(
+                "serving_paged_pool_occupancy",
+                "Fraction of KV page pool in use (paged layout).",
+                self._paged_occupancy,
+            )
+            gauge(
+                "serving_paged_shared_ratio",
+                "Fraction of used pages referenced by >1 run "
+                "(copy-free prefix sharing).",
+                self._paged_shared_ratio,
+            )
+            gauge(
+                "serving_paged_used_pages",
+                "KV pages currently allocated.",
+                self._paged_used_pages,
+            )
+            gauge(
+                "serving_paged_capacity_pages",
+                "Allocatable KV pages (trash page excluded).",
+                self._paged_capacity,
+            )
+            counter(
+                "serving_paged_pages_allocated_total",
+                "KV pages handed out.",
+                self._paged_pages_allocated,
+            )
+            counter(
+                "serving_paged_pages_freed_total",
+                "KV pages returned to the free list.",
+                self._paged_pages_freed,
+            )
+            counter(
+                "serving_paged_pages_shared_total",
+                "Page references added copy-free by prefix hits.",
+                self._paged_pages_shared,
+            )
+            counter(
+                "serving_paged_cow_copies_total",
+                "Copy-on-write page copies (admission frontier only).",
+                self._paged_cow_copies,
+            )
+            counter(
+                "serving_paged_swap_preemptions_total",
+                "Requests preempted-and-swapped to host under page "
+                "pool pressure.",
+                self._paged_swap_preemptions,
+            )
+            counter(
+                "serving_paged_swap_resumes_total",
+                "Preempted requests resumed by replay.",
+                self._paged_swap_resumes,
             )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()

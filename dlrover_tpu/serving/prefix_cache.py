@@ -36,7 +36,7 @@ zero-copy page-table backend needs, so the property tests pin it now
 """
 
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
 class _Node:
@@ -62,13 +62,22 @@ class RadixPrefixCache:
     row numbers handed out here are its row indices there.
     """
 
-    def __init__(self, n_rows: int, block: int = 16):
+    def __init__(
+        self,
+        n_rows: int,
+        block: int = 16,
+        on_evict: Optional[Callable[[int], None]] = None,
+    ):
         if n_rows < 1:
             raise ValueError(f"n_rows must be >= 1, got {n_rows}")
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
         self.n_rows = n_rows
         self.block = block
+        # fired with the row index whenever a row leaves the tree —
+        # the paged engine hangs page-run refcount drops off this so
+        # an evicted published prefix cannot leak pool pages
+        self.on_evict = on_evict
         self.root = _Node()
         self._row_node: Dict[int, _Node] = {}
         self._free: List[int] = list(range(n_rows))
@@ -184,6 +193,19 @@ class RadixPrefixCache:
                 return row
         return None
 
+    def evict_lru(self) -> bool:
+        """Force out the oldest unreferenced row and return it to the
+        free list. False when every row is pinned (nothing evictable).
+        Used by the paged engine under page-pool pressure: dropping a
+        published prefix run is the cheapest way to reclaim pages —
+        cheaper than preempting a live request."""
+        for row in self._lru:  # oldest-touched first
+            if self._refs.get(row, 0) == 0:
+                self._evict(row)
+                self._free.append(row)
+                return True
+        return False
+
     def _evict(self, row: int) -> None:
         assert self._refs.get(row, 0) == 0, (
             f"evicting row {row} with live references"
@@ -193,6 +215,8 @@ class RadixPrefixCache:
         del self._lru[row]
         self.evictions += 1
         self._prune(node)
+        if self.on_evict is not None:
+            self.on_evict(row)
 
     @staticmethod
     def _prune(node: _Node) -> None:

@@ -312,10 +312,29 @@ class RequestScheduler:
             try:
                 # admit only up to the engine's free slots so EDF
                 # order, not engine-internal FIFO, decides dispatch
+                headroom_ok = getattr(
+                    self.engine, "admission_headroom_ok", None
+                )
                 while (
                     self._waiting
                     and self.engine.queue_len() < self.engine.free_slots()
                 ):
+                    # memory-aware gate (paged KV): when the page pool
+                    # cannot back a worst-case admission and the engine
+                    # already has work, wait for it to drain rather
+                    # than force the engine into preempt-and-swap
+                    # thrash. With the engine empty we admit anyway —
+                    # it reclaims inline, so progress is guaranteed
+                    # either way.
+                    if (
+                        headroom_ok is not None
+                        and not headroom_ok()
+                        and (
+                            self.engine.active_count() > 0
+                            or self.engine.queue_len() > 0
+                        )
+                    ):
+                        break
                     _, _, req = heapq.heappop(self._waiting)
                     if req.state is not RequestState.QUEUED:
                         continue  # cancelled while waiting
@@ -393,6 +412,11 @@ class RequestScheduler:
                     st["host_ms"], st["device_wait_ms"],
                     int(st["dispatches"]), st["overlap_ratio"],
                 )
+            paged_stats = getattr(self.engine, "paged_stats", None)
+            if paged_stats is not None:
+                ps = paged_stats()
+                if ps:
+                    self.metrics.update_paged(ps)
             return bool(self._waiting) or bool(self._running)
 
     # ---- failover --------------------------------------------------------

@@ -159,6 +159,21 @@ def test_serve_bench_smoke_emits_driver_contract():
         "chaos_ttft_p99_ms",
         "chaos_ttft_p99_ratio",
         "n_chaos_requests",
+        # paged phase: the paged-KV evidence axes
+        "dense_tpot_ms_p50",
+        "paged_tpot_ms_p50",
+        "paged_tpot_ratio",
+        "paged_parity_ok",
+        "paged_success_rate",
+        "paged_swap_preemptions",
+        "paged_swap_resumes",
+        "paged_oversub_pool_pages",
+        "paged_pages_per_slot",
+        "paged_page_size",
+        "paged_warm_cow_copies",
+        "paged_pages_shared",
+        "paged_prefix_hit_rate",
+        "n_paged_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -199,3 +214,25 @@ def test_serve_bench_smoke_emits_driver_contract():
     assert detail["chaos_failed_total"] == 0
     assert 0.0 < detail["chaos_ttft_p99_ratio"] <= 25.0
     assert detail["n_chaos_requests"] > 0
+    # the paged-KV acceptance floor: a pool half the dense footprint
+    # completes EVERY request byte-identically (oversubscription costs
+    # preempt-and-swap latency, never correctness or loss), warm
+    # suffix admissions share prefix pages with ZERO copy-on-write,
+    # and the paged layout's steady-state TPOT overhead stays within
+    # 10% of the dense bank
+    assert detail["paged_success_rate"] == 1.0
+    assert detail["paged_parity_ok"] is True
+    assert detail["paged_swap_preemptions"] >= 1
+    assert (
+        detail["paged_swap_resumes"]
+        == detail["paged_swap_preemptions"]
+    )
+    assert detail["paged_warm_cow_copies"] == 0
+    assert detail["paged_pages_shared"] > 0
+    # the TPOT lock rides the PAIRED ratio (median over back-to-back
+    # dense/paged cycles): the two absolute p50s are minima from
+    # different moments of a noisy box, and their quotient flaps
+    assert 0.0 < detail["paged_tpot_ratio"] <= 1.1
+    assert detail["paged_tpot_ms_p50"] > 0
+    assert detail["dense_tpot_ms_p50"] > 0
+    assert detail["n_paged_requests"] > 0

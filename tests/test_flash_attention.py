@@ -70,3 +70,29 @@ def test_supports():
     assert supports(q, k)
     q_bad = q[:, :100]  # seq not divisible by block
     assert not supports(q_bad, k[:, :100])
+
+
+def test_supports_single_query_decode():
+    """Regression: supports() used to reject every s_q != s_k shape,
+    including the q_len==1 decode case where causal masking
+    degenerates to no mask (the paged-attention gate relies on it)."""
+    q, k, _ = _rand_qkv(jax.random.PRNGKey(5))
+    q1 = q[:, :1]
+    assert supports(q1, k)
+    # other cross-length shapes still take the XLA reference
+    assert not supports(q[:, :128], k)
+    # and the usual shape gates still apply at s_q == 1
+    assert not supports(q1[..., :24], k[..., :24])   # head_dim < 32
+
+
+@pytest.mark.parametrize("s_k", [128, 256])
+def test_single_query_matches_reference(s_k):
+    """q_len==1 flash decode == unmasked reference attention: the one
+    query sits on the bottom-right causal row, so causal and
+    non-causal agree and the kernel may drop the mask entirely."""
+    q, k, v = _rand_qkv(jax.random.PRNGKey(6), s=s_k)
+    q1 = q[:, :1]
+    for causal in (True, False):
+        out = flash_attention(q1, k, v, causal=causal)
+        ref = reference_attention(q1, k, v, causal=False)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

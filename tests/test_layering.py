@@ -65,13 +65,15 @@ def test_serving_never_imports_rl():
 # functions in engine.py allowed to materialize host arrays: the ONE
 # designated device fetch point, plus the host-data paths (prompt
 # normalization at submit, PRNG-key capture at admit, output-list
-# conversion at retire/drain) that never touch a dispatch result
+# conversion at retire/drain, prompt-folding at preemption — all of
+# which only touch host-resident numpy data, never a dispatch result)
 _HOST_COPY_ALLOWED = {
     "_to_host",
     "submit",
     "_admit",
     "retire",
     "generate_all",
+    "_preempt_slot",
 }
 
 # calls that synchronously materialize a device array on host
@@ -133,3 +135,87 @@ def test_engine_host_copies_only_in_designated_fetch_helper():
     assert any(
         owner == "_to_host" for _, _, owner in _host_copy_calls(tree)
     )
+
+
+# 3. the paged hot path must not allocate device arrays per step.
+# Page tables, the page pool, and the trash row are built ONCE in
+# __init__/reset and thereafter only updated through the jitted
+# programs (donated buffers). A stray jnp.zeros(...) inside an
+# engine method would allocate + transfer on every call — exactly
+# the per-step overhead the paged layout exists to avoid. Module-
+# level jit builders are exempt: jnp calls there run under trace
+# and compile into the program instead of allocating eagerly.
+_DEVICE_ALLOC_ALLOWED = {"__init__", "reset"}
+
+_DEVICE_ALLOC_CALLS = {
+    ("jnp", "zeros"),
+    ("jnp", "ones"),
+    ("jnp", "full"),
+    ("jnp", "empty"),
+    ("jnp", "arange"),
+    ("jnp", "zeros_like"),
+    ("jnp", "ones_like"),
+    ("jnp", "full_like"),
+}
+
+# bulk device-state constructors (engine.py top-level helpers)
+_DEVICE_ALLOC_NAMES = {"init_kv_cache", "init_page_pool"}
+
+
+def _class_method_alloc_calls(tree, class_name):
+    """(lineno, call, method-name) for every eager device allocation
+    inside methods of `class_name` (module-level functions — the jit
+    program builders — are intentionally out of scope)."""
+    cls = next(
+        (
+            n
+            for n in tree.body
+            if isinstance(n, ast.ClassDef) and n.name == class_name
+        ),
+        None,
+    )
+    assert cls is not None, f"class {class_name} not found"
+    out = []
+    for method in cls.body:
+        if not isinstance(
+            method, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        for node in ast.walk(method):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and (f.value.id, f.attr) in _DEVICE_ALLOC_CALLS
+            ):
+                out.append(
+                    (node.lineno, f"{f.value.id}.{f.attr}", method.name)
+                )
+            elif (
+                isinstance(f, ast.Name)
+                and f.id in _DEVICE_ALLOC_NAMES
+            ):
+                out.append((node.lineno, f.id, method.name))
+    return out
+
+
+def test_engine_hot_path_never_allocates_device_arrays():
+    path = SERVING_DIR / "engine.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    calls = _class_method_alloc_calls(tree, "ContinuousBatcher")
+    offenders = [
+        f"{path}:{lineno}: {call} in {owner}()"
+        for lineno, call, owner in calls
+        if owner not in _DEVICE_ALLOC_ALLOWED
+    ]
+    assert not offenders, (
+        "ContinuousBatcher may allocate device arrays only in "
+        "__init__/reset — the paged hot path updates page tables "
+        "through donated jitted programs, never per-step jnp "
+        "constructors:\n" + "\n".join(offenders)
+    )
+    # vacuity guard: __init__ DOES allocate (pool/table); if the
+    # walker stops seeing those, it stopped seeing anything
+    assert any(owner == "__init__" for _, _, owner in calls)
