@@ -75,7 +75,23 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
-from dlrover_tpu.utils.platform import ensure_cpu_if_forced  # noqa: E402
+from dlrover_tpu.utils.platform import (  # noqa: E402
+    FORCE_CPU_ENV,
+    ensure_cpu_if_forced,
+)
+
+# The mesh phase needs >1 local device to exercise tp=2; on a forced-CPU
+# smoke run ask XLA for 8 virtual host devices. Must happen before the
+# first jax import (ensure_cpu_if_forced imports jax), and must not
+# clobber an operator-supplied flag set.
+if os.environ.get(FORCE_CPU_ENV) == "1" and (
+    "xla_force_host_platform_device_count"
+    not in os.environ.get("XLA_FLAGS", "")
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 ensure_cpu_if_forced()
 
@@ -644,6 +660,57 @@ def main():
     share_stats = share_eng.paged_stats()
     paged_hit_rate = share_eng.prefix_cache.stats()["hit_rate"]
 
+    # ---- phase 7: tensor-parallel mesh slice (tp=1 vs tp=2) -----------
+    # A replica as a named mesh slice: mesh_spec=2 shards params and the
+    # KV bank along the head axis and lets GSPMD insert the collectives.
+    # Parity is the whole contract — tp=2 must be byte-identical to the
+    # dense tp=1 outputs already computed above (dense_out), because
+    # head-sharding only splits matmul OUTPUT columns and replicates the
+    # attention output before the out projection: same arithmetic,
+    # chunked by head. Degrades to tp=1-only when the host has a single
+    # device (real-TPU single-chip runs).
+    mesh_devices = jax.local_device_count()
+    _mesh_kv = cfg.n_kv_heads or cfg.n_heads
+    mesh_tp = 2 if (mesh_devices >= 2 and _mesh_kv % 2 == 0) else 1
+    mesh_tp1_tpot_p50 = paged_dense_tpot_p50
+    mesh_tp2_tpot_p50 = 0.0
+    mesh_parity_ok = True
+    n_mesh_requests = 0
+    if mesh_tp > 1:
+        tp2_eng = ContinuousBatcher(
+            cfg, params, n_slots=n_slots, max_len=max_len,
+            max_new_tokens=max_new, chunk=chunk, pad_id=-1,
+            mesh_spec=mesh_tp,
+        )
+        tp2_out = [o.tolist() for o in tp2_eng.generate_all(prompts)]
+        mesh_parity_ok = tp2_out == dense_out
+        n_mesh_requests = len(tp2_out)
+        # TPOT through the same harness as the paged phase so the tp=1
+        # side can reuse the dense minima measured there; two passes
+        # and take the min (the first pays jit warmup noise)
+        mesh_tp2_tpot_p50 = min(
+            _layout_pass(mesh_spec=mesh_tp)[0] for _ in range(2)
+        )
+    # exposition: a mesh-aware scheduler pump publishes the slice shape
+    # through ServingMetrics; the per-replica chip gauge is what the
+    # chip-denominated autoscaler path is fed from
+    mesh_eng = ContinuousBatcher(
+        cfg, params, n_slots=n_slots, max_len=max_len,
+        max_new_tokens=max_new, chunk=chunk, pad_id=-1,
+        mesh_spec=mesh_tp,
+    )
+    mesh_metrics = ServingMetrics()
+    mesh_sched = RequestScheduler(
+        mesh_eng, lp_slo, metrics=mesh_metrics
+    )
+    mesh_sched.submit(prompts[0], max_new=2)
+    mesh_sched.run_to_completion()
+    _mesh_render = mesh_metrics.render()
+    mesh_metrics_ok = (
+        f"serving_mesh_tp {mesh_tp}" in _mesh_render
+        and f"serving_replica_chips {mesh_tp}" in _mesh_render
+    )
+
     print(
         json.dumps(
             {
@@ -771,6 +838,18 @@ def main():
                         paged_hit_rate, 3
                     ),
                     "n_paged_requests": len(oversub_out),
+                    # mesh phase: tensor-parallel slice evidence axes
+                    "mesh_tp": mesh_tp,
+                    "mesh_devices": mesh_devices,
+                    "mesh_tp1_tpot_ms_p50": round(
+                        mesh_tp1_tpot_p50, 3
+                    ),
+                    "mesh_tp2_tpot_ms_p50": round(
+                        mesh_tp2_tpot_p50, 3
+                    ),
+                    "mesh_parity_ok": mesh_parity_ok,
+                    "mesh_metrics_ok": mesh_metrics_ok,
+                    "n_mesh_requests": n_mesh_requests,
                 },
             }
         ),

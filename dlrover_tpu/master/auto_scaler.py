@@ -171,6 +171,9 @@ class ServingScaleAdvisor:
         self.max_replicas = max_replicas
         self.executed_plans = 0
         self._last_hint_ts = 0.0
+        # chips implied by the last acted-on hint (replicas × slice
+        # size) — the capacity number a chip-budgeted operator reads
+        self.last_chip_demand = 0
 
     def poll_once(self) -> Optional[ScalePlan]:
         """Read the latest hint from the KV store; act on a fresh
@@ -197,8 +200,19 @@ class ServingScaleAdvisor:
         direction = hint.get("direction")
         if direction not in ("up", "down"):
             return plan
-        target = int(hint.get("replicas", hint.get("current", 0)))
+        # chip-denominated: a replica is a mesh slice of
+        # `chips_per_replica` devices, so the demand the pool reports
+        # (and the plan the scaler executes) is chips, converted to
+        # whole replicas by ceiling division. Pre-mesh hints carry
+        # neither field and behave exactly as before (cpr=1,
+        # chips == replicas).
+        cpr = max(1, int(hint.get("chips_per_replica", 1)))
+        if "chips" in hint:
+            target = -(-int(hint["chips"]) // cpr)
+        else:
+            target = int(hint.get("replicas", hint.get("current", 0)))
         target = min(self.max_replicas, max(self.min_replicas, target))
+        self.last_chip_demand = target * cpr
         if target == int(hint.get("current", -1)):
             return plan  # bounds clamped the move away
         plan.node_group_resources[self.node_type] = NodeGroupResource(
@@ -206,8 +220,9 @@ class ServingScaleAdvisor:
         )
         logger.info(
             "serving scale hint %s: replica group -> %d "
-            "(pressure %.2f)",
-            direction, target, hint.get("pressure", -1.0),
+            "(%d chips at %d/replica, pressure %.2f)",
+            direction, target, target * cpr, cpr,
+            hint.get("pressure", -1.0),
         )
         if self._scaler is not None:
             self.executed_plans += 1

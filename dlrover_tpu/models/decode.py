@@ -28,8 +28,34 @@ from dlrover_tpu.models.llama import (
     _mlp_residual,
     _rms_norm,
 )
+from dlrover_tpu.parallel.mesh import SERVING_TP_AXIS
+from dlrover_tpu.parallel.sharding import constrain
 
 Params = Dict
+
+
+def _mesh_tp(mesh) -> int:
+    """Size of the serving tensor axis (1 when no mesh is threaded)."""
+    if mesh is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        SERVING_TP_AXIS, 1
+    )
+
+
+# Why byte parity survives head sharding (the tp>1 oracle of
+# tests/test_serving_mesh.py): only OUTPUT dimensions of matmuls are
+# ever sharded — the QKV projections split their head/output columns,
+# so every output element still reduces over the full model dim in
+# the same order as the unsharded program. Attention is per-KV-head
+# local (scores contract head_dim, softmax runs over cache cells, the
+# value einsum contracts cache cells — all within one head), and the
+# attention output is constrained back to REPLICATED before the out
+# projection, which reconstructs the exact per-shard values via
+# all-gather. No contraction dimension is ever split, so XLA never
+# introduces a partial-sum all-reduce whose float additions could
+# reassociate — tp=N runs the same arithmetic as tp=1, chunked by
+# head.
 
 
 def init_kv_cache(
@@ -140,6 +166,7 @@ def _write_cache_and_attend(
     q, k, v, layer_cache, positions, start, head_dim,
     attn_impl: str = "auto",
     plain_causal: bool = False,
+    mesh=None,
 ):
     """THE decode-specific core, shared by both family blocks: write
     this chunk's K/V into the cache at `start` and attend over the
@@ -162,7 +189,17 @@ def _write_cache_and_attend(
 
     `layer_cache` is this layer's {"k","v"[,"k_scale","v_scale"]};
     quantized caches get the chunk's K/V int8-quantized on write and
-    dequantized inside the masked attention."""
+    dequantized inside the masked attention.
+
+    `mesh` (optional serving mesh) pins the GSPMD layout: q/k/v stay
+    split on their head axis so the cache write and the per-head
+    attention run shard-local, and the attention output is replicated
+    (all-gather) before returning so every downstream op — out
+    projection, MLP, logits — is the identical full-width program on
+    every shard (the byte-parity argument at the top of this file)."""
+    q = constrain(q, mesh, None, None, SERVING_TP_AXIS, None)
+    k = constrain(k, mesh, None, None, SERVING_TP_AXIS, None)
+    v = constrain(v, mesh, None, None, SERVING_TP_AXIS, None)
     out_cache = dict(layer_cache)
     if "k_scale" in layer_cache:
         kq, ks = _kv_quantize(k)
@@ -186,14 +223,15 @@ def _write_cache_and_attend(
         # size divides (fine to enforce at training seq lengths,
         # wrong to crash inference over) — auto still picks the flash
         # kernel whenever the prompt tiles
+        impl = "reference" if attn_impl == "reference" else "auto"
         attn = dot_product_attention(
-            q, k, v, causal=True,
-            impl="reference" if attn_impl == "reference" else "auto",
+            q, k, v, causal=True, impl=impl, tp=_mesh_tp(mesh),
         )
     else:
         attn = _cached_attention(
             q, out_cache, positions, float(head_dim) ** -0.5
         )
+    attn = constrain(attn, mesh)
     return attn, out_cache
 
 
@@ -205,12 +243,15 @@ def _block(
     positions: jax.Array,    # [B, S] global positions of x's tokens
     start,                   # scalar: cache slot of x's first token
     plain_causal: bool = False,
+    mesh=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One decoder block writing its K/V into the cache. Prefill is
     S=prompt_len/start=0; decode is S=1/start=pos. The projections,
     RoPE, residuals and MLP are llama._layer's own helpers — the cache
     write + position-masked attention are the only decode-specific
-    parts."""
+    parts. `_attn_qkv`/`_attn_residual` get mesh=None on purpose:
+    their constraints speak the TRAINING axis names; the serving tp
+    layout is pinned inside `_write_cache_and_attend`."""
     lp = _compute_weights(cfg, layer_params)
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
     q, k, v = _attn_qkv(cfg, None, h, lp, positions)
@@ -218,6 +259,7 @@ def _block(
         q, k, v, layer_cache, positions, start, cfg.head_dim,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
         plain_causal=plain_causal,
+        mesh=mesh,
     )
     x = _attn_residual(cfg, None, x, attn, lp)
     x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
@@ -227,6 +269,7 @@ def _block(
 def _block_gpt(
     cfg, x, lp, layer_cache, positions, start,
     plain_causal: bool = False,
+    mesh=None,
 ):
     """GPT-2 pre-LN block with cache write — built from gpt.py's own
     helpers; the cache write + masked attention are the only
@@ -238,6 +281,7 @@ def _block_gpt(
         q, k, v, layer_cache, positions, start, cfg.head_dim,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
         plain_causal=plain_causal,
+        mesh=mesh,
     )
     x = gpt._attn_residual(cfg, x, attn, lp)
     x = gpt._mlp_residual(cfg, x, lp)
@@ -266,6 +310,7 @@ def _check_positional_capacity(cfg, max_len: int):
 def _forward_cached(
     cfg, params, tokens, cache, positions, start,
     plain_causal: bool = False,
+    mesh=None,
 ):
     """tokens [B,S] → logits [B,S,V], writing the cache at
     [start, start+S). Family dispatch: llama (RoPE/GQA/RMSNorm) or
@@ -287,6 +332,7 @@ def _forward_cached(
         h, layer_cache = block(
             cfg, h, layer_params, layer_cache, positions, start,
             plain_causal=plain_causal,
+            mesh=mesh,
         )
         return h, layer_cache
 
@@ -316,6 +362,7 @@ def prefill(
     params: Params,
     tokens: jax.Array,  # [B, P]
     cache: Dict[str, jax.Array],
+    mesh=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Fill the cache from a prompt; returns (last-token logits, cache)."""
     b, p = tokens.shape
@@ -325,6 +372,7 @@ def prefill(
     logits, cache = _forward_cached(
         cfg, params, tokens, cache, positions, 0,
         plain_causal=p > 1,
+        mesh=mesh,
     )
     return logits[:, -1], cache
 
@@ -335,6 +383,7 @@ def decode_step(
     token: jax.Array,   # [B] current token
     cache: Dict[str, jax.Array],
     pos,                # position of `token`: scalar, or [B] per slot
+    mesh=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One cached step → (next-token logits [B,V], updated cache).
 
@@ -349,7 +398,7 @@ def decode_step(
     else:
         positions = jnp.broadcast_to(pos, (b, 1))
     logits, cache = _forward_cached(
-        cfg, params, token[:, None], cache, positions, pos
+        cfg, params, token[:, None], cache, positions, pos, mesh=mesh
     )
     return logits[:, 0], cache
 
@@ -360,6 +409,7 @@ def verify_step(
     tokens: jax.Array,  # [B, S]: carry token + S-1 draft tokens
     cache: Dict[str, jax.Array],
     pos,                # [B] position of tokens[:, 0] per slot
+    mesh=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Batched speculative verify: run the target model over all S
     positions per row in ONE compiled forward (the speculative
@@ -383,7 +433,7 @@ def verify_step(
     pos = jnp.asarray(pos, jnp.int32)
     positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
     logits, cache = _forward_cached(
-        cfg, params, tokens, cache, positions, pos
+        cfg, params, tokens, cache, positions, pos, mesh=mesh
     )
     return logits, cache
 
@@ -466,6 +516,7 @@ def prefill_into_slot(
     prompt: jax.Array,  # [P] (pad tail beyond the real length is fine)
     cache: Dict[str, jax.Array],
     slot,
+    mesh=None,
 ) -> Dict[str, jax.Array]:
     """Run a single-sequence prefill and install its K/V into row
     `slot` of a multi-slot cache — the admission step of continuous
@@ -483,7 +534,7 @@ def prefill_into_slot(
             f"{cache['k'].shape[2]}"
         )
     mini = init_kv_cache(cfg, 1, p, quant="k_scale" in cache)
-    _, mini = prefill(cfg, params, prompt[None], mini)
+    _, mini = prefill(cfg, params, prompt[None], mini, mesh=mesh)
     out = {}
     for name, arr in cache.items():
         out[name] = jax.lax.dynamic_update_slice(
@@ -519,19 +570,20 @@ def exact_row_cache(cfg, max_len: int) -> Dict[str, jax.Array]:
 
 
 def prefill_exact_row(
-    cfg, params, prompt: jax.Array, max_len: int
+    cfg, params, prompt: jax.Array, max_len: int, mesh=None
 ) -> Dict[str, jax.Array]:
     """Cold-admission prefill: run `prompt` [P] (pad tail fine) into a
     fresh exact row. The forward is identical to prefill_into_slot's
     (plain-causal attention never reads the cache, so an unquantized
     target changes nothing about the computed K/V)."""
     row = exact_row_cache(cfg, max_len)
-    _, row = prefill(cfg, params, prompt[None], row)
+    _, row = prefill(cfg, params, prompt[None], row, mesh=mesh)
     return row
 
 
 def prefill_suffix_row(
-    cfg, params, suffix: jax.Array, row: Dict[str, jax.Array], start
+    cfg, params, suffix: jax.Array, row: Dict[str, jax.Array], start,
+    mesh=None,
 ) -> Dict[str, jax.Array]:
     """Warm-admission prefill: extend an exact row that already holds
     K/V for positions [0, start) with `suffix` [S] at positions
@@ -545,7 +597,7 @@ def prefill_suffix_row(
     s = suffix.shape[0]
     positions = (jnp.asarray(start, jnp.int32) + jnp.arange(s))[None]
     _, row = _forward_cached(
-        cfg, params, suffix[None], row, positions, start
+        cfg, params, suffix[None], row, positions, start, mesh=mesh
     )
     return row
 
@@ -667,7 +719,7 @@ def _paged_view(
 
 
 def _write_pages_and_attend(
-    q, k, v, layer_pool, table, positions, head_dim
+    q, k, v, layer_pool, table, positions, head_dim, mesh=None
 ):
     """The paged counterpart of `_write_cache_and_attend`: scatter
     this chunk's K/V into the slot's PAGES (row b, chunk position s →
@@ -681,6 +733,9 @@ def _write_pages_and_attend(
     cells no live mask ever admits. Quantized pools quantize the
     chunk with the same `_kv_quantize` as the dense write path, so
     the stored bytes are identical either way."""
+    q = constrain(q, mesh, None, None, SERVING_TP_AXIS, None)
+    k = constrain(k, mesh, None, None, SERVING_TP_AXIS, None)
+    v = constrain(v, mesh, None, None, SERVING_TP_AXIS, None)
     ps = layer_pool["k"].shape[1]
     pids = jnp.take_along_axis(table, positions // ps, axis=1)
     offs = positions % ps
@@ -699,22 +754,23 @@ def _write_pages_and_attend(
         from dlrover_tpu.ops import paged_attention as pa
 
         q1 = q[:, 0]
-        if pa.use_kernel(q1, out_pool, table):
+        if pa.use_kernel(q1, out_pool, table, tp=_mesh_tp(mesh)):
             lengths = positions[:, 0] + 1
             attn = pa.paged_attention(
                 q1, out_pool, table, lengths,
                 scale=float(head_dim) ** -0.5, impl="kernel",
             )
-            return attn[:, None], out_pool
+            return constrain(attn[:, None], mesh), out_pool
     view = _paged_view(out_pool, table)
     attn = _cached_attention(
         q, view, positions, float(head_dim) ** -0.5
     )
+    attn = constrain(attn, mesh)
     return attn, out_pool
 
 
 def _block_paged(
-    cfg, x, layer_params, layer_pool, table, positions
+    cfg, x, layer_params, layer_pool, table, positions, mesh=None
 ):
     """Llama block over paged KV — identical projections/residuals to
     `_block`; only the cache write + view differ."""
@@ -722,26 +778,32 @@ def _block_paged(
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
     q, k, v = _attn_qkv(cfg, None, h, lp, positions)
     attn, layer_pool = _write_pages_and_attend(
-        q, k, v, layer_pool, table, positions, cfg.head_dim
+        q, k, v, layer_pool, table, positions, cfg.head_dim,
+        mesh=mesh,
     )
     x = _attn_residual(cfg, None, x, attn, lp)
     x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
     return x, layer_pool
 
 
-def _block_gpt_paged(cfg, x, lp, layer_pool, table, positions):
+def _block_gpt_paged(
+    cfg, x, lp, layer_pool, table, positions, mesh=None
+):
     from dlrover_tpu.models import gpt
 
     q, k, v = gpt._attn_qkv(cfg, x, lp)
     attn, layer_pool = _write_pages_and_attend(
-        q, k, v, layer_pool, table, positions, cfg.head_dim
+        q, k, v, layer_pool, table, positions, cfg.head_dim,
+        mesh=mesh,
     )
     x = gpt._attn_residual(cfg, x, attn, lp)
     x = gpt._mlp_residual(cfg, x, lp)
     return x, layer_pool
 
 
-def _forward_paged(cfg, params, tokens, pool, table, positions):
+def _forward_paged(
+    cfg, params, tokens, pool, table, positions, mesh=None
+):
     """tokens [B, S] → logits [B, S, V] over the paged pool; the
     layer scan mirrors `_forward_cached` (the pool pytree scans over
     its leading layer axis; the table is shared by every layer)."""
@@ -760,7 +822,8 @@ def _forward_paged(cfg, params, tokens, pool, table, positions):
         h = carry
         layer_params, layer_pool = inp
         h, layer_pool = block(
-            cfg, h, layer_params, layer_pool, table, positions
+            cfg, h, layer_params, layer_pool, table, positions,
+            mesh=mesh,
         )
         return h, layer_pool
 
@@ -784,7 +847,7 @@ def _forward_paged(cfg, params, tokens, pool, table, positions):
 
 
 def paged_decode_step(
-    cfg, params, token: jax.Array, pool, table, pos
+    cfg, params, token: jax.Array, pool, table, pos, mesh=None
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One cached step over paged KV → (logits [B, V], pool). The
     paged twin of `decode_step` ([B] per-slot positions only — the
@@ -792,13 +855,14 @@ def paged_decode_step(
     pos = jnp.asarray(pos, jnp.int32)
     positions = pos[:, None]
     logits, pool = _forward_paged(
-        cfg, params, token[:, None], pool, table, positions
+        cfg, params, token[:, None], pool, table, positions,
+        mesh=mesh,
     )
     return logits[:, 0], pool
 
 
 def paged_verify_step(
-    cfg, params, tokens: jax.Array, pool, table, pos
+    cfg, params, tokens: jax.Array, pool, table, pos, mesh=None
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Batched speculative verify over paged KV — the paged twin of
     `verify_step`. The engine sizes each request's page run for
@@ -808,7 +872,7 @@ def paged_verify_step(
     pos = jnp.asarray(pos, jnp.int32)
     positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
     logits, pool = _forward_paged(
-        cfg, params, tokens, pool, table, positions
+        cfg, params, tokens, pool, table, positions, mesh=mesh
     )
     return logits, pool
 

@@ -78,11 +78,18 @@ def dot_product_attention(
     impl: str = "auto",
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    tp: int = 1,
 ) -> jax.Array:
     """Main entry. impl: 'auto' | 'flash' | 'reference'.
 
     'auto' uses the Pallas flash kernel on TPU when shapes allow
     (seq % block == 0, head_dim tile-able), else the XLA reference.
+
+    `tp` > 1 declares the caller runs under GSPMD head sharding
+    (serving mesh): 'auto' then always takes the reference — the
+    flash kernel is not shard_mapped yet, and an unpartitioned
+    pallas_call inside a sharded program would force a regather,
+    while the reference einsums partition per head for free.
     """
     if impl == "reference":
         return reference_attention(q, k, v, causal, scale, segment_ids)
@@ -96,6 +103,7 @@ def dot_product_attention(
             )
         take_flash = impl == "flash" or (
             _tpu_available()
+            and tp == 1
             and fa.supports(
                 q, k, segment_ids, block_q=block_q, block_k=block_k
             )

@@ -75,10 +75,23 @@ def auto_blocks(s_q: int, s_k: int, d: int) -> Tuple[int, int]:
     return bq, bk
 
 
-def supports(q, k, segment_ids=None, block_q=None, block_k=None) -> bool:
-    """Whether the flash path handles these shapes (else XLA reference)."""
+def supports(
+    q, k, segment_ids=None, block_q=None, block_k=None, tp: int = 1
+) -> bool:
+    """Whether the flash path handles these shapes (else XLA reference).
+
+    `tp` is the serving tensor-parallel degree: under GSPMD head
+    sharding the kernel would run on PER-SHARD heads, so the head
+    constraints are evaluated after dividing both head counts by tp —
+    a global head count that doesn't split evenly can't shard at all,
+    and the GQA group check must hold within one shard."""
     if segment_ids is not None:
         return False
+    h, kv = q.shape[2], k.shape[2]
+    if tp > 1:
+        if h % tp != 0 or kv % tp != 0:
+            return False
+        h, kv = h // tp, kv // tp
     d = q.shape[-1]
     s_q = q.shape[1]
     s_k = k.shape[1]
@@ -103,7 +116,7 @@ def supports(q, k, segment_ids=None, block_q=None, block_k=None) -> bool:
         return False
     if s_q % bq != 0 or s_k % bk != 0:
         return False
-    if q.shape[2] % k.shape[2] != 0:
+    if h % kv != 0:
         return False
     return True
 

@@ -48,15 +48,25 @@ from dlrover_tpu.ops import flash_attention as fa
 NEG_INF = -1e30
 
 
-def supports(q, pages: Dict, table) -> bool:
+def supports(q, pages: Dict, table, tp: int = 1) -> bool:
     """Whether the Pallas kernel handles these shapes. `q` is the
     [B, H, hd] single-token query, `pages` the per-layer pool dict,
     `table` the [B, P] page table. Reuses flash_attention's q_len==1
-    gate for the head_dim constraints, then checks the page axis."""
+    gate for the head_dim constraints, then checks the page axis.
+
+    `tp` is the serving tensor-parallel degree: the gate judges the
+    PER-SHARD head counts (heads / tp), because that is what the
+    kernel would see under GSPMD head sharding — a global count that
+    doesn't divide over tp fails outright."""
     b, h, d = q.shape
     n_pages, page_size, kv, _ = pages["k"].shape
-    # flash's single-query gate owns the d / GQA lane constraints; the
-    # key-side "sequence" a page kernel streams is one page long
+    if tp > 1:
+        if h % tp != 0 or kv % tp != 0:
+            return False
+        h, kv = h // tp, kv // tp
+    # flash's single-query gate owns the d / GQA lane constraints
+    # (probed with the per-shard head counts); the key-side
+    # "sequence" a page kernel streams is one page long
     q_probe = jax.ShapeDtypeStruct((b, 1, h, d), q.dtype)
     k_probe = jax.ShapeDtypeStruct((b, 1, kv, d), q.dtype)
     if not fa.supports(q_probe, k_probe, block_q=1, block_k=1):
@@ -72,13 +82,19 @@ def supports(q, pages: Dict, table) -> bool:
     return True
 
 
-def use_kernel(q, pages: Dict, table) -> bool:
+def use_kernel(q, pages: Dict, table, tp: int = 1) -> bool:
     """Static (trace-time) dispatch decision for the engine: the
     kernel only on a real TPU backend — CPU always takes the
-    reference, which is the byte-parity formulation."""
+    reference, which is the byte-parity formulation. tp > 1 also
+    takes the reference: the kernel is not shard_mapped yet, and an
+    unpartitioned pallas_call inside a GSPMD-sharded program would
+    force a full regather, while the gather+einsum reference
+    partitions per head with no communication."""
     if jax.default_backend() != "tpu":
         return False
-    return supports(q, pages, table)
+    if tp > 1:
+        return False
+    return supports(q, pages, table, tp=tp)
 
 
 # ---------------------------------------------------------------------------

@@ -237,3 +237,73 @@ def local_mesh_spec(n_devices: Optional[int] = None) -> MeshSpec:
     if n_devices is None:
         n_devices = jax.local_device_count()
     return MeshSpec.fit(n_devices)
+
+
+# --------------------------------------------------------------------------
+# Serving replica meshes: a replica is a 1-D tensor slice of the LOCAL
+# devices (heartbeats/auto-scaling count chips = replicas × slice size).
+# Serving code must build meshes through these helpers — never a raw
+# jax.sharding.Mesh — so the mesh layer stays single-sourced here
+# (enforced by tests/test_layering.py).
+
+SERVING_TP_AXIS = "tp"
+
+
+def serving_mesh_spec(
+    tp: int = 1,
+    n_kv_heads: Optional[int] = None,
+    n_devices: Optional[int] = None,
+) -> MeshSpec:
+    """Validated `local_mesh_spec` sibling for a serving replica: a pure
+    tensor slice (``MeshSpec(tensor=tp)``) of the local devices. Raises
+    ``ValueError`` when the host has fewer devices than the slice or when
+    `n_kv_heads` (if given) does not divide evenly over `tp` — the KV
+    banks shard the head axis, so a non-divisible head count cannot be
+    laid out."""
+    if n_devices is None:
+        n_devices = jax.local_device_count()
+    if tp < 1:
+        raise ValueError(f"serving mesh tp must be >= 1, got {tp}")
+    if tp > n_devices:
+        raise ValueError(
+            f"serving mesh needs tp={tp} local devices, host has only "
+            f"{n_devices} — shrink mesh_spec or run on a larger slice"
+        )
+    if n_kv_heads is not None and n_kv_heads % tp != 0:
+        raise ValueError(
+            f"n_kv_heads={n_kv_heads} is not divisible by tp={tp}: the "
+            f"KV cache shards the head axis, so tp must divide the KV "
+            f"head count — use tp in "
+            f"{[t for t in range(1, n_kv_heads + 1) if n_kv_heads % t == 0]}"
+        )
+    return MeshSpec(tensor=tp)
+
+
+def serving_mesh(
+    tp: int = 1,
+    devices: Optional[Sequence] = None,
+    n_kv_heads: Optional[int] = None,
+) -> Mesh:
+    """1-D ``("tp",)`` mesh over the first `tp` local devices. Built via
+    ``MeshSpec.build`` (topology-aware layout on real TPUs, reshape
+    fallback on virtual/CPU devices) then flattened to the single
+    serving axis, so serving and training share one mesh layer."""
+    if devices is None:
+        devices = jax.local_devices()
+    spec = serving_mesh_spec(
+        tp, n_kv_heads=n_kv_heads, n_devices=len(devices)
+    )
+    full = spec.build(devices)
+    return Mesh(
+        full.devices.reshape((tp,)), (SERVING_TP_AXIS,)
+    )
+
+
+def serving_kv_spec() -> PartitionSpec:
+    """Spec for the serving KV banks — dense slot bank
+    ``[L, slots, cells, KV, hd]``, paged pool
+    ``[L, pages, page_size, KV, hd]`` and prefix pool all keep the KV
+    head axis at dim 3; quantization scales share the layout with
+    hd==1. Only the head axis is sharded: rows/cells are host-planned
+    (slot tables, page tables) and must stay addressable everywhere."""
+    return PartitionSpec(None, None, None, SERVING_TP_AXIS)

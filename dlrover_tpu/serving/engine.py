@@ -107,6 +107,13 @@ from dlrover_tpu.models.decode import (
     spec_accept_sampled,
     verify_step,
 )
+from dlrover_tpu.parallel.mesh import (
+    named,
+    serving_kv_spec,
+    serving_mesh,
+    serving_mesh_spec,
+)
+from dlrover_tpu.parallel.sharding import replicated, shard_tree
 from dlrover_tpu.serving.paged_kv import (
     TRASH_PAGE,
     OutOfPages,
@@ -114,6 +121,58 @@ from dlrover_tpu.serving.paged_kv import (
 )
 from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
 from dlrover_tpu.serving.speculative import SpeculativeDecoder
+
+
+# GSPMD param layout for a serving replica (ISSUE/ DEVIATIONS §11):
+# ONLY the QKV projections shard, on their head/output columns —
+# splitting a matmul's output dim leaves every output element's
+# contraction intact, which is what keeps tp>1 byte-identical to tp=1
+# (see the parity note atop models/decode.py). Out projection, MLP,
+# embedding, head and norms stay replicated: they run after the
+# attention output is all-gathered back to full width, so sharding
+# them would split a contraction and reassociate float adds. GPT's
+# fused-qkv weight matches no rule and stays replicated; its q/k/v
+# still shard through the activation constraints.
+_SERVING_PARAM_RULES = (
+    (r"layers/wq$", ("tp",)),
+    (r"layers/wk$", ("tp",)),
+    (r"layers/wv$", ("tp",)),
+)
+
+
+def _serving_param_shardings():
+    from jax.sharding import PartitionSpec
+
+    return [
+        (pat, PartitionSpec(None, None, *axes))
+        for pat, axes in _SERVING_PARAM_RULES
+    ]
+
+
+def _parse_mesh_tp(mesh_spec) -> int:
+    """The `mesh_spec` knob accepts an int tp degree, a {"tp": n}
+    dict, or a parallel.mesh.MeshSpec (its tensor axis)."""
+    if isinstance(mesh_spec, bool):
+        raise ValueError(f"mesh_spec must be an int tp degree, a "
+                         f"{{'tp': n}} dict or a MeshSpec, got "
+                         f"{mesh_spec!r}")
+    if isinstance(mesh_spec, int):
+        return mesh_spec
+    if isinstance(mesh_spec, dict):
+        extra = set(mesh_spec) - {"tp"}
+        if extra:
+            raise ValueError(
+                f"mesh_spec dict supports only the 'tp' axis for "
+                f"serving, got extra axes {sorted(extra)}"
+            )
+        return int(mesh_spec.get("tp", 1))
+    tensor = getattr(mesh_spec, "tensor", None)
+    if tensor is not None:
+        return int(tensor)
+    raise ValueError(
+        f"mesh_spec must be an int tp degree, a {{'tp': n}} dict or "
+        f"a MeshSpec, got {mesh_spec!r}"
+    )
 
 
 def _pad_bucket(n: int, lo: int = 16) -> int:
@@ -173,7 +232,7 @@ def _cached_program(cache: Dict[Any, Any], key, build):
 
 
 def _build_chunk_program(
-    cfg, pad_id, eos_id, temperature, top_k, top_p
+    cfg, pad_id, eos_id, temperature, top_k, top_p, mesh=None
 ):
     def _warp(logits):
         logits = logits / temperature
@@ -222,7 +281,9 @@ def _build_chunk_program(
     def _run_chunk(cache, params, tok, pos, done, limit, keys, k):
         def body(carry, _):
             cache, tok, pos, done, keys = carry
-            logits, cache = decode_step(cfg, params, tok, cache, pos)
+            logits, cache = decode_step(
+                cfg, params, tok, cache, pos, mesh=mesh
+            )
             tok, pos, done, keys, nxt = _advance(
                 logits, tok, pos, done, limit, keys
             )
@@ -265,7 +326,7 @@ def _build_chunk_program(
             def body(carry, _):
                 pool, tok, pos, done, keys = carry
                 logits, pool = paged_decode_step(
-                    cfg, params, tok, pool, table, pos
+                    cfg, params, tok, pool, table, pos, mesh=mesh
                 )
                 tok, pos, done, keys, nxt = _advance(
                     logits, tok, pos, done, limit, keys
@@ -282,7 +343,9 @@ def _build_chunk_program(
 
         def body(carry, _):
             cache, tok, pos, done, keys = carry
-            logits, cache = decode_step(cfg, params, tok, cache, pos)
+            logits, cache = decode_step(
+                cfg, params, tok, cache, pos, mesh=mesh
+            )
             tok, pos, done, keys, nxt = _advance(
                 logits, tok, pos, done, limit, keys
             )
@@ -298,7 +361,7 @@ def _build_chunk_program(
 
 
 def _build_spec_program(
-    cfg, pad_id, eos_id, temperature, top_k, top_p
+    cfg, pad_id, eos_id, temperature, top_k, top_p, mesh=None
 ):
     """The speculative alternative to the chunk scan: ONE verify
     forward over K+1 positions per slot, acceptance on device, and
@@ -386,7 +449,9 @@ def _build_spec_program(
         cache, params, tok, pos, done, limit, keys, drafts, draft_len
     ):
         tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
-        logits, cache = verify_step(cfg, params, tokens, cache, pos)
+        logits, cache = verify_step(
+            cfg, params, tokens, cache, pos, mesh=mesh
+        )
         out = _accept(
             logits, tok, pos, done, limit, keys, drafts, draft_len
         )
@@ -411,11 +476,13 @@ def _build_spec_program(
         table = jnp.where(done[:, None], 0, table)
         if on_tpu:
             logits, pool = paged_verify_step(
-                cfg, params, tokens, pool, table, pos
+                cfg, params, tokens, pool, table, pos, mesh=mesh
             )
         else:
             view = gather_pool_view(pool, table)
-            logits, view = verify_step(cfg, params, tokens, view, pos)
+            logits, view = verify_step(
+                cfg, params, tokens, view, pos, mesh=mesh
+            )
             pool = scatter_pool_window(
                 pool, view, table, pos, tokens.shape[1]
             )
@@ -427,7 +494,7 @@ def _build_spec_program(
     return {"dense": _run_spec, "paged": _run_spec_paged}
 
 
-def _build_admit_programs(cfg, max_len):
+def _build_admit_programs(cfg, max_len, mesh=None):
     """Admission + prefix-pool programs. Each retraces once per
     prompt/suffix BUCKET (log2(max_len) shapes total); slot/row/start
     are traced scalars so no recompile per slot, row, or prefix
@@ -436,14 +503,16 @@ def _build_admit_programs(cfg, max_len):
 
     @partial(jax.jit, donate_argnums=(0,))
     def _admit_fn(cache, params, prompt, slot):
-        return prefill_into_slot(cfg, params, prompt, cache, slot)
+        return prefill_into_slot(
+            cfg, params, prompt, cache, slot, mesh=mesh
+        )
 
     @partial(jax.jit, donate_argnums=(0,))
     def _admit_cold_fn(cache, params, prompt, slot):
         """Full prefill into an exact working row, installed into
         the slot (quantizing iff the bank is int8). Returns the
         row too so the host can publish its prefix."""
-        row = prefill_exact_row(cfg, params, prompt, max_len)
+        row = prefill_exact_row(cfg, params, prompt, max_len, mesh=mesh)
         return install_exact_row(cache, row, slot), row
 
     @partial(jax.jit, donate_argnums=(0,))
@@ -452,7 +521,9 @@ def _build_admit_programs(cfg, max_len):
         the matched prefix) into a working row, run ONLY the
         suffix forward at positions [start, start+S), install."""
         work = pool_take_row(pool, row)
-        work = prefill_suffix_row(cfg, params, suffix, work, start)
+        work = prefill_suffix_row(
+            cfg, params, suffix, work, start, mesh=mesh
+        )
         return install_exact_row(cache, work, slot), work
 
     @partial(jax.jit, donate_argnums=(0,))
@@ -487,7 +558,7 @@ def _build_admit_programs(cfg, max_len):
 
     @partial(jax.jit, donate_argnums=(0,))
     def _paged_cold_fn(pages, table, params, prompt, slot, table_row):
-        row = prefill_exact_row(cfg, params, prompt, max_len)
+        row = prefill_exact_row(cfg, params, prompt, max_len, mesh=mesh)
         pages = paged_install_row(
             pages, row, table_row, 0, prompt.shape[0]
         )
@@ -497,7 +568,9 @@ def _build_admit_programs(cfg, max_len):
     def _paged_warm_fn(pages, table, pool, params, suffix, slot,
                        table_row, row, start):
         work = pool_take_row(pool, row)
-        work = prefill_suffix_row(cfg, params, suffix, work, start)
+        work = prefill_suffix_row(
+            cfg, params, suffix, work, start, mesh=mesh
+        )
         pages = paged_install_row(
             pages, work, table_row, start, suffix.shape[0]
         )
@@ -637,6 +710,7 @@ class ContinuousBatcher:
         page_size: int = 0,          # cells per page (0 = auto pow2)
         n_pages: int = 0,            # pool size (0 = dense-equivalent)
         swap_headroom: int = 1,      # free pages the scheduler keeps
+        mesh_spec=None,              # tp degree | {"tp": n} | MeshSpec
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -658,8 +732,24 @@ class ContinuousBatcher:
                 f"pipeline), got {async_depth}"
             )
         _check_positional_capacity(cfg, max_len)
+        # ---- serving mesh (GSPMD tensor slice) --------------------------
+        # tp=1 (or the knob unset) keeps mesh=None: the compiled
+        # programs are then literally the single-device ones (the mesh
+        # joins every program-cache key, and constrain() is the
+        # identity under mesh=None), so the parity contract for the
+        # default path is structural, not merely numerical.
+        self.mesh = None
+        self.mesh_tp = 1
+        if mesh_spec is not None:
+            tp = _parse_mesh_tp(mesh_spec)
+            n_kv = getattr(cfg, "n_kv_heads", None) or cfg.n_heads
+            # validate even for tp=1 so a bad knob fails loudly here
+            serving_mesh_spec(tp, n_kv_heads=n_kv)
+            self.mesh_tp = tp
+            if tp > 1:
+                self.mesh = serving_mesh(tp, n_kv_heads=n_kv)
         self.cfg = cfg
-        self.params = params
+        self.params = self._shard_params(params)
         self.n_slots = n_slots
         self.max_len = max_len
         self.max_new = max_new_tokens
@@ -739,13 +829,15 @@ class ContinuousBatcher:
             self.swap_headroom = max(0, swap_headroom)
             self._pages_per_slot = per_slot
             self.allocator = PageAllocator(n_pages, page_size)
-            self.page_pool = init_page_pool(
-                cfg, n_pages, page_size, quant=kv_quant
+            self.page_pool = self._shard_bank(
+                init_page_pool(cfg, n_pages, page_size, quant=kv_quant)
             )
             # all rows start on the trash page (page 0); after that
             # the programs trash-route done rows on their own, so the
             # host only ever scatters rows at admission/CoW
-            self._table = jnp.zeros((n_slots, per_slot), jnp.int32)
+            self._table = self._replicate(
+                jnp.zeros((n_slots, per_slot), jnp.int32)
+            )
             self._slot_pages: List[List[int]] = [
                 [] for _ in range(n_slots)
             ]
@@ -755,8 +847,8 @@ class ContinuousBatcher:
             self._swap_resumes = 0
             self.cache = None
         else:
-            self.cache = init_kv_cache(
-                cfg, n_slots, bank_len, quant=kv_quant
+            self.cache = self._shard_bank(
+                init_kv_cache(cfg, n_slots, bank_len, quant=kv_quant)
             )
         # host MIRRORS of the slot state (tiny [B] vectors). The truth
         # lives on device in self._dev; these track it so admission
@@ -817,7 +909,9 @@ class ContinuousBatcher:
             # exact dtype even when the slot bank is int8: install
             # re-quantizes, which keeps warm admissions byte-identical
             # to cold ones (models/decode.py pool primitives)
-            self.pool = init_kv_cache(cfg, prefix_cache_rows, max_len)
+            self.pool = self._shard_bank(
+                init_kv_cache(cfg, prefix_cache_rows, max_len)
+            )
 
         # ---- speculative decoding ---------------------------------------
         # host drafter + adaptive controller (serving/speculative.py);
@@ -837,24 +931,29 @@ class ContinuousBatcher:
             self._run_spec = _cached_program(
                 _SPEC_PROGRAMS,
                 (cfg, pad_id, eos_id, temperature, top_k, top_p,
-                 spec_draft_len),
+                 spec_draft_len, self.mesh),
                 lambda: _build_spec_program(
-                    cfg, pad_id, eos_id, temperature, top_k, top_p
+                    cfg, pad_id, eos_id, temperature, top_k, top_p,
+                    mesh=self.mesh,
                 ),
             )[self.kv_layout]
         self.spec_draft_len = spec_draft_len
 
         self._run_chunk = _cached_program(
             _CHUNK_PROGRAMS,
-            (cfg, pad_id, eos_id, temperature, top_k, top_p),
+            (cfg, pad_id, eos_id, temperature, top_k, top_p,
+             self.mesh),
             lambda: _build_chunk_program(
-                cfg, pad_id, eos_id, temperature, top_k, top_p
+                cfg, pad_id, eos_id, temperature, top_k, top_p,
+                mesh=self.mesh,
             ),
         )[self.kv_layout]
         admit = _cached_program(
             _ADMIT_PROGRAMS,
-            (cfg, max_len),
-            lambda: _build_admit_programs(cfg, max_len),
+            (cfg, max_len, self.mesh),
+            lambda: _build_admit_programs(
+                cfg, max_len, mesh=self.mesh
+            ),
         )
         self._admit_fn = admit["admit"]
         self._admit_cold_fn = admit["cold"]
@@ -865,16 +964,61 @@ class ContinuousBatcher:
         self._paged_warm_fn = admit["paged_warm"]
         self._page_copy_fn = admit["page_copy"]
 
+    # -- mesh placement ----------------------------------------------------
+
+    def _shard_params(self, params):
+        """Lay the served weights out under the serving mesh: QKV
+        projections split on their head columns, everything else
+        replicated (_SERVING_PARAM_RULES). Identity without a mesh."""
+        if self.mesh is None:
+            return params
+        return shard_tree(
+            params, self.mesh, _serving_param_shardings()
+        )
+
+    def _shard_bank(self, bank):
+        """Place a KV bank (dense slot bank, paged page pool, or the
+        exact prefix pool — dicts of [L, rows, cells, KV, hd] arrays;
+        int8 scales ride along with hd==1) with the KV head axis
+        sharded and every host-planned axis replicated. Identity
+        without a mesh."""
+        if self.mesh is None or bank is None:
+            return bank
+        sharding = named(self.mesh, serving_kv_spec())
+        return {
+            name: jax.device_put(arr, sharding)
+            for name, arr in bank.items()
+        }
+
+    def _replicate(self, x):
+        """Replicated placement for host-planned device state (slot
+        vectors, page tables): every shard addresses the full array,
+        so the PR-5 async scatters and PR-6 host PageAllocator stay
+        layout-oblivious. Identity without a mesh."""
+        if self.mesh is None:
+            return x
+        return jax.device_put(x, replicated(self.mesh))
+
+    @property
+    def mesh_shape(self) -> Dict[str, int]:
+        """The replica's mesh slice shape (heartbeat payload)."""
+        return {"tp": self.mesh_tp}
+
+    @property
+    def n_chips(self) -> int:
+        """Devices this replica occupies — the auto-scaler's unit."""
+        return self.mesh_tp
+
     def _device_state(self) -> Dict[str, Any]:
         """Upload the host mirrors once; from here on the device
         copies advance through the chunk/spec programs and the
         scatter programs — never by per-dispatch re-upload."""
         return {
-            "tok": jnp.asarray(self.tok),
-            "pos": jnp.asarray(self.pos),
-            "done": jnp.asarray(self.done),
-            "limit": jnp.asarray(self.limit),
-            "keys": jnp.asarray(self.slot_key),
+            "tok": self._replicate(jnp.asarray(self.tok)),
+            "pos": self._replicate(jnp.asarray(self.pos)),
+            "done": self._replicate(jnp.asarray(self.done)),
+            "limit": self._replicate(jnp.asarray(self.limit)),
+            "keys": self._replicate(jnp.asarray(self.slot_key)),
         }
 
     def _next_chunk_len(self) -> int:
@@ -912,7 +1056,7 @@ class ContinuousBatcher:
         must match; the compiled programs are reused as-is. Call
         between generate_all() drains — mid-drain the batch would mix
         policies."""
-        self.params = params
+        self.params = self._shard_params(params)
 
     # -- admission ---------------------------------------------------------
 
@@ -1683,21 +1827,27 @@ class ContinuousBatcher:
             # dense bank — rebuild pool, allocator, and tables, and
             # drop every host-side run record with them
             self.allocator = PageAllocator(self.n_pages, self.page_size)
-            self.page_pool = init_page_pool(
-                self.cfg, self.n_pages, self.page_size,
-                quant=self._kv_quant,
+            self.page_pool = self._shard_bank(
+                init_page_pool(
+                    self.cfg, self.n_pages, self.page_size,
+                    quant=self._kv_quant,
+                )
             )
-            self._table = jnp.zeros(
-                (self.n_slots, self._pages_per_slot), jnp.int32
+            self._table = self._replicate(
+                jnp.zeros(
+                    (self.n_slots, self._pages_per_slot), jnp.int32
+                )
             )
             self._slot_pages = [[] for _ in range(self.n_slots)]
             self._row_pages = {}
         else:
-            self.cache = init_kv_cache(
-                self.cfg,
-                self.n_slots,
-                self.max_len + self.spec_draft_len,
-                quant=self._kv_quant,
+            self.cache = self._shard_bank(
+                init_kv_cache(
+                    self.cfg,
+                    self.n_slots,
+                    self.max_len + self.spec_draft_len,
+                    quant=self._kv_quant,
+                )
             )
         self.tok[:] = self.pad_id
         self.pos[:] = 0
@@ -1721,8 +1871,10 @@ class ContinuousBatcher:
                 block=self._prefix_block,
                 on_evict=self._on_prefix_evict if self._paged else None,
             )
-            self.pool = init_kv_cache(
-                self.cfg, self._prefix_rows, self.max_len
+            self.pool = self._shard_bank(
+                init_kv_cache(
+                    self.cfg, self._prefix_rows, self.max_len
+                )
             )
         if self.spec is not None:
             ng_max, ng_min, thresh, probe = self._spec_knobs

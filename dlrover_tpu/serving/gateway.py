@@ -265,6 +265,13 @@ class ServingGateway:
         paged = self._paged()
         if paged:
             out["paged_kv"] = paged
+        engine = getattr(self.backend, "engine", None)
+        mesh_shape = getattr(engine, "mesh_shape", None)
+        if mesh_shape is not None:
+            out["mesh"] = {
+                "shape": mesh_shape,
+                "n_chips": int(getattr(engine, "n_chips", 1)),
+            }
         return out
 
     def _prefix_cache(self):

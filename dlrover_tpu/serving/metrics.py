@@ -105,6 +105,11 @@ class ServingMetrics:
         self._paged_cow_copies = 0
         self._paged_swap_preemptions = 0
         self._paged_swap_resumes = 0
+        # mesh-slice gauges: copied from the engine's
+        # mesh_shape/n_chips each pump. 1/1 is the un-meshed default
+        # (a replica always occupies at least one device)
+        self._mesh_tp = 1
+        self._replica_chips = 1
 
     # ---- ingestion -------------------------------------------------------
 
@@ -251,6 +256,13 @@ class ServingMetrics:
                 int(stats.get("swap_resumes", 0)),
             )
 
+    def set_mesh(self, tp: int, n_chips: int):
+        """Refresh the replica's mesh-slice shape (gauges, set
+        directly — a restarted engine may legitimately change them)."""
+        with self._lock:
+            self._mesh_tp = int(tp)
+            self._replica_chips = int(n_chips)
+
     # ---- queries ---------------------------------------------------------
 
     @property
@@ -391,6 +403,16 @@ class ServingMetrics:
     def paged_swap_resumes(self) -> int:
         with self._lock:
             return self._paged_swap_resumes
+
+    @property
+    def mesh_tp(self) -> int:
+        with self._lock:
+            return self._mesh_tp
+
+    @property
+    def replica_chips(self) -> int:
+        with self._lock:
+            return self._replica_chips
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -629,6 +651,16 @@ class ServingMetrics:
                 "serving_paged_swap_resumes_total",
                 "Preempted requests resumed by replay.",
                 self._paged_swap_resumes,
+            )
+            gauge(
+                "serving_mesh_tp",
+                "Tensor-parallel width of this replica's mesh slice.",
+                self._mesh_tp,
+            )
+            gauge(
+                "serving_replica_chips",
+                "Devices this replica's mesh slice occupies.",
+                self._replica_chips,
             )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()

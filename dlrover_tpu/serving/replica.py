@@ -123,15 +123,22 @@ class InferenceReplica:
         self.register()
 
     def _meta(self) -> bytes:
+        # mesh_shape/n_chips: a replica is a mesh SLICE, not a device
+        # — the auto-scaler prices its hints in chips = replicas ×
+        # slice size, so the heartbeat must carry the slice shape
+        # (getattr keeps pre-mesh engines and test doubles valid)
+        eng = self.scheduler.engine
         return json.dumps(
             {
                 "id": self.id,
                 "ts": time.time(),
-                "n_slots": self.scheduler.engine.n_slots,
+                "n_slots": eng.n_slots,
                 "queue_depth": self.scheduler.queue_depth(),
                 "active": self.scheduler.active_count(),
                 "pressure": self.scheduler.pressure(),
                 "healthy": self.healthy,
+                "mesh_shape": getattr(eng, "mesh_shape", {"tp": 1}),
+                "n_chips": int(getattr(eng, "n_chips", 1)),
             }
         ).encode()
 
@@ -392,12 +399,27 @@ class ReplicaPool:
                 direction, target = "down", n - 1
             else:
                 direction, target = "hold", n
+        # chip denomination: the advisor reasons in chips (= replicas
+        # × mesh slice size), so the hint carries the pool's slice
+        # width alongside the replica counts. Heterogeneous pools take
+        # the widest slice — over-asking by a partial slice beats
+        # under-provisioning a replica that cannot be placed.
+        cpr = max(
+            (
+                int(getattr(r.scheduler.engine, "n_chips", 1))
+                for r in reps
+            ),
+            default=1,
+        )
         hint = {
             "direction": direction,
             "replicas": target,
             "current": n,
             "pressure": round(pressure, 4),
             "ts": time.time(),
+            "chips_per_replica": cpr,
+            "chips": target * cpr,
+            "current_chips": n * cpr,
         }
         self._last_hint_ts = now
         if self.kv is not None:

@@ -417,6 +417,12 @@ class RequestScheduler:
                 ps = paged_stats()
                 if ps:
                     self.metrics.update_paged(ps)
+            mesh_shape = getattr(self.engine, "mesh_shape", None)
+            if mesh_shape is not None:
+                self.metrics.set_mesh(
+                    int(mesh_shape.get("tp", 1)),
+                    int(getattr(self.engine, "n_chips", 1)),
+                )
             return bool(self._waiting) or bool(self._running)
 
     # ---- failover --------------------------------------------------------

@@ -174,6 +174,14 @@ def test_serve_bench_smoke_emits_driver_contract():
         "paged_pages_shared",
         "paged_prefix_hit_rate",
         "n_paged_requests",
+        # mesh phase: the tensor-parallel slice evidence axes
+        "mesh_tp",
+        "mesh_devices",
+        "mesh_tp1_tpot_ms_p50",
+        "mesh_tp2_tpot_ms_p50",
+        "mesh_parity_ok",
+        "mesh_metrics_ok",
+        "n_mesh_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -236,3 +244,16 @@ def test_serve_bench_smoke_emits_driver_contract():
     assert detail["paged_tpot_ms_p50"] > 0
     assert detail["dense_tpot_ms_p50"] > 0
     assert detail["n_paged_requests"] > 0
+    # the mesh acceptance floor: the bench forces 8 virtual host
+    # devices, so tp=2 MUST have run, MUST be byte-identical to the
+    # dense tp=1 outputs, and the slice-shape gauges must render.
+    # No tp2-vs-tp1 latency ratio lock: on virtual CPU devices the
+    # collectives are pure overhead — the latency win is a TPU fact,
+    # parity is the portable invariant
+    assert detail["mesh_tp"] == 2
+    assert detail["mesh_devices"] >= 2
+    assert detail["mesh_parity_ok"] is True
+    assert detail["mesh_metrics_ok"] is True
+    assert detail["mesh_tp2_tpot_ms_p50"] > 0
+    assert detail["mesh_tp1_tpot_ms_p50"] > 0
+    assert detail["n_mesh_requests"] > 0

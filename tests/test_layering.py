@@ -219,3 +219,75 @@ def test_engine_hot_path_never_allocates_device_arrays():
     # vacuity guard: __init__ DOES allocate (pool/table); if the
     # walker stops seeing those, it stopped seeing anything
     assert any(owner == "__init__" for _, _, owner in calls)
+
+
+# 4. serving/ must not construct jax.sharding.Mesh directly. The ONE
+# mesh factory is parallel/mesh.py (serving_mesh + serving_mesh_spec):
+# it owns axis naming, device selection, and the divisibility
+# validation. A raw Mesh(...) inside serving/ would mint a second,
+# unvalidated axis-name convention that decode.py's PartitionSpecs
+# silently would not match (GSPMD falls back to replicated — correct
+# bytes, zero speedup, nothing fails loudly).
+
+
+def _raw_mesh_uses(path: pathlib.Path):
+    """(lineno, what) for every direct jax.sharding.Mesh reference:
+    `from jax.sharding import Mesh`, `jax.sharding.Mesh(...)`, or an
+    aliased `sharding.Mesh(...)`."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level == 0 and mod == "jax.sharding":
+                for alias in node.names:
+                    if alias.name == "Mesh":
+                        out.append(
+                            (
+                                node.lineno,
+                                "from jax.sharding import Mesh",
+                            )
+                        )
+        elif isinstance(node, ast.Attribute) and node.attr == "Mesh":
+            v = node.value
+            # jax.sharding.Mesh  /  sharding.Mesh
+            if (
+                isinstance(v, ast.Attribute)
+                and v.attr == "sharding"
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "jax"
+            ) or (isinstance(v, ast.Name) and v.id == "sharding"):
+                out.append((node.lineno, ast.unparse(node)))
+    return out
+
+
+def test_serving_never_constructs_raw_mesh():
+    offenders = []
+    files = sorted(SERVING_DIR.rglob("*.py"))
+    assert files, f"no sources under {SERVING_DIR}"
+    for path in files:
+        for lineno, what in _raw_mesh_uses(path):
+            offenders.append(f"{path}:{lineno}: {what}")
+    assert not offenders, (
+        "serving/ must build meshes through parallel/mesh.py "
+        "(serving_mesh validates tp against devices and KV heads and "
+        "owns the axis name decode.py's shardings match):\n"
+        + "\n".join(offenders)
+    )
+    # vacuity guard: the walker must flag the patterns it exists to
+    # catch — check against a synthetic offender, not the clean tree
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".py", delete=False
+    ) as f:
+        f.write(
+            "from jax.sharding import Mesh\n"
+            "import jax\n"
+            "m = jax.sharding.Mesh(devs, ('tp',))\n"
+        )
+        probe = pathlib.Path(f.name)
+    try:
+        assert len(_raw_mesh_uses(probe)) == 2
+    finally:
+        probe.unlink()
