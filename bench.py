@@ -403,6 +403,12 @@ def main():
 
     ensure_cpu_if_forced()  # DLROVER_TPU_FORCE_CPU=1 -> CPU smoke mode
 
+    # pure-AST, no jax: a number benched off a tree that breaks the
+    # serving invariants measures the bug, not the system
+    from dlrover_tpu.analysis import bench_preflight
+
+    bench_preflight("bench.py")
+
     watchdog = _Watchdog(
         float(os.environ.get("BENCH_PROBE_TIMEOUT", "600"))
     )

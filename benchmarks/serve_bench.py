@@ -97,6 +97,10 @@ ensure_cpu_if_forced()
 
 
 def main():
+    from dlrover_tpu.analysis import bench_preflight
+
+    bench_preflight("serve_bench.py")
+
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,90 @@
+"""graftlint: rule-based static analysis for the repo's serving
+invariants (layering, host-sync, device allocation, mesh discipline,
+locks, clocks, jit hygiene, exceptions).
+
+Run it:
+
+    python -m dlrover_tpu.analysis [--json] [--rules ID,ID] [paths…]
+
+or from code / pytest:
+
+    from dlrover_tpu import analysis
+    findings = analysis.run()                 # whole registry, tree
+    assert not analysis.unsuppressed(findings)
+
+Keep this package importable without jax: the CLI and the bench
+preflights depend on it being pure-stdlib `ast`.
+"""
+
+from typing import Iterable, List, Optional
+
+from dlrover_tpu.analysis.core import (
+    CRITICAL,
+    WARNING,
+    Finding,
+    Rule,
+    SourceFile,
+    default_files,
+    repo_root,
+    run_rules,
+    unsuppressed,
+)
+from dlrover_tpu.analysis.rules import REGISTRY, get_rules
+
+
+def run(
+    rule_ids: Optional[List[str]] = None,
+    files: Optional[Iterable] = None,
+) -> List[Finding]:
+    """Run (a subset of) the registry over the tree; returns ALL
+    findings, suppressed ones flagged."""
+    return run_rules(get_rules(rule_ids), files=files)
+
+
+def critical_findings() -> List[Finding]:
+    """Unsuppressed CRITICAL findings on the current tree — the bench
+    preflight gate (bench.py / serve_bench.py refuse to run while
+    this is non-empty)."""
+    return [
+        f
+        for f in unsuppressed(run())
+        if f.severity == CRITICAL
+    ]
+
+
+def bench_preflight(label: str) -> None:
+    """Refuse to start a benchmark while the tree has unsuppressed
+    CRITICAL findings. A bench number taken from a tree that violates
+    the lock/host-sync/jit invariants measures the bug, not the
+    system — fix the finding or pragma it with a reason first."""
+    crit = critical_findings()
+    if not crit:
+        return
+    print(
+        f"{label}: refusing to run — {len(crit)} CRITICAL graftlint "
+        "finding(s) outstanding (fix, or add "
+        "'# graftlint: allow(RULE-ID) reason=...'; "
+        "see `python -m dlrover_tpu.analysis`):",
+        flush=True,
+    )
+    for f in crit:
+        print("  " + f.render(), flush=True)
+    raise SystemExit(2)
+
+
+__all__ = [
+    "CRITICAL",
+    "WARNING",
+    "Finding",
+    "Rule",
+    "SourceFile",
+    "REGISTRY",
+    "bench_preflight",
+    "critical_findings",
+    "default_files",
+    "get_rules",
+    "repo_root",
+    "run",
+    "run_rules",
+    "unsuppressed",
+]

@@ -1,0 +1,90 @@
+"""graftlint CLI.
+
+    python -m dlrover_tpu.analysis                # whole tree
+    python -m dlrover_tpu.analysis --json         # machine-readable
+    python -m dlrover_tpu.analysis --rules LOCK-001,CLOCK-001
+    python -m dlrover_tpu.analysis --list         # registry overview
+    python -m dlrover_tpu.analysis path/to/file.py …
+
+Exit status: 0 when every finding is suppressed (or none), 1 when
+unsuppressed findings remain, 2 on usage errors.
+"""
+
+import argparse
+import json
+import sys
+
+from dlrover_tpu.analysis import (
+    REGISTRY,
+    get_rules,
+    run_rules,
+    unsuppressed,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dlrover_tpu.analysis",
+        description="graftlint: serving-invariant static analysis",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files to lint (default: every .py under dlrover_tpu/)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="JSON output"
+    )
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--list",
+        action="store_true",
+        help="list registered rules and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for rule in REGISTRY:
+            print(f"{rule.id}  [{rule.severity}]  {rule.title}")
+        return 0
+
+    try:
+        rules = get_rules(
+            args.rules.split(",") if args.rules else None
+        )
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+
+    findings = run_rules(rules, files=args.paths or None)
+    active = unsuppressed(findings)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "ok": not active,
+                    "findings": [f.to_dict() for f in active],
+                    "suppressed": [
+                        f.to_dict() for f in findings if f.suppressed
+                    ],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        n_sup = sum(1 for f in findings if f.suppressed)
+        print(
+            f"graftlint: {len(active)} finding(s), "
+            f"{n_sup} suppressed, {len(rules)} rule(s)"
+        )
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

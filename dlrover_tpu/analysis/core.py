@@ -1,0 +1,224 @@
+"""graftlint core: the rule framework behind `python -m
+dlrover_tpu.analysis`.
+
+DLRover's pitch (PAPER.md) is *automatic* reliability — faults caught
+by machinery, not reviewers. This module applies the same stance to
+the repo's own invariants: the layering/host-copy/device-alloc/mesh
+contracts (DEVIATIONS §5/§9/§10/§11) and the threading/clock/jit
+contracts that nothing enforced before live as `Rule` objects a
+file-set driver runs over the tree. Findings carry file:line and a
+severity; intentional exceptions are suppressed inline with
+
+    # graftlint: allow(RULE-ID) reason=<why this site is exempt>
+
+where the reason is MANDATORY — a pragma without one is itself a
+CRITICAL finding (GRAFT-000), so the tree can never accumulate
+unexplained suppressions. A pragma on its own comment line also
+covers the next source line.
+
+Deliberately dependency-free and jax-free: everything is stdlib `ast`
+over source text, so the CLI (and the bench preflights that call it)
+runs in milliseconds without touching a backend.
+"""
+
+import ast
+import dataclasses
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence
+
+CRITICAL = "CRITICAL"
+WARNING = "WARNING"
+
+# one pragma per line, at end of line:  # graftlint: allow(ID) reason=...
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*allow\(([A-Za-z0-9_-]+)\)"
+    r"(?:\s+reason=(\S.*?))?\s*$"
+)
+
+META_RULE_ID = "GRAFT-000"
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at file:line (suppressed=True when an inline
+    pragma with a reason covers it)."""
+
+    rule_id: str
+    severity: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    suppression_reason: Optional[str] = None
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (
+            f"{self.location}: [{self.severity}] "
+            f"{self.rule_id}: {self.message}{tag}"
+        )
+
+
+def _parse_pragmas(text: str) -> Dict[int, Dict[str, Optional[str]]]:
+    """line -> {rule_id: reason-or-None}. A pragma covers its own line;
+    a comment-only pragma line additionally covers the next line (so a
+    long statement can carry its pragma on the line above)."""
+    out: Dict[int, Dict[str, Optional[str]]] = {}
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            continue
+        rule_id, reason = m.group(1), m.group(2)
+        if reason is not None:
+            reason = reason.strip() or None
+        out.setdefault(lineno, {})[rule_id] = reason
+        if line.lstrip().startswith("#"):
+            out.setdefault(lineno + 1, {})[rule_id] = reason
+    return out
+
+
+class SourceFile:
+    """One parsed source file: text + AST + pragma map, parsed once
+    and shared by every rule. `rel` is the repo-relative posix path
+    rules key their per-file configuration on — tests may override it
+    to make a synthetic probe impersonate a real file."""
+
+    def __init__(self, path, text: str, rel: Optional[str] = None):
+        self.path = pathlib.Path(path)
+        self.rel = rel if rel is not None else self.path.as_posix()
+        self.text = text
+        self.tree = ast.parse(text, filename=str(path))
+        self.pragmas = _parse_pragmas(text)
+
+    @classmethod
+    def parse(
+        cls,
+        path,
+        root: Optional[pathlib.Path] = None,
+        rel: Optional[str] = None,
+    ) -> "SourceFile":
+        path = pathlib.Path(path)
+        if rel is None and root is not None:
+            try:
+                rel = path.resolve().relative_to(
+                    pathlib.Path(root).resolve()
+                ).as_posix()
+            except ValueError:
+                rel = path.as_posix()
+        return cls(path, path.read_text(), rel=rel)
+
+    def allow_reason(
+        self, rule_id: str, line: int
+    ) -> "tuple[bool, Optional[str]]":
+        """(covered, reason) for a pragma targeting rule_id at line."""
+        entry = self.pragmas.get(line, {})
+        if rule_id in entry:
+            return True, entry[rule_id]
+        return False, None
+
+
+class Rule:
+    """One invariant. Subclasses set the class attributes and
+    implement check(); `rationale` names the contract (DEVIATIONS
+    section or design doc) the rule enforces, so a finding always
+    points at the *why*, not just the *what*."""
+
+    id: str = "RULE-000"
+    severity: str = CRITICAL
+    title: str = ""
+    rationale: str = ""
+
+    def applies(self, src: SourceFile) -> bool:
+        return True
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, src: SourceFile, line: int, message: str
+    ) -> Finding:
+        return Finding(self.id, self.severity, src.rel, line, message)
+
+
+def repo_root() -> pathlib.Path:
+    """The directory containing the dlrover_tpu package."""
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def default_files(
+    root: Optional[pathlib.Path] = None,
+) -> List[pathlib.Path]:
+    root = pathlib.Path(root) if root is not None else repo_root()
+    pkg = root / "dlrover_tpu"
+    return sorted(pkg.rglob("*.py"))
+
+
+def _meta_findings(src: SourceFile) -> List[Finding]:
+    """GRAFT-000: every pragma must carry a non-empty reason. The
+    per-line map double-books comment-only pragmas onto the following
+    line; dedupe so each pragma is reported once."""
+    out: List[Finding] = []
+    seen = set()
+    for line in sorted(src.pragmas):
+        for rule_id, reason in src.pragmas[line].items():
+            key = (rule_id, reason, line - 1)
+            if (rule_id, reason, line) in seen or key in seen:
+                continue
+            seen.add((rule_id, reason, line))
+            if reason is None:
+                out.append(
+                    Finding(
+                        META_RULE_ID,
+                        CRITICAL,
+                        src.rel,
+                        line,
+                        f"suppression of {rule_id} without a reason "
+                        "(pragmas must say WHY: "
+                        "# graftlint: allow(ID) reason=...)",
+                    )
+                )
+    return out
+
+
+def run_rules(
+    rules: Sequence[Rule],
+    files: Optional[Iterable] = None,
+    root: Optional[pathlib.Path] = None,
+) -> List[Finding]:
+    """Drive `rules` over `files` (default: every .py under the
+    dlrover_tpu package). Returns ALL findings; suppressed ones carry
+    suppressed=True + the pragma's reason. GRAFT-000 meta-findings
+    (reasonless pragmas) are appended per file and cannot be
+    suppressed."""
+    root = pathlib.Path(root) if root is not None else repo_root()
+    paths = list(files) if files is not None else default_files(root)
+    findings: List[Finding] = []
+    for item in paths:
+        src = (
+            item
+            if isinstance(item, SourceFile)
+            else SourceFile.parse(item, root=root)
+        )
+        for rule in rules:
+            if not rule.applies(src):
+                continue
+            for f in rule.check(src):
+                covered, reason = src.allow_reason(f.rule_id, f.line)
+                if covered:
+                    f.suppressed = True
+                    f.suppression_reason = reason
+                findings.append(f)
+        findings.extend(_meta_findings(src))
+    return findings
+
+
+def unsuppressed(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.suppressed]

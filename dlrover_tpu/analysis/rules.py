@@ -1,0 +1,853 @@
+"""graftlint rules: the serving-invariant registry.
+
+Four rules are straight ports of the tests/test_layering.py AST lints
+(that file is now a thin bridge over this registry); the rest encode
+the threading/clock/jit/exception contracts that previously lived
+only in review comments. Each rule names the contract it enforces in
+`rationale` so a finding points at the why.
+
+Shared-helper functions (host_copy_sites, class_alloc_sites,
+raw_mesh_uses) are module-level so the legacy test bridge can keep
+its vacuity guards against the same walkers the rules use.
+"""
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+from dlrover_tpu.analysis.core import (
+    CRITICAL,
+    WARNING,
+    Finding,
+    Rule,
+    SourceFile,
+)
+
+SERVING_PREFIX = "dlrover_tpu/serving/"
+DECODE_FILE = "dlrover_tpu/models/decode.py"
+ENGINE_FILE = SERVING_PREFIX + "engine.py"
+PAGED_KV_FILE = SERVING_PREFIX + "paged_kv.py"
+
+
+def _in_serving(src: SourceFile) -> bool:
+    # substring, not prefix: a file handed to the CLI by absolute
+    # path still gets the serving rules applied
+    return SERVING_PREFIX in src.rel
+
+
+def _matches_file(rel: str, key: str) -> bool:
+    return rel == key or rel.endswith("/" + key)
+
+
+def _file_config(rel: str, table: Dict[str, FrozenSet[str]]):
+    for key, value in table.items():
+        if _matches_file(rel, key):
+            return value
+    return None
+
+
+def walk_with_owner(
+    tree: ast.AST,
+) -> Iterator[Tuple[ast.AST, Optional[str]]]:
+    """(node, enclosing-function-name) pairs; owner is None at module
+    and class scope (i.e. code that RUNS at import time — a lambda
+    body counts as deferred, so lambdas become owners too)."""
+
+    def visit(node, owner):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            owner = node.name
+        elif isinstance(node, ast.Lambda):
+            owner = "<lambda>"
+        yield node, owner
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, owner)
+
+    yield from visit(tree, None)
+
+
+# ---------------------------------------------------------------------------
+# LAYER-001: serving/ never imports dlrover_tpu.rl
+
+
+_FORBIDDEN_IMPORT = "dlrover_tpu.rl"
+
+
+def rl_import_uses(tree: ast.AST) -> List[Tuple[int, str]]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.name
+                if name == _FORBIDDEN_IMPORT or name.startswith(
+                    _FORBIDDEN_IMPORT + "."
+                ):
+                    out.append((node.lineno, f"import {name}"))
+        elif isinstance(node, ast.ImportFrom):
+            # level>0 is a relative import inside serving/ — it cannot
+            # reach dlrover_tpu.rl without an absolute name
+            mod = node.module or ""
+            if node.level == 0 and (
+                mod == _FORBIDDEN_IMPORT
+                or mod.startswith(_FORBIDDEN_IMPORT + ".")
+            ):
+                out.append((node.lineno, f"from {mod} import ..."))
+            elif node.level == 0 and mod == "dlrover_tpu":
+                for alias in node.names:
+                    if alias.name == "rl":
+                        out.append(
+                            (node.lineno, "from dlrover_tpu import rl")
+                        )
+    return out
+
+
+class RlImportRule(Rule):
+    id = "LAYER-001"
+    severity = CRITICAL
+    title = "serving/ must not import dlrover_tpu.rl"
+    rationale = (
+        "DEVIATIONS §5: the dependency is one-way — rl/serve.py "
+        "imports the serving engine, never the reverse, so the "
+        "serving stack stays usable without the RL stack."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [
+            self.finding(src, lineno, what)
+            for lineno, what in rl_import_uses(src.tree)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# HOST-001: host materialization only in designated fetch helpers
+
+
+# calls that synchronously materialize a device array on host
+HOST_COPY_CALLS = {
+    ("np", "array"),
+    ("np", "asarray"),
+    ("np", "copy"),
+    ("numpy", "array"),
+    ("numpy", "asarray"),
+    ("numpy", "copy"),
+    ("jax", "device_get"),
+}
+
+# functions allowed to materialize host arrays, per file. engine.py:
+# the ONE designated device fetch point plus the host-data paths
+# (prompt normalization at submit, PRNG-key capture at admit,
+# output-list conversion at retire/drain, prompt-folding at
+# preemption — all of which only touch host-resident numpy data,
+# never a dispatch result). decode.py and paged_kv.py currently have
+# NO host-copy sites at all; the empty allowlists freeze that.
+HOST_COPY_ALLOWED: Dict[str, FrozenSet[str]] = {
+    ENGINE_FILE: frozenset(
+        {
+            "_to_host",
+            "submit",
+            "_admit",
+            "retire",
+            "generate_all",
+            "_preempt_slot",
+        }
+    ),
+    DECODE_FILE: frozenset(),
+    PAGED_KV_FILE: frozenset(),
+}
+
+
+def host_copy_sites(
+    tree: ast.AST,
+) -> List[Tuple[int, str, Optional[str]]]:
+    """(lineno, call, enclosing-function-name) for every potentially
+    blocking host materialization; owner is None at module scope."""
+    out = []
+    for node, owner in walk_with_owner(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and (f.value.id, f.attr) in HOST_COPY_CALLS
+            ):
+                out.append(
+                    (node.lineno, f"{f.value.id}.{f.attr}", owner)
+                )
+    return out
+
+
+class HostCopyRule(Rule):
+    id = "HOST-001"
+    severity = CRITICAL
+    title = "host copies only in designated fetch helpers"
+    rationale = (
+        "DEVIATIONS §9: the async dispatch design depends on the "
+        "step hot path never issuing a fresh blocking device->host "
+        "copy — a stray np.array(<jax array>) silently re-serializes "
+        "host and device."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _file_config(src.rel, HOST_COPY_ALLOWED) is not None
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        allowed = _file_config(src.rel, HOST_COPY_ALLOWED)
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{call} in {owner or '<module>'}() — host "
+                f"materialization allowed only in "
+                f"{sorted(allowed) or 'nothing in this file'}",
+            )
+            for lineno, call, owner in host_copy_sites(src.tree)
+            if owner not in allowed
+        ]
+
+
+# ---------------------------------------------------------------------------
+# ALLOC-001: no per-step device allocation in engine-class methods
+
+
+DEVICE_ALLOC_ALLOWED = frozenset({"__init__", "reset"})
+
+DEVICE_ALLOC_CALLS = {
+    ("jnp", "zeros"),
+    ("jnp", "ones"),
+    ("jnp", "full"),
+    ("jnp", "empty"),
+    ("jnp", "arange"),
+    ("jnp", "zeros_like"),
+    ("jnp", "ones_like"),
+    ("jnp", "full_like"),
+}
+
+# bulk device-state constructors (engine.py top-level helpers)
+DEVICE_ALLOC_NAMES = {"init_kv_cache", "init_page_pool"}
+
+_ALLOC_FILES = frozenset({ENGINE_FILE, PAGED_KV_FILE, DECODE_FILE})
+
+
+def class_alloc_sites(
+    tree: ast.AST, class_name: Optional[str] = None
+) -> List[Tuple[int, str, str, str]]:
+    """(lineno, call, method, class) for every eager device
+    allocation inside class methods (module-level functions — the jit
+    program builders — are intentionally out of scope: jnp calls
+    there run under trace and compile into the program instead of
+    allocating eagerly)."""
+    out = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        if class_name is not None and cls.name != class_name:
+            continue
+        for method in cls.body:
+            if not isinstance(
+                method, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            for node in ast.walk(method):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and (f.value.id, f.attr) in DEVICE_ALLOC_CALLS
+                ):
+                    out.append(
+                        (
+                            node.lineno,
+                            f"{f.value.id}.{f.attr}",
+                            method.name,
+                            cls.name,
+                        )
+                    )
+                elif (
+                    isinstance(f, ast.Name)
+                    and f.id in DEVICE_ALLOC_NAMES
+                ):
+                    out.append(
+                        (node.lineno, f.id, method.name, cls.name)
+                    )
+    return out
+
+
+class DeviceAllocRule(Rule):
+    id = "ALLOC-001"
+    severity = CRITICAL
+    title = "no device allocation outside __init__/reset"
+    rationale = (
+        "DEVIATIONS §10: page tables, the page pool, and the slot "
+        "bank are built ONCE and thereafter updated through donated "
+        "jitted programs; a stray jnp.zeros(...) in an engine method "
+        "allocates + transfers on every call."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return any(
+            _matches_file(src.rel, key) for key in _ALLOC_FILES
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{call} in {cls}.{method}() — device allocation "
+                f"allowed only in {sorted(DEVICE_ALLOC_ALLOWED)}",
+            )
+            for lineno, call, method, cls in class_alloc_sites(
+                src.tree
+            )
+            if method not in DEVICE_ALLOC_ALLOWED
+        ]
+
+
+# ---------------------------------------------------------------------------
+# MESH-001: serving/ never constructs a raw jax.sharding.Mesh
+
+
+def raw_mesh_uses(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, what) for every direct jax.sharding.Mesh reference:
+    `from jax.sharding import Mesh`, `jax.sharding.Mesh(...)`, or an
+    aliased `sharding.Mesh(...)`."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level == 0 and mod == "jax.sharding":
+                for alias in node.names:
+                    if alias.name == "Mesh":
+                        out.append(
+                            (
+                                node.lineno,
+                                "from jax.sharding import Mesh",
+                            )
+                        )
+        elif isinstance(node, ast.Attribute) and node.attr == "Mesh":
+            v = node.value
+            # jax.sharding.Mesh  /  sharding.Mesh
+            if (
+                isinstance(v, ast.Attribute)
+                and v.attr == "sharding"
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "jax"
+            ) or (isinstance(v, ast.Name) and v.id == "sharding"):
+                out.append((node.lineno, ast.unparse(node)))
+    return out
+
+
+class RawMeshRule(Rule):
+    id = "MESH-001"
+    severity = CRITICAL
+    title = "serving/ must not construct jax.sharding.Mesh"
+    rationale = (
+        "DEVIATIONS §11: the ONE mesh factory is parallel/mesh.py "
+        "(serving_mesh) — it owns axis naming, device selection, and "
+        "divisibility validation; a raw Mesh would mint an axis-name "
+        "convention decode.py's PartitionSpecs silently don't match."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [
+            self.finding(src, lineno, what)
+            for lineno, what in raw_mesh_uses(src.tree)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# LOCK-001: lock discipline for thread-spawning classes
+
+
+# constructing any of these inside a class makes it a concurrency
+# participant that must declare its guarded-field set
+_THREADING_FACTORIES = frozenset(
+    {"Thread", "Lock", "RLock", "Condition"}
+)
+
+_LOCK_ATTRS = frozenset({"_lock", "_cond"})
+
+
+def _creates_threading(cls: ast.ClassDef) -> Optional[int]:
+    for node in ast.walk(cls):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "threading"
+            and node.func.attr in _THREADING_FACTORIES
+        ):
+            return node.lineno
+    return None
+
+
+def _declared_guarded_fields(
+    cls: ast.ClassDef,
+) -> Optional[FrozenSet[str]]:
+    """Parse a class-body `GUARDED_FIELDS = frozenset({...})` (or a
+    bare set literal). None when not declared."""
+    for stmt in cls.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "GUARDED_FIELDS"
+            for t in stmt.targets
+        ):
+            continue
+        value = stmt.value
+        if (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "frozenset"
+        ):
+            if not value.args:
+                return frozenset()
+            value = value.args[0]
+        names = set()
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            for el in value.elts:
+                if isinstance(el, ast.Constant) and isinstance(
+                    el.value, str
+                ):
+                    names.add(el.value)
+        return frozenset(names)
+    return None
+
+
+def _is_self_lock(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and expr.attr in _LOCK_ATTRS
+    )
+
+
+def _unguarded_accesses(
+    method: ast.AST, guarded: FrozenSet[str]
+) -> List[Tuple[int, str]]:
+    """(lineno, field) for every `self.<guarded>` access not lexically
+    inside a `with self._lock` / `with self._cond` block."""
+    out = []
+
+    def visit(node, locked):
+        if isinstance(node, ast.With):
+            if any(
+                _is_self_lock(item.context_expr)
+                for item in node.items
+            ):
+                locked = True
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in guarded
+            and not locked
+        ):
+            out.append((node.lineno, node.attr))
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    visit(method, False)
+    return out
+
+
+class LockDisciplineRule(Rule):
+    id = "LOCK-001"
+    severity = CRITICAL
+    title = "guarded fields accessed only under the lock"
+    rationale = (
+        "The scheduler/pool/gateway/metrics threads share state "
+        "across the request path, the pump loop, and the health "
+        "loop; every cross-thread field must be declared in the "
+        "class's GUARDED_FIELDS and touched only inside `with "
+        "self._lock`/`self._cond`, in __init__, or in a "
+        "`*_locked`-convention method (called with the lock held)."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        findings = []
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lineno = _creates_threading(cls)
+            if lineno is None:
+                continue
+            guarded = _declared_guarded_fields(cls)
+            if guarded is None:
+                findings.append(
+                    self.finding(
+                        src,
+                        cls.lineno,
+                        f"class {cls.name} creates threading "
+                        "primitives but declares no GUARDED_FIELDS "
+                        "(= frozenset of cross-thread field names)",
+                    )
+                )
+                continue
+            for method in cls.body:
+                if not isinstance(
+                    method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if method.name == "__init__" or method.name.endswith(
+                    "_locked"
+                ):
+                    continue
+                for line, field in _unguarded_accesses(
+                    method, guarded
+                ):
+                    findings.append(
+                        self.finding(
+                            src,
+                            line,
+                            f"{cls.name}.{method.name}() touches "
+                            f"guarded field self.{field} outside "
+                            "`with self._lock`/`self._cond` (rename "
+                            "to *_locked if callers hold the lock)",
+                        )
+                    )
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# CLOCK-001: deadline/latency arithmetic never uses the wall clock
+
+
+class ClockDisciplineRule(Rule):
+    id = "CLOCK-001"
+    severity = CRITICAL
+    title = "serving/ uses monotonic (or injected) clocks"
+    rationale = (
+        "Deadlines, backoffs, and latency windows must survive NTP "
+        "steps: use the injected clock or time.monotonic(). "
+        "time.time() is allowed only for wall-clock telemetry "
+        "(heartbeat/hint `ts` fields read by master-side staleness "
+        "checks) behind an explicit pragma."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        out = []
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "time"
+                and node.func.attr == "time"
+            ):
+                out.append(
+                    self.finding(
+                        src,
+                        node.lineno,
+                        "time.time() — use the injected clock or "
+                        "time.monotonic() for anything fed into "
+                        "deadline/backoff/latency arithmetic",
+                    )
+                )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# JIT-001 / JIT-002 / JIT-003: jit hygiene
+
+
+def _is_jit_expr(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Name) and expr.id == "jit":
+        return True
+    return (
+        isinstance(expr, ast.Attribute)
+        and expr.attr == "jit"
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "jax"
+    )
+
+
+def _jit_decorated(node) -> bool:
+    for dec in node.decorator_list:
+        if _is_jit_expr(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_expr(dec.func):
+                return True
+            # @partial(jax.jit, ...) / @functools.partial(jax.jit, ..)
+            f = dec.func
+            is_partial = (
+                isinstance(f, ast.Name) and f.id == "partial"
+            ) or (isinstance(f, ast.Attribute) and f.attr == "partial")
+            if is_partial and dec.args and _is_jit_expr(dec.args[0]):
+                return True
+    return False
+
+
+class JitSelfCaptureRule(Rule):
+    id = "JIT-001"
+    severity = CRITICAL
+    title = "no jax.jit over closures capturing self"
+    rationale = (
+        "A jitted function that closes over `self` keys its trace "
+        "cache on the bound instance: every engine restart retraces "
+        "every program, silently defeating the module-level "
+        "_CHUNK/_ADMIT/_SPEC program caches (DEVIATIONS §9)."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src) or _matches_file(
+            src.rel, DECODE_FILE
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        out = []
+        for node in ast.walk(src.tree):
+            body = None
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and _jit_decorated(node):
+                body = node.body
+                where = f"jitted {node.name}()"
+            elif (
+                isinstance(node, ast.Call)
+                and _is_jit_expr(node.func)
+                and node.args
+                and isinstance(node.args[0], ast.Lambda)
+            ):
+                body = [node.args[0].body]
+                where = "jax.jit(<lambda>)"
+            if body is None:
+                continue
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Name)
+                        and sub.id == "self"
+                    ):
+                        out.append(
+                            self.finding(
+                                src,
+                                sub.lineno,
+                                f"{where} references `self` — trace "
+                                "cache becomes per-instance; pass "
+                                "state as arguments instead",
+                            )
+                        )
+                        break
+        return out
+
+
+class EagerJnpImportRule(Rule):
+    id = "JIT-002"
+    severity = WARNING
+    title = "no eager jnp calls at module import in serving/"
+    rationale = (
+        "A module-scope jnp call allocates on (and may initialize) "
+        "the backend at import time — serving modules must stay "
+        "importable without a device (the CLI, the gateway tests, "
+        "and the analysis pass all rely on cheap imports)."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        out = []
+        for node, owner in walk_with_owner(src.tree):
+            if (
+                owner is None
+                and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "jnp"
+            ):
+                out.append(
+                    self.finding(
+                        src,
+                        node.lineno,
+                        f"eager jnp.{node.func.attr}(...) at module "
+                        "scope runs at import time",
+                    )
+                )
+        return out
+
+
+_UNHASHABLE_DISPLAYS = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.SetComp,
+    ast.DictComp,
+    ast.GeneratorExp,
+)
+
+
+class ProgramCacheKeyRule(Rule):
+    id = "JIT-003"
+    severity = WARNING
+    title = "program-cache keys are hashable tuple literals"
+    rationale = (
+        "_cached_program silently falls back to per-instance builds "
+        "on an unhashable key (TypeError path) — a list/dict/set in "
+        "the key would disable program sharing without any failure."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        out = []
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "_cached_program"
+            ):
+                continue
+            if len(node.args) < 2:
+                continue
+            key = node.args[1]
+            if not isinstance(key, ast.Tuple):
+                out.append(
+                    self.finding(
+                        src,
+                        key.lineno,
+                        "_cached_program key must be a tuple "
+                        "literal (got "
+                        f"{type(key).__name__})",
+                    )
+                )
+                continue
+            for sub in ast.walk(key):
+                if isinstance(sub, _UNHASHABLE_DISPLAYS):
+                    out.append(
+                        self.finding(
+                            src,
+                            sub.lineno,
+                            "_cached_program key contains an "
+                            f"unhashable {type(sub).__name__} "
+                            "display — the cache would silently "
+                            "fall back to per-instance builds",
+                        )
+                    )
+                    break
+        return out
+
+
+# ---------------------------------------------------------------------------
+# EXC-001: broad excepts must re-raise, log, or carry a pragma
+
+
+_LOG_METHODS = frozenset(
+    {
+        "exception",
+        "warning",
+        "error",
+        "info",
+        "debug",
+        "critical",
+        "log",
+    }
+)
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except
+        return True
+    if isinstance(t, ast.Name) and t.id in (
+        "Exception",
+        "BaseException",
+    ):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(el, ast.Name)
+            and el.id in ("Exception", "BaseException")
+            for el in t.elts
+        )
+    return False
+
+
+def _handler_disposes(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _LOG_METHODS
+        ):
+            return True
+    return False
+
+
+class BroadExceptRule(Rule):
+    id = "EXC-001"
+    severity = WARNING
+    title = "broad excepts in serving/ must re-raise or log"
+    rationale = (
+        "A silent `except Exception: pass/continue` in the serving "
+        "path swallows real failures (XLA errors, KV outages) "
+        "indistinguishably from the faults it meant to tolerate — "
+        "the crash-safety story (DEVIATIONS §8) depends on failures "
+        "being observed."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [
+            self.finding(
+                src,
+                node.lineno,
+                "broad except neither re-raises nor logs — swallow "
+                "sites must be observable (or pragma'd with a "
+                "reason)",
+            )
+            for node in ast.walk(src.tree)
+            if isinstance(node, ast.ExceptHandler)
+            and _is_broad_handler(node)
+            and not _handler_disposes(node)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+
+REGISTRY: List[Rule] = [
+    RlImportRule(),
+    HostCopyRule(),
+    DeviceAllocRule(),
+    RawMeshRule(),
+    LockDisciplineRule(),
+    ClockDisciplineRule(),
+    JitSelfCaptureRule(),
+    EagerJnpImportRule(),
+    ProgramCacheKeyRule(),
+    BroadExceptRule(),
+]
+
+
+def get_rules(ids: Optional[List[str]] = None) -> List[Rule]:
+    if ids is None:
+        return list(REGISTRY)
+    by_id = {r.id: r for r in REGISTRY}
+    missing = [i for i in ids if i not in by_id]
+    if missing:
+        raise KeyError(
+            f"unknown rule id(s): {missing}; known: {sorted(by_id)}"
+        )
+    return [by_id[i] for i in ids]

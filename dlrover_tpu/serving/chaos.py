@@ -66,6 +66,13 @@ class FaultInjector:
     hook on the pool thread, and plan installs on the test thread.
     """
 
+    # cross-thread state under self._lock (LOCK-001). _rng stays out:
+    # plan installation — the only consumer — runs on the test thread
+    # before any hook thread exists.
+    GUARDED_FIELDS = frozenset(
+        {"_engine", "_slow", "_crashed", "_kv", "fired"}
+    )
+
     def __init__(self, seed: int = 0):
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()

@@ -243,7 +243,14 @@ class FailoverManager:
             for rep in self._targets(scheduler):
                 try:
                     placed = rep.scheduler.readmit(req, ticket)
-                except Exception:
+                except Exception:  # noqa: BLE001 — try the next peer
+                    # a raising readmit (peer crashed between the
+                    # _targets snapshot and here) must stay visible:
+                    # silently skipping peers hides a dying pool
+                    logger.exception(
+                        "readmit of request %d on replica %s failed",
+                        req.id, rep.id,
+                    )
                     continue
                 if placed:
                     if metrics is not None:

@@ -89,6 +89,12 @@ class ServingGateway:
     ServeRequest: a RequestScheduler (single replica) or a ReplicaPool
     (least-loaded routing across replicas)."""
 
+    # the gateway spawns the server thread but shares no mutable
+    # fields with it: backend/metrics/timeout are read-only after
+    # __init__, and per-request state lives on the handler instances
+    # (graftlint LOCK-001)
+    GUARDED_FIELDS = frozenset()
+
     def __init__(
         self,
         backend,

@@ -51,6 +51,53 @@ class ServingMetrics:
     TPOT = mean inter-token time after the first (decode rate).
     """
 
+    # every counter/gauge/window below is written by scheduler pump
+    # threads and read by gateway handler threads — all access goes
+    # through self._lock (graftlint LOCK-001)
+    GUARDED_FIELDS = frozenset(
+        {
+            "_ttft_ms",
+            "_tpot_ms",
+            "_queue_depth",
+            "_active_requests",
+            "_requests_total",
+            "_completed_total",
+            "_shed_total",
+            "_rejected_total",
+            "_tokens_total",
+            "_failed_total",
+            "_cancelled_total",
+            "_failovers_total",
+            "_replica_ejections",
+            "_replica_readmissions",
+            "_token_events",
+            "_prefix_hits",
+            "_prefix_misses",
+            "_prefix_evictions",
+            "_prefix_tokens_reused",
+            "_spec_proposed",
+            "_spec_accepted",
+            "_spec_rounds",
+            "_spec_emitted",
+            "_step_host_ms",
+            "_step_device_wait_ms",
+            "_step_dispatches",
+            "_step_overlap_ratio",
+            "_paged_occupancy",
+            "_paged_shared_ratio",
+            "_paged_used_pages",
+            "_paged_capacity",
+            "_paged_pages_allocated",
+            "_paged_pages_freed",
+            "_paged_pages_shared",
+            "_paged_cow_copies",
+            "_paged_swap_preemptions",
+            "_paged_swap_resumes",
+            "_mesh_tp",
+            "_replica_chips",
+        }
+    )
+
     def __init__(self, window: int = 512):
         self._lock = threading.Lock()
         self._ttft_ms = _Window(window)

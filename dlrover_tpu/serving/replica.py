@@ -131,6 +131,7 @@ class InferenceReplica:
         return json.dumps(
             {
                 "id": self.id,
+                # graftlint: allow(CLOCK-001) reason=wall-clock heartbeat ts read by master-side dead-replica staleness checks
                 "ts": time.time(),
                 "n_slots": eng.n_slots,
                 "queue_depth": self.scheduler.queue_depth(),
@@ -208,6 +209,13 @@ class InferenceReplica:
 class ReplicaPool:
     """Routes requests across replicas; health-checks them; emits
     scale hints from aggregate queue pressure."""
+
+    # shared between the pool's health-check thread, request threads
+    # routing through submit(force-hint path), and FailoverManager —
+    # access only under self._lock (graftlint LOCK-001)
+    GUARDED_FIELDS = frozenset(
+        {"_replicas", "breakers", "_last_hint_ts"}
+    )
 
     def __init__(
         self,
@@ -334,9 +342,10 @@ class ReplicaPool:
         clean probe re-admits the replica — restarting its scheduler
         first if it crashed (engine reset, empty queue). A failed
         probation re-trips with doubled backoff."""
-        breaker = self.breakers.get(rep.id)
-        if breaker is None:  # replica added behind the pool's back
-            breaker = self.breakers[rep.id] = self._new_breaker()
+        with self._lock:
+            breaker = self.breakers.get(rep.id)
+            if breaker is None:  # replica added behind the pool's back
+                breaker = self.breakers[rep.id] = self._new_breaker()
         if not breaker.should_probe():
             return
         try:
@@ -381,11 +390,16 @@ class ReplicaPool:
         by `hint_cooldown_s` so a pressure spike cannot flap the
         scaler (force=True bypasses, for tests)."""
         now = time.monotonic()
-        if (
-            not force
-            and now - self._last_hint_ts < self.hint_cooldown_s
-        ):
-            return None
+        # atomic check-and-stamp: the pool thread and a submit(force)
+        # on a request thread race here — without the lock both could
+        # pass the cooldown and double-write the hint
+        with self._lock:
+            if (
+                not force
+                and now - self._last_hint_ts < self.hint_cooldown_s
+            ):
+                return None
+            self._last_hint_ts = now
         reps = self.healthy_replicas()
         n = len(reps)
         pressure = self.aggregate_pressure()
@@ -416,12 +430,12 @@ class ReplicaPool:
             "replicas": target,
             "current": n,
             "pressure": round(pressure, 4),
+            # graftlint: allow(CLOCK-001) reason=wall-clock telemetry ts compared across hosts by the auto-scaler's staleness check
             "ts": time.time(),
             "chips_per_replica": cpr,
             "chips": target * cpr,
             "current_chips": n * cpr,
         }
-        self._last_hint_ts = now
         if self.kv is not None:
             try:
                 _kv_set(

@@ -158,6 +158,20 @@ class RequestScheduler:
     the gateway path) or by calling `pump()` / `run_to_completion()`
     directly (tests, benches: deterministic, no thread)."""
 
+    # cross-thread state shared by submit (request threads), pump
+    # (driver thread), and the failover paths — every access must hold
+    # self._lock/self._cond (graftlint LOCK-001)
+    GUARDED_FIELDS = frozenset(
+        {
+            "_waiting",
+            "_running",
+            "_seq",
+            "_next_id",
+            "crashed",
+            "journal",
+        }
+    )
+
     def __init__(
         self,
         engine: ContinuousBatcher,
@@ -272,11 +286,12 @@ class RequestScheduler:
 
     # ---- the loop --------------------------------------------------------
 
-    def _shed_expired(self, now: float):
+    def _shed_expired_locked(self, now: float):
         """Shed every WAITING request whose deadline already passed
         (the heap is deadline-ordered, so they sit at the front).
         Cancelled entries linger in the heap until they surface here
-        or at admission (lazy removal) — just drop them."""
+        or at admission (lazy removal) — just drop them. Caller holds
+        self._cond (the _locked convention)."""
         while self._waiting:
             deadline, _, req = self._waiting[0]
             if req.state is not RequestState.QUEUED:
@@ -308,7 +323,7 @@ class RequestScheduler:
             if self.crashed:
                 return False
             now = self._clock()
-            self._shed_expired(now)
+            self._shed_expired_locked(now)
             try:
                 # admit only up to the engine's free slots so EDF
                 # order, not engine-internal FIFO, decides dispatch
@@ -350,6 +365,7 @@ class RequestScheduler:
                 events = (
                     self.engine.step() if self.engine.has_work() else []
                 )
+            # graftlint: allow(EXC-001) reason=failure is logged and dispatched outside the lock by _dispatch_failure below
             except Exception as exc:
                 failure = (self._crash_locked(), exc)
                 events = []

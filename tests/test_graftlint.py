@@ -1,0 +1,531 @@
+"""graftlint self-tests (dlrover_tpu/analysis).
+
+Two halves, mirroring tests/test_layering.py's vacuity-guard
+discipline:
+
+1. the CLEAN-TREE contract: the whole registry runs over the repo and
+   must report zero unsuppressed findings (this is how the registry
+   runs in tier-1 by default), and every suppression on the tree
+   carries a reason.
+2. per-rule OFFENDER probes: each rule must flag a synthetic
+   known-bad snippet — a rule that cannot detect its own violation
+   pattern is passing vacuously.
+
+Plus pragma semantics (same-line, comment-line-above, reasonless →
+GRAFT-000) and the CLI end-to-end (--json exit status contract the
+bench preflights rely on).
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dlrover_tpu import analysis
+from dlrover_tpu.analysis import (
+    CRITICAL,
+    SourceFile,
+    run_rules,
+    unsuppressed,
+)
+from dlrover_tpu.analysis.rules import (
+    REGISTRY,
+    BroadExceptRule,
+    ClockDisciplineRule,
+    DeviceAllocRule,
+    EagerJnpImportRule,
+    HostCopyRule,
+    JitSelfCaptureRule,
+    LockDisciplineRule,
+    ProgramCacheKeyRule,
+    RawMeshRule,
+    RlImportRule,
+    get_rules,
+)
+
+pytestmark = pytest.mark.lint
+
+SERVING_REL = "dlrover_tpu/serving/probe.py"
+ENGINE_REL = "dlrover_tpu/serving/engine.py"
+
+
+def probe(tmp_path, code, rel=SERVING_REL, name="probe.py"):
+    """A synthetic SourceFile impersonating `rel` so per-file rule
+    config applies to it."""
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(code))
+    return SourceFile.parse(path, rel=rel)
+
+
+def hits(rule, src):
+    return [
+        f
+        for f in unsuppressed(run_rules([rule], files=[src]))
+        if f.rule_id == rule.id
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the clean-tree contract (the registry's tier-1 entry point)
+
+
+def test_registry_clean_on_tree():
+    findings = analysis.run()
+    active = unsuppressed(findings)
+    assert not active, "graftlint findings on the tree:\n" + "\n".join(
+        f.render() for f in active
+    )
+
+
+def test_tree_suppressions_all_carry_reasons():
+    suppressed = [f for f in analysis.run() if f.suppressed]
+    # the tree is expected to carry a few deliberate pragmas …
+    assert suppressed, "expected at least one pragma'd site"
+    # … and every one of them must explain itself
+    for f in suppressed:
+        assert f.suppression_reason, f.render()
+
+
+def test_no_outstanding_critical_findings():
+    assert analysis.critical_findings() == []
+
+
+def test_bench_preflight_gate(monkeypatch, capsys):
+    # clean tree: no-op — and the refusal path must actually fire,
+    # exit code 2 with the finding rendered, when criticals exist
+    analysis.bench_preflight("probe-bench")
+    bad = analysis.Finding(
+        rule_id="CLOCK-001",
+        severity=CRITICAL,
+        path="dlrover_tpu/serving/replica.py",
+        line=1,
+        message="synthetic",
+    )
+    monkeypatch.setattr(analysis, "critical_findings", lambda: [bad])
+    with pytest.raises(SystemExit) as exc:
+        analysis.bench_preflight("probe-bench")
+    assert exc.value.code == 2
+    out = capsys.readouterr().out
+    assert "refusing to run" in out and "CLOCK-001" in out
+
+
+# ---------------------------------------------------------------------------
+# per-rule synthetic offenders
+
+
+def test_layer_rule_flags_rl_imports(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import dlrover_tpu.rl
+        from dlrover_tpu.rl import serve
+        from dlrover_tpu import rl
+        """,
+    )
+    assert len(hits(RlImportRule(), src)) == 3
+
+
+def test_layer_rule_ignores_relative_imports(tmp_path):
+    src = probe(tmp_path, "from . import engine\n")
+    assert not hits(RlImportRule(), src)
+
+
+def test_host_copy_rule_flags_stray_fetch(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import numpy as np
+        def step(self):
+            return np.array(self.tok)
+        def _to_host(*arrays):
+            return tuple(np.array(a) for a in arrays)
+        """,
+        rel=ENGINE_REL,
+    )
+    found = hits(HostCopyRule(), src)
+    assert len(found) == 1 and "step" in found[0].message
+
+
+def test_host_copy_rule_generalizes_beyond_engine(tmp_path):
+    # decode.py and paged_kv.py have EMPTY allowlists: any host
+    # materialization at all is a finding there
+    for rel in (
+        "dlrover_tpu/models/decode.py",
+        "dlrover_tpu/serving/paged_kv.py",
+    ):
+        src = probe(
+            tmp_path,
+            """
+            import jax
+            def anything(x):
+                return jax.device_get(x)
+            """,
+            rel=rel,
+        )
+        assert len(hits(HostCopyRule(), src)) == 1, rel
+
+
+def test_alloc_rule_flags_hot_path_allocation(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        class ContinuousBatcher:
+            def __init__(self):
+                self.bank = jnp.zeros((4, 4))
+            def reset(self):
+                self.bank = jnp.zeros((4, 4))
+            def step(self):
+                return jnp.zeros((4,)), init_page_pool()
+        """,
+        rel=ENGINE_REL,
+    )
+    found = hits(DeviceAllocRule(), src)
+    # jnp.zeros AND the bulk constructor in step(); __init__/reset ok
+    assert len(found) == 2
+    assert all("step" in f.message for f in found)
+
+
+def test_mesh_rule_flags_raw_mesh(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        from jax.sharding import Mesh
+        import jax
+        m = jax.sharding.Mesh(devs, ("tp",))
+        """,
+    )
+    assert len(hits(RawMeshRule(), src)) == 2
+
+
+def test_lock_rule_requires_guarded_fields_declaration(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import threading
+        class Sched:
+            def __init__(self):
+                self._lock = threading.Lock()
+        """,
+    )
+    found = hits(LockDisciplineRule(), src)
+    assert len(found) == 1 and "GUARDED_FIELDS" in found[0].message
+
+
+_LOCKED_CLASS = """
+import threading
+class Sched:
+    GUARDED_FIELDS = frozenset({"_q"})
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = []
+    def {method}(self):
+        {body}
+"""
+
+
+def _lock_probe(tmp_path, method, body):
+    return probe(
+        tmp_path,
+        _LOCKED_CLASS.replace("{method}", method).replace(
+            "{body}", body
+        ),
+    )
+
+
+def test_lock_rule_flags_unguarded_access(tmp_path):
+    src = _lock_probe(tmp_path, "drain", "return len(self._q)")
+    found = hits(LockDisciplineRule(), src)
+    assert len(found) == 1 and "self._q" in found[0].message
+
+
+def test_lock_rule_accepts_with_lock(tmp_path):
+    src = _lock_probe(
+        tmp_path,
+        "drain",
+        "with self._lock:\n            return len(self._q)",
+    )
+    assert not hits(LockDisciplineRule(), src)
+
+
+def test_lock_rule_accepts_locked_convention(tmp_path):
+    src = _lock_probe(tmp_path, "drain_locked", "return len(self._q)")
+    assert not hits(LockDisciplineRule(), src)
+
+
+def test_lock_rule_accepts_cond_guard(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import threading
+        class Sched:
+            GUARDED_FIELDS = frozenset({"_q"})
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._cond = threading.Condition(self._lock)
+                self._q = []
+            def pump(self):
+                with self._cond:
+                    self._q.append(1)
+        """,
+    )
+    assert not hits(LockDisciplineRule(), src)
+
+
+def test_lock_rule_catches_the_pre_pr9_shed_bug(tmp_path):
+    # regression probe for the exact latent pattern this PR fixed:
+    # scheduler._shed_expired touched the EDF heap with neither a
+    # lexical lock nor the _locked naming convention
+    src = probe(
+        tmp_path,
+        """
+        import threading
+        class RequestScheduler:
+            GUARDED_FIELDS = frozenset({"_waiting"})
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._cond = threading.Condition(self._lock)
+                self._waiting = []
+            def _shed_expired(self, now):
+                while self._waiting:
+                    self._waiting.pop()
+        """,
+    )
+    assert len(hits(LockDisciplineRule(), src)) == 2
+
+
+def test_clock_rule_flags_wall_clock(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import time
+        def deadline():
+            return time.time() + 5.0
+        def ok():
+            return time.monotonic() + 5.0
+        """,
+    )
+    found = hits(ClockDisciplineRule(), src)
+    assert len(found) == 1
+    assert found[0].severity == CRITICAL
+
+
+def test_jit_rule_flags_self_capture(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import jax
+        from functools import partial
+        class Engine:
+            @partial(jax.jit, static_argnums=(0,))
+            def _step(self, tok):
+                return tok + self.offset
+        @jax.jit
+        def good(tok):
+            return tok + 1
+        """,
+    )
+    found = hits(JitSelfCaptureRule(), src)
+    assert len(found) == 1 and "self" in found[0].message
+
+
+def test_jit_rule_flags_jitted_lambda_capture(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import jax
+        class Engine:
+            def build(self):
+                return jax.jit(lambda t: t + self.offset)
+        """,
+    )
+    assert len(hits(JitSelfCaptureRule(), src)) == 1
+
+
+def test_eager_jnp_rule_flags_import_time_calls(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        _TABLE = jnp.arange(16)
+        def fine():
+            return jnp.arange(16)
+        _LAZY = lambda: jnp.arange(16)
+        """,
+    )
+    found = hits(EagerJnpImportRule(), src)
+    assert len(found) == 1 and "arange" in found[0].message
+
+
+def test_cache_key_rule_flags_unhashable_keys(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        def build():
+            pass
+        a = _cached_program(C, (cfg, pad_id), build)
+        b = _cached_program(C, [cfg, pad_id], build)
+        c = _cached_program(C, (cfg, [1, 2]), build)
+        """,
+        rel=ENGINE_REL,
+    )
+    found = hits(ProgramCacheKeyRule(), src)
+    assert len(found) == 2
+    assert any("tuple literal" in f.message for f in found)
+    assert any("List display" in f.message for f in found)
+
+
+def test_except_rule_flags_silent_swallows(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        def a():
+            try:
+                risky()
+            except Exception:
+                pass
+        def b():
+            try:
+                risky()
+            except:
+                continue_on()
+        def c():
+            try:
+                risky()
+            except Exception:
+                logger.exception("boom")
+        def d():
+            try:
+                risky()
+            except Exception:
+                raise
+        def e():
+            try:
+                risky()
+            except ValueError:
+                pass
+        """,
+    )
+    found = hits(BroadExceptRule(), src)
+    assert len(found) == 2  # a() and b(); c/d dispose, e is typed
+
+
+# ---------------------------------------------------------------------------
+# pragma semantics
+
+
+def test_pragma_suppresses_with_reason(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import time
+        def beat():
+            return time.time()  # graftlint: allow(CLOCK-001) reason=wall-clock telemetry
+        """,
+    )
+    findings = run_rules([ClockDisciplineRule()], files=[src])
+    assert len(findings) == 1
+    assert findings[0].suppressed
+    assert findings[0].suppression_reason == "wall-clock telemetry"
+    assert not unsuppressed(findings)
+
+
+def test_pragma_on_comment_line_covers_next_line(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import time
+        def beat():
+            # graftlint: allow(CLOCK-001) reason=telemetry ts
+            return time.time()
+        """,
+    )
+    assert not unsuppressed(
+        run_rules([ClockDisciplineRule()], files=[src])
+    )
+
+
+def test_pragma_without_reason_is_critical(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import time
+        def beat():
+            return time.time()  # graftlint: allow(CLOCK-001)
+        """,
+    )
+    findings = run_rules([ClockDisciplineRule()], files=[src])
+    meta = [f for f in findings if f.rule_id == "GRAFT-000"]
+    assert len(meta) == 1
+    assert meta[0].severity == CRITICAL
+    assert not meta[0].suppressed
+
+
+def test_pragma_for_other_rule_does_not_suppress(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import time
+        def beat():
+            return time.time()  # graftlint: allow(EXC-001) reason=mismatched id
+        """,
+    )
+    findings = run_rules([ClockDisciplineRule()], files=[src])
+    assert [f.rule_id for f in unsuppressed(findings)] == [
+        "CLOCK-001"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# registry / CLI
+
+
+def test_registry_ids_unique_and_selectable():
+    ids = [r.id for r in REGISTRY]
+    assert len(ids) == len(set(ids))
+    assert [r.id for r in get_rules(["CLOCK-001"])] == ["CLOCK-001"]
+    with pytest.raises(KeyError):
+        get_rules(["NOPE-999"])
+    for rule in REGISTRY:
+        assert rule.rationale and rule.title
+
+
+def _cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "dlrover_tpu.analysis", *argv],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_cli_json_exits_zero_on_clean_tree():
+    res = _cli("--json")
+    assert res.returncode == 0, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert payload["suppressed"], "expected the tree's pragma'd sites"
+    assert all(f["suppression_reason"] for f in payload["suppressed"])
+
+
+def test_cli_flags_offender_file(tmp_path):
+    bad = tmp_path / "dlrover_tpu" / "serving" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import time\nTS = time.time()\n")
+    res = _cli("--rules", "CLOCK-001", str(bad))
+    assert res.returncode == 1
+    assert "CLOCK-001" in res.stdout
+
+
+def test_cli_rejects_unknown_rule():
+    assert _cli("--rules", "NOPE-999").returncode == 2
+
+
+def test_cli_list_names_every_rule():
+    res = _cli("--list")
+    assert res.returncode == 0
+    for rule in REGISTRY:
+        assert rule.id in res.stdout

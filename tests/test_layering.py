@@ -1,129 +1,68 @@
-"""Layering lints, enforced by AST walk instead of review comments.
+"""Layering lints — thin bridge over the graftlint registry.
 
-1. dlrover_tpu/serving/ must not import dlrover_tpu.rl. DEVIATIONS §5
-   makes the dependency one-way — rl/serve.py imports the serving
-   engine, never the reverse — so the serving stack stays usable
-   without the RL stack.
+The four AST walkers that used to live here are now registry rules in
+dlrover_tpu/analysis/rules.py (LAYER-001, HOST-001, ALLOC-001,
+MESH-001), run by `python -m dlrover_tpu.analysis` and by
+tests/test_graftlint.py alongside the newer lock/clock/jit/exception
+rules. These tests keep their original names (and their vacuity
+guards) so the contracts stay individually addressable:
+
+1. dlrover_tpu/serving/ must not import dlrover_tpu.rl (DEVIATIONS
+   §5 — the dependency is one-way).
 2. serving/engine.py must not materialize device arrays outside the
-   ONE designated fetch helper (`_to_host`) and the functions that
-   legitimately touch host data (admission, retire, reset, drain).
-   The async dispatch design (DEVIATIONS §9) depends on the step hot
-   path never issuing a fresh blocking device->host copy — a stray
-   np.array(<jax array>) would silently serialize host and device
-   again, and nothing but this lint would notice."""
+   ONE designated fetch helper (`_to_host`) and the host-data paths
+   (DEVIATIONS §9 — async dispatch).
+3. the engine hot path must not allocate device arrays per step
+   (DEVIATIONS §10 — paged layout).
+4. serving/ must not construct a raw jax.sharding.Mesh (DEVIATIONS
+   §11 — the ONE factory is parallel/mesh.py).
+"""
 
 import ast
 import pathlib
 
 import dlrover_tpu.serving
+from dlrover_tpu.analysis import SourceFile, run_rules, unsuppressed
+from dlrover_tpu.analysis.rules import (
+    DeviceAllocRule,
+    HostCopyRule,
+    RawMeshRule,
+    RlImportRule,
+    class_alloc_sites,
+    host_copy_sites,
+    raw_mesh_uses,
+)
 
 SERVING_DIR = pathlib.Path(dlrover_tpu.serving.__file__).parent
-FORBIDDEN = "dlrover_tpu.rl"
+REPO_ROOT = SERVING_DIR.parent.parent
 
 
-def _violations(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                name = alias.name
-                if name == FORBIDDEN or name.startswith(
-                    FORBIDDEN + "."
-                ):
-                    out.append((node.lineno, f"import {name}"))
-        elif isinstance(node, ast.ImportFrom):
-            # level>0 is a relative import inside serving/ — it cannot
-            # reach dlrover_tpu.rl without an absolute name
-            mod = node.module or ""
-            if node.level == 0 and (
-                mod == FORBIDDEN or mod.startswith(FORBIDDEN + ".")
-            ):
-                out.append((node.lineno, f"from {mod} import ..."))
-            elif node.level == 0 and mod == "dlrover_tpu":
-                for alias in node.names:
-                    if alias.name == "rl":
-                        out.append(
-                            (node.lineno, "from dlrover_tpu import rl")
-                        )
-    return out
+def _serving_sources():
+    files = sorted(SERVING_DIR.rglob("*.py"))
+    assert files, f"no sources under {SERVING_DIR}"
+    return [SourceFile.parse(p, root=REPO_ROOT) for p in files]
+
+
+def _offenders(rule, sources):
+    return [
+        f.render()
+        for f in unsuppressed(run_rules([rule], files=sources))
+        if f.rule_id == rule.id
+    ]
 
 
 def test_serving_never_imports_rl():
-    offenders = []
-    files = sorted(SERVING_DIR.rglob("*.py"))
-    assert files, f"no sources under {SERVING_DIR}"
-    for path in files:
-        for lineno, what in _violations(path):
-            offenders.append(f"{path}:{lineno}: {what}")
+    offenders = _offenders(RlImportRule(), _serving_sources())
     assert not offenders, (
         "serving/ must not depend on rl/ (DEVIATIONS §5):\n"
         + "\n".join(offenders)
     )
 
 
-# functions in engine.py allowed to materialize host arrays: the ONE
-# designated device fetch point, plus the host-data paths (prompt
-# normalization at submit, PRNG-key capture at admit, output-list
-# conversion at retire/drain, prompt-folding at preemption — all of
-# which only touch host-resident numpy data, never a dispatch result)
-_HOST_COPY_ALLOWED = {
-    "_to_host",
-    "submit",
-    "_admit",
-    "retire",
-    "generate_all",
-    "_preempt_slot",
-}
-
-# calls that synchronously materialize a device array on host
-_HOST_COPY_CALLS = {
-    ("np", "array"),
-    ("np", "asarray"),
-    ("np", "copy"),
-    ("numpy", "array"),
-    ("numpy", "asarray"),
-    ("numpy", "copy"),
-    ("jax", "device_get"),
-}
-
-
-def _host_copy_calls(tree):
-    """(lineno, call, enclosing-function-name) for every potentially
-    blocking host materialization; enclosing name is None at module
-    scope."""
-    out = []
-
-    def visit(node, owner):
-        if isinstance(
-            node, (ast.FunctionDef, ast.AsyncFunctionDef)
-        ):
-            owner = node.name
-        if isinstance(node, ast.Call):
-            f = node.func
-            if (
-                isinstance(f, ast.Attribute)
-                and isinstance(f.value, ast.Name)
-                and (f.value.id, f.attr) in _HOST_COPY_CALLS
-            ):
-                out.append(
-                    (node.lineno, f"{f.value.id}.{f.attr}", owner)
-                )
-        for child in ast.iter_child_nodes(node):
-            visit(child, owner)
-
-    visit(tree, None)
-    return out
-
-
 def test_engine_host_copies_only_in_designated_fetch_helper():
     path = SERVING_DIR / "engine.py"
-    tree = ast.parse(path.read_text(), filename=str(path))
-    offenders = [
-        f"{path}:{lineno}: {call} in {owner or '<module>'}()"
-        for lineno, call, owner in _host_copy_calls(tree)
-        if owner not in _HOST_COPY_ALLOWED
-    ]
+    src = SourceFile.parse(path, root=REPO_ROOT)
+    offenders = _offenders(HostCopyRule(), [src])
     assert not offenders, (
         "engine.py must fetch device arrays only through _to_host "
         "(async dispatch contract, DEVIATIONS §9) — a blocking "
@@ -133,141 +72,30 @@ def test_engine_host_copies_only_in_designated_fetch_helper():
     # the lint must actually see the designated helper — if _to_host
     # is renamed this test should fail loudly, not pass vacuously
     assert any(
-        owner == "_to_host" for _, _, owner in _host_copy_calls(tree)
+        owner == "_to_host"
+        for _, _, owner in host_copy_sites(src.tree)
     )
-
-
-# 3. the paged hot path must not allocate device arrays per step.
-# Page tables, the page pool, and the trash row are built ONCE in
-# __init__/reset and thereafter only updated through the jitted
-# programs (donated buffers). A stray jnp.zeros(...) inside an
-# engine method would allocate + transfer on every call — exactly
-# the per-step overhead the paged layout exists to avoid. Module-
-# level jit builders are exempt: jnp calls there run under trace
-# and compile into the program instead of allocating eagerly.
-_DEVICE_ALLOC_ALLOWED = {"__init__", "reset"}
-
-_DEVICE_ALLOC_CALLS = {
-    ("jnp", "zeros"),
-    ("jnp", "ones"),
-    ("jnp", "full"),
-    ("jnp", "empty"),
-    ("jnp", "arange"),
-    ("jnp", "zeros_like"),
-    ("jnp", "ones_like"),
-    ("jnp", "full_like"),
-}
-
-# bulk device-state constructors (engine.py top-level helpers)
-_DEVICE_ALLOC_NAMES = {"init_kv_cache", "init_page_pool"}
-
-
-def _class_method_alloc_calls(tree, class_name):
-    """(lineno, call, method-name) for every eager device allocation
-    inside methods of `class_name` (module-level functions — the jit
-    program builders — are intentionally out of scope)."""
-    cls = next(
-        (
-            n
-            for n in tree.body
-            if isinstance(n, ast.ClassDef) and n.name == class_name
-        ),
-        None,
-    )
-    assert cls is not None, f"class {class_name} not found"
-    out = []
-    for method in cls.body:
-        if not isinstance(
-            method, (ast.FunctionDef, ast.AsyncFunctionDef)
-        ):
-            continue
-        for node in ast.walk(method):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if (
-                isinstance(f, ast.Attribute)
-                and isinstance(f.value, ast.Name)
-                and (f.value.id, f.attr) in _DEVICE_ALLOC_CALLS
-            ):
-                out.append(
-                    (node.lineno, f"{f.value.id}.{f.attr}", method.name)
-                )
-            elif (
-                isinstance(f, ast.Name)
-                and f.id in _DEVICE_ALLOC_NAMES
-            ):
-                out.append((node.lineno, f.id, method.name))
-    return out
 
 
 def test_engine_hot_path_never_allocates_device_arrays():
     path = SERVING_DIR / "engine.py"
-    tree = ast.parse(path.read_text(), filename=str(path))
-    calls = _class_method_alloc_calls(tree, "ContinuousBatcher")
-    offenders = [
-        f"{path}:{lineno}: {call} in {owner}()"
-        for lineno, call, owner in calls
-        if owner not in _DEVICE_ALLOC_ALLOWED
-    ]
+    src = SourceFile.parse(path, root=REPO_ROOT)
+    offenders = _offenders(DeviceAllocRule(), [src])
     assert not offenders, (
         "ContinuousBatcher may allocate device arrays only in "
         "__init__/reset — the paged hot path updates page tables "
         "through donated jitted programs, never per-step jnp "
         "constructors:\n" + "\n".join(offenders)
     )
-    # vacuity guard: __init__ DOES allocate (pool/table); if the
-    # walker stops seeing those, it stopped seeing anything
-    assert any(owner == "__init__" for _, _, owner in calls)
-
-
-# 4. serving/ must not construct jax.sharding.Mesh directly. The ONE
-# mesh factory is parallel/mesh.py (serving_mesh + serving_mesh_spec):
-# it owns axis naming, device selection, and the divisibility
-# validation. A raw Mesh(...) inside serving/ would mint a second,
-# unvalidated axis-name convention that decode.py's PartitionSpecs
-# silently would not match (GSPMD falls back to replicated — correct
-# bytes, zero speedup, nothing fails loudly).
-
-
-def _raw_mesh_uses(path: pathlib.Path):
-    """(lineno, what) for every direct jax.sharding.Mesh reference:
-    `from jax.sharding import Mesh`, `jax.sharding.Mesh(...)`, or an
-    aliased `sharding.Mesh(...)`."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            mod = node.module or ""
-            if node.level == 0 and mod == "jax.sharding":
-                for alias in node.names:
-                    if alias.name == "Mesh":
-                        out.append(
-                            (
-                                node.lineno,
-                                "from jax.sharding import Mesh",
-                            )
-                        )
-        elif isinstance(node, ast.Attribute) and node.attr == "Mesh":
-            v = node.value
-            # jax.sharding.Mesh  /  sharding.Mesh
-            if (
-                isinstance(v, ast.Attribute)
-                and v.attr == "sharding"
-                and isinstance(v.value, ast.Name)
-                and v.value.id == "jax"
-            ) or (isinstance(v, ast.Name) and v.id == "sharding"):
-                out.append((node.lineno, ast.unparse(node)))
-    return out
+    # vacuity guard: ContinuousBatcher.__init__ DOES allocate (pool/
+    # table); if the walker stops seeing those, it stopped seeing
+    # anything
+    calls = class_alloc_sites(src.tree, "ContinuousBatcher")
+    assert any(method == "__init__" for _, _, method, _ in calls)
 
 
 def test_serving_never_constructs_raw_mesh():
-    offenders = []
-    files = sorted(SERVING_DIR.rglob("*.py"))
-    assert files, f"no sources under {SERVING_DIR}"
-    for path in files:
-        for lineno, what in _raw_mesh_uses(path):
-            offenders.append(f"{path}:{lineno}: {what}")
+    offenders = _offenders(RawMeshRule(), _serving_sources())
     assert not offenders, (
         "serving/ must build meshes through parallel/mesh.py "
         "(serving_mesh validates tp against devices and KV heads and "
@@ -276,18 +104,9 @@ def test_serving_never_constructs_raw_mesh():
     )
     # vacuity guard: the walker must flag the patterns it exists to
     # catch — check against a synthetic offender, not the clean tree
-    import tempfile
-
-    with tempfile.NamedTemporaryFile(
-        "w", suffix=".py", delete=False
-    ) as f:
-        f.write(
-            "from jax.sharding import Mesh\n"
-            "import jax\n"
-            "m = jax.sharding.Mesh(devs, ('tp',))\n"
-        )
-        probe = pathlib.Path(f.name)
-    try:
-        assert len(_raw_mesh_uses(probe)) == 2
-    finally:
-        probe.unlink()
+    probe = ast.parse(
+        "from jax.sharding import Mesh\n"
+        "import jax\n"
+        "m = jax.sharding.Mesh(devs, ('tp',))\n"
+    )
+    assert len(raw_mesh_uses(probe)) == 2
