@@ -824,6 +824,133 @@ class BroadExceptRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# KERNEL-001: Pallas/shard_map hygiene
+
+
+OPS_PREFIX = "dlrover_tpu/ops/"
+PARALLEL_PREFIX = "dlrover_tpu/parallel/"
+
+
+def _in_ops(src: SourceFile) -> bool:
+    return OPS_PREFIX in src.rel
+
+
+def _in_parallel(src: SourceFile) -> bool:
+    return PARALLEL_PREFIX in src.rel
+
+
+def pallas_call_sites(
+    tree: ast.AST,
+) -> List[Tuple[int, Optional[str]]]:
+    """(lineno, unparsed-interpret-kwarg-or-None) for every
+    `pallas_call(...)` / `pl.pallas_call(...)` invocation."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        named = isinstance(f, ast.Name) and f.id == "pallas_call"
+        attred = (
+            isinstance(f, ast.Attribute) and f.attr == "pallas_call"
+        )
+        if not (named or attred):
+            continue
+        interp = None
+        for kw in node.keywords:
+            if kw.arg == "interpret":
+                interp = ast.unparse(kw.value)
+        out.append((node.lineno, interp))
+    return out
+
+
+def shard_map_uses(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, what) for every shard_map import or call: `from jax
+    import shard_map`, `from jax.experimental.shard_map import ...`,
+    `shard_map(...)`, or any `<x>.shard_map(...)`."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level == 0 and mod == "jax.experimental.shard_map":
+                out.append(
+                    (node.lineno, f"from {mod} import ...")
+                )
+            elif node.level == 0 and mod == "jax":
+                for alias in node.names:
+                    if alias.name == "shard_map":
+                        out.append(
+                            (
+                                node.lineno,
+                                "from jax import shard_map",
+                            )
+                        )
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "shard_map":
+                out.append((node.lineno, "shard_map(...)"))
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr == "shard_map"
+            ):
+                out.append(
+                    (node.lineno, f"{ast.unparse(f)}(...)")
+                )
+    return out
+
+
+class KernelHygieneRule(Rule):
+    id = "KERNEL-001"
+    severity = CRITICAL
+    title = "Pallas kernels gate interpret; shard_map stays in ops//parallel/"
+    rationale = (
+        "DEVIATIONS §13: every pallas_call must pass "
+        "interpret=_interpret() so the same kernel body runs "
+        "compiled on TPU and interpreted in the CPU parity tests — "
+        "a hardcoded interpret flag silently forks the two. And "
+        "shard_map is a kernel/collective implementation detail: "
+        "models and serving consume it only through the ops/ entry "
+        "points (sharded_flash_attention, paged_attention) and "
+        "parallel/ wrappers, so the no-collectives-in-kernel-body "
+        "contract stays auditable in one place."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        # every package file: ops/ gets the interpret check, files
+        # outside ops//parallel/ get the shard_map containment check
+        return True
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        findings = []
+        if _in_ops(src):
+            for lineno, interp in pallas_call_sites(src.tree):
+                if interp is None or not interp.endswith(
+                    "_interpret()"
+                ):
+                    findings.append(
+                        self.finding(
+                            src,
+                            lineno,
+                            "pallas_call must pass "
+                            "interpret=_interpret() (got "
+                            f"interpret={interp})",
+                        )
+                    )
+        if not (_in_ops(src) or _in_parallel(src)):
+            for lineno, what in shard_map_uses(src.tree):
+                findings.append(
+                    self.finding(
+                        src,
+                        lineno,
+                        f"{what} — shard_map may only be "
+                        "imported/constructed under ops/ or "
+                        "parallel/; call the ops/ entry points "
+                        "instead",
+                    )
+                )
+        return findings
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -838,6 +965,7 @@ REGISTRY: List[Rule] = [
     EagerJnpImportRule(),
     ProgramCacheKeyRule(),
     BroadExceptRule(),
+    KernelHygieneRule(),
 ]
 
 

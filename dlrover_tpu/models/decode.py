@@ -226,6 +226,7 @@ def _write_cache_and_attend(
         impl = "reference" if attn_impl == "reference" else "auto"
         attn = dot_product_attention(
             q, k, v, causal=True, impl=impl, tp=_mesh_tp(mesh),
+            mesh=mesh,
         )
     else:
         attn = _cached_attention(
@@ -719,7 +720,8 @@ def _paged_view(
 
 
 def _write_pages_and_attend(
-    q, k, v, layer_pool, table, positions, head_dim, mesh=None
+    q, k, v, layer_pool, table, positions, head_dim, mesh=None,
+    attn_impl: str = "auto",
 ):
     """The paged counterpart of `_write_cache_and_attend`: scatter
     this chunk's K/V into the slot's PAGES (row b, chunk position s →
@@ -750,7 +752,10 @@ def _write_pages_and_attend(
         arr = layer_pool[name]
         out_pool[name] = arr.at[pids, offs].set(upd.astype(arr.dtype))
     s = q.shape[1]
-    if s == 1:
+    # attn_impl='reference' is the byte-parity oracle knob: it pins
+    # the gathered-view formulation even where use_kernel would take
+    # the Pallas path (real TPU, or forced interpret kernels)
+    if s == 1 and attn_impl != "reference":
         from dlrover_tpu.ops import paged_attention as pa
 
         q1 = q[:, 0]
@@ -759,6 +764,7 @@ def _write_pages_and_attend(
             attn = pa.paged_attention(
                 q1, out_pool, table, lengths,
                 scale=float(head_dim) ** -0.5, impl="kernel",
+                mesh=mesh,
             )
             return constrain(attn[:, None], mesh), out_pool
     view = _paged_view(out_pool, table)
@@ -780,6 +786,7 @@ def _block_paged(
     attn, layer_pool = _write_pages_and_attend(
         q, k, v, layer_pool, table, positions, cfg.head_dim,
         mesh=mesh,
+        attn_impl=getattr(cfg, "attn_impl", "auto"),
     )
     x = _attn_residual(cfg, None, x, attn, lp)
     x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
@@ -795,6 +802,7 @@ def _block_gpt_paged(
     attn, layer_pool = _write_pages_and_attend(
         q, k, v, layer_pool, table, positions, cfg.head_dim,
         mesh=mesh,
+        attn_impl=getattr(cfg, "attn_impl", "auto"),
     )
     x = gpt._attn_residual(cfg, x, attn, lp)
     x = gpt._mlp_residual(cfg, x, lp)

@@ -79,17 +79,22 @@ def dot_product_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     tp: int = 1,
+    mesh=None,
 ) -> jax.Array:
     """Main entry. impl: 'auto' | 'flash' | 'reference'.
 
     'auto' uses the Pallas flash kernel on TPU when shapes allow
     (seq % block == 0, head_dim tile-able), else the XLA reference.
+    DLROVER_TPU_FORCE_KERNELS=1 (flash_attention.force_kernels) lets
+    tests/bench dispatch the interpret-mode kernel off-TPU too.
 
     `tp` > 1 declares the caller runs under GSPMD head sharding
-    (serving mesh): 'auto' then always takes the reference — the
-    flash kernel is not shard_mapped yet, and an unpartitioned
-    pallas_call inside a sharded program would force a regather,
-    while the reference einsums partition per head for free.
+    (serving mesh): 'auto' then takes the kernel shard_mapped over
+    `mesh`'s "tp" axis — each shard runs flash on its PER-SHARD heads
+    (attention is embarrassingly parallel over heads, so the body
+    needs no collectives) — whenever the per-shard shapes pass
+    `supports(..., tp=tp)` and a mesh is provided; otherwise the
+    reference, whose einsums partition per head for free.
     """
     if impl == "reference":
         return reference_attention(q, k, v, causal, scale, segment_ids)
@@ -102,13 +107,19 @@ def dot_product_attention(
                 "use impl='reference' for packed sequences"
             )
         take_flash = impl == "flash" or (
-            _tpu_available()
-            and tp == 1
+            (_tpu_available() or fa.force_kernels())
+            and (tp == 1 or mesh is not None)
             and fa.supports(
-                q, k, segment_ids, block_q=block_q, block_k=block_k
+                q, k, segment_ids,
+                block_q=block_q, block_k=block_k, tp=tp,
             )
         )
         if take_flash:
+            if mesh is not None and tp > 1:
+                return fa.sharded_flash_attention(
+                    q, k, v, mesh, causal=causal, scale=scale,
+                    block_q=block_q, block_k=block_k,
+                )
             return fa.flash_attention(
                 q, k, v, causal=causal, scale=scale,
                 block_q=block_q, block_k=block_k,

@@ -13,12 +13,22 @@ broadcast outside the custom_vjp boundary (autodiff reduces dK/dV).
 """
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+try:  # jax >= 0.8 moved shard_map out of experimental
+    from jax import shard_map as _shard_map
+
+    shard_map = functools.partial(_shard_map, check_vma=False)
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    shard_map = functools.partial(_shard_map, check_rep=False)
 
 # jax renamed TPUCompilerParams -> CompilerParams around 0.5; accept
 # both so the kernels (and their interpret-mode tests) run on either
@@ -38,6 +48,31 @@ _VMEM_BUDGET = 8 * 1024 * 1024
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
+
+
+def force_kernels() -> bool:
+    """DLROVER_TPU_FORCE_KERNELS=1 makes the dispatch gates
+    (attention.dot_product_attention 'auto', paged_attention
+    use_kernel) treat the interpret-mode kernels as dispatchable on a
+    non-TPU backend. Test/bench escape hatch ONLY: it is how the
+    forced-8-device CPU host exercises the shard_mapped kernel paths
+    end-to-end; production 'auto' stays reference off-TPU."""
+    return os.environ.get("DLROVER_TPU_FORCE_KERNELS", "") == "1"
+
+
+def per_shard_heads(
+    h: int, kv: int, tp: int
+) -> Optional[Tuple[int, int]]:
+    """The (q_heads, kv_heads) one shard sees under GSPMD head
+    sharding of degree `tp`, or None when the global counts don't
+    split evenly (then no head layout exists and every kernel gate
+    must fail). The ONE divisibility check both `supports()` gates
+    (flash and paged) share, so they cannot drift."""
+    if tp > 1:
+        if h % tp != 0 or kv % tp != 0:
+            return None
+        return h // tp, kv // tp
+    return h, kv
 
 
 def _pick_block(s: int, cap: int) -> int:
@@ -87,11 +122,10 @@ def supports(
     and the GQA group check must hold within one shard."""
     if segment_ids is not None:
         return False
-    h, kv = q.shape[2], k.shape[2]
-    if tp > 1:
-        if h % tp != 0 or kv % tp != 0:
-            return False
-        h, kv = h // tp, kv // tp
+    shard = per_shard_heads(q.shape[2], k.shape[2], tp)
+    if shard is None:
+        return False
+    h, kv = shard
     d = q.shape[-1]
     s_q = q.shape[1]
     s_k = k.shape[1]
@@ -528,3 +562,49 @@ def flash_attention(
     vt = v.transpose(0, 2, 1, 3)
     o = _flash(qt, kt, vt, causal, scale, block_q, block_k)
     return o.transpose(0, 2, 1, 3)
+
+
+def sharded_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+) -> jax.Array:
+    """`flash_attention` shard_mapped over the serving mesh's "tp"
+    axis: each shard runs the unmodified kernel on its per-shard
+    heads. Attention is embarrassingly parallel over heads, so the
+    body needs NO collectives — and because scale, blocks and the
+    causal mask depend only on the (unsharded) seq/head_dim axes,
+    every shard runs the exact arithmetic the tp=1 kernel runs on its
+    head slice: output is byte-identical to tp=1 chunked by head.
+    The caller (models/decode.py) keeps the replicated-output
+    constraint before the out-projection.
+
+    q/k/v are GLOBAL [B, S, H, D] arrays (head axes divisible by tp —
+    `supports(..., tp=tp)` gates this); specs come from
+    parallel/mesh.py:serving_head_specs, the one layout source."""
+    from dlrover_tpu.parallel.mesh import serving_head_specs
+
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    # pin blocks OUTSIDE the shard_map body: auto_blocks reads only
+    # seq/head_dim (unsharded), but resolving them here makes the
+    # tp-invariance explicit rather than a property of the body
+    if block_q is None or block_k is None:
+        auto_q, auto_k = auto_blocks(
+            q.shape[1], k.shape[1], q.shape[-1]
+        )
+        block_q = block_q or auto_q
+        block_k = block_k or auto_k
+    spec = serving_head_specs(mesh)["qkv"]
+    fn = functools.partial(
+        flash_attention, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k,
+    )
+    return shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

@@ -27,8 +27,12 @@ over them — this module provides both halves:
   in HBM, halving decode's memory-bound byte traffic). interpret=True
   on CPU keeps tier-1 runnable.
 - `impl="auto"`: the kernel on real TPU when `supports()` passes,
-  else the reference. CPU tier-1 therefore always runs the reference
-  — which is what makes the engine parity sweep deterministic.
+  else the reference. CPU tier-1 therefore runs the reference —
+  which is what makes the engine parity sweep deterministic — unless
+  DLROVER_TPU_FORCE_KERNELS=1 (the shard_map parity tests / bench)
+  forces the interpret-mode kernel. Under a serving mesh (tp > 1)
+  the kernel dispatches shard_mapped over the "tp" axis: each shard
+  streams the pages of its own KV-head slice (no collectives).
 
 The single-query shape gate reuses ops/flash_attention.supports()
 (fixed to accept q_len == 1 decode shapes): head_dim lane/tile
@@ -60,10 +64,10 @@ def supports(q, pages: Dict, table, tp: int = 1) -> bool:
     doesn't divide over tp fails outright."""
     b, h, d = q.shape
     n_pages, page_size, kv, _ = pages["k"].shape
-    if tp > 1:
-        if h % tp != 0 or kv % tp != 0:
-            return False
-        h, kv = h // tp, kv // tp
+    shard = fa.per_shard_heads(h, kv, tp)
+    if shard is None:
+        return False
+    h, kv = shard
     # flash's single-query gate owns the d / GQA lane constraints
     # (probed with the per-shard head counts); the key-side
     # "sequence" a page kernel streams is one page long
@@ -84,15 +88,16 @@ def supports(q, pages: Dict, table, tp: int = 1) -> bool:
 
 def use_kernel(q, pages: Dict, table, tp: int = 1) -> bool:
     """Static (trace-time) dispatch decision for the engine: the
-    kernel only on a real TPU backend — CPU always takes the
-    reference, which is the byte-parity formulation. tp > 1 also
-    takes the reference: the kernel is not shard_mapped yet, and an
-    unpartitioned pallas_call inside a GSPMD-sharded program would
-    force a full regather, while the gather+einsum reference
-    partitions per head with no communication."""
-    if jax.default_backend() != "tpu":
-        return False
-    if tp > 1:
+    kernel on a real TPU backend (or under the
+    DLROVER_TPU_FORCE_KERNELS=1 interpret-mode escape hatch the
+    shard_map parity tests and the bench use) — CPU otherwise takes
+    the reference, the byte-parity formulation, which keeps the
+    engine parity sweeps deterministic. tp > 1 dispatches the
+    SHARD_MAPPED kernel: each shard runs the same Pallas program on
+    its per-shard heads (`supports()` judges the per-shard shapes),
+    so multi-chip replicas keep the fused int8-dequant page streaming
+    instead of regathering into the einsum reference."""
+    if jax.default_backend() != "tpu" and not fa.force_kernels():
         return False
     return supports(q, pages, table, tp=tp)
 
@@ -279,6 +284,37 @@ def _kernel(q, pages, table, lengths, scale):
     return out.reshape(b, h, hd)
 
 
+def _sharded_kernel(q, pages, table, lengths, scale, mesh):
+    """`_kernel` shard_mapped over the serving mesh's "tp" axis: q
+    and the page pool split on their head axes, the page table and
+    lengths replicated (host-planned — every shard walks the same
+    pages, reading only its own KV-head slice of them). Attention is
+    per-KV-head local, so the body needs NO collectives, and the
+    kernel's grid/scratch shapes depend only on per-shard head
+    counts: output is byte-identical to the tp=1 kernel chunked by
+    head. Specs come from parallel/mesh.py:serving_head_specs, the
+    one layout source."""
+    from dlrover_tpu.parallel.mesh import serving_head_specs
+
+    specs = serving_head_specs(mesh)
+    rep = specs["replicated"]
+
+    def body(q, pages, table, lengths):
+        return _kernel(q, pages, table, lengths, scale)
+
+    return fa.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            specs["q1"],
+            {name: specs["pool"] for name in pages},
+            rep,
+            rep,
+        ),
+        out_specs=specs["q1"],
+    )(q, pages, table, lengths)
+
+
 def paged_attention(
     q: jax.Array,           # [B, H, hd] — one decode query per row
     pages: Dict[str, jax.Array],
@@ -286,19 +322,32 @@ def paged_attention(
     lengths: jax.Array,     # [B] valid cells per row (query at len-1)
     scale: Optional[float] = None,
     impl: str = "auto",
+    mesh=None,
 ) -> jax.Array:
     """Single-query attention over paged KV. impl: "reference" (the
     dense-bank byte-parity formulation over a gathered view), "kernel"
     (Pallas, pages streamed via scalar-prefetched table), or "auto"
-    (kernel on TPU when supported, else reference)."""
+    (kernel when `use_kernel` passes, else reference).
+
+    `mesh` (optional serving mesh with a "tp" axis) makes the kernel
+    path dispatch shard_mapped over the head axes; the reference path
+    needs no wrapper — GSPMD partitions its gather+einsums per head
+    on its own."""
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
+    from dlrover_tpu.parallel.mesh import serving_mesh_tp
+
+    tp = serving_mesh_tp(mesh)
     if impl == "reference":
         return _reference(q, pages, table, lengths, scale)
     if impl == "kernel":
+        if tp > 1:
+            return _sharded_kernel(q, pages, table, lengths, scale, mesh)
         return _kernel(q, pages, table, lengths, scale)
     if impl != "auto":
         raise ValueError(f"unknown impl {impl!r}")
-    if use_kernel(q, pages, table):
+    if use_kernel(q, pages, table, tp=tp):
+        if tp > 1:
+            return _sharded_kernel(q, pages, table, lengths, scale, mesh)
         return _kernel(q, pages, table, lengths, scale)
     return _reference(q, pages, table, lengths, scale)

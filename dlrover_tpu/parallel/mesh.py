@@ -307,3 +307,47 @@ def serving_kv_spec() -> PartitionSpec:
     hd==1. Only the head axis is sharded: rows/cells are host-planned
     (slot tables, page tables) and must stay addressable everywhere."""
     return PartitionSpec(None, None, None, SERVING_TP_AXIS)
+
+
+def serving_mesh_tp(mesh: Optional[Mesh]) -> int:
+    """Size of the serving ``"tp"`` axis (1 when no mesh is threaded
+    or the mesh has no serving axis) — the ops kernel wrappers and
+    models/decode.py key their dispatch on this."""
+    if mesh is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+        SERVING_TP_AXIS, 1
+    )
+
+
+def serving_head_specs(mesh: Mesh) -> Dict[str, PartitionSpec]:
+    """Per-shard PartitionSpecs for shard_mapping the attention
+    kernels over the serving ``"tp"`` axis — the ONE layout source
+    the ops/ wrappers consume (a second spec table could silently
+    drift from the NamedShardings decode.py constrains q/k/v to):
+
+    - ``"qkv"``: prefill/verify activations ``[B, S, H, D]`` — head
+      axis (dim 2) split, everything else shard-local.
+    - ``"q1"``: the single-token decode query ``[B, H, hd]`` — head
+      axis at dim 1.
+    - ``"pool"``: a per-layer page-pool array ``[pages, page_size,
+      KV, hd]`` (scales ride with hd==1) — KV head axis at dim 2.
+    - ``"replicated"``: host-planned operands (page tables, lengths)
+      every shard reads whole.
+
+    Attention is embarrassingly parallel over heads, so bodies using
+    these specs need NO collectives; the replicated-output constraint
+    before the out-projection stays with the caller (decode.py)."""
+    if SERVING_TP_AXIS not in getattr(mesh, "axis_names", ()):
+        raise ValueError(
+            f"serving_head_specs needs a mesh with a "
+            f"{SERVING_TP_AXIS!r} axis (serving_mesh builds one); got "
+            f"axes {getattr(mesh, 'axis_names', None)}"
+        )
+    ax = SERVING_TP_AXIS
+    return {
+        "qkv": PartitionSpec(None, None, ax, None),
+        "q1": PartitionSpec(None, ax, None),
+        "pool": PartitionSpec(None, None, ax, None),
+        "replicated": PartitionSpec(),
+    }

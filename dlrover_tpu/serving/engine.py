@@ -231,6 +231,22 @@ def _cached_program(cache: Dict[Any, Any], key, build):
     return prog
 
 
+def _kernel_cache_tag() -> tuple:
+    """Extra program-cache key component for forced-kernel runs.
+
+    DLROVER_TPU_FORCE_KERNELS lives in the environment, not in cfg or
+    mesh, yet it changes which attention body the traced program
+    contains (shard_mapped Pallas kernel vs XLA reference). Without
+    this tag a forced engine and an unforced engine with identical
+    (cfg, mesh, ...) would share one cached program and silently run
+    the wrong body. Unforced runs get the empty tuple so their keys
+    stay byte-identical to what they were before the knob existed.
+    """
+    from dlrover_tpu.ops import flash_attention as fa
+
+    return ("forced-kernels",) if fa.force_kernels() else ()
+
+
 def _build_chunk_program(
     cfg, pad_id, eos_id, temperature, top_k, top_p, mesh=None
 ):
@@ -930,8 +946,9 @@ class ContinuousBatcher:
             )
             self._run_spec = _cached_program(
                 _SPEC_PROGRAMS,
+                # graftlint: allow(JIT-003) reason=tuple literal plus env-derived forced-kernel tag; unforced keys are unchanged
                 (cfg, pad_id, eos_id, temperature, top_k, top_p,
-                 spec_draft_len, self.mesh),
+                 spec_draft_len, self.mesh) + _kernel_cache_tag(),
                 lambda: _build_spec_program(
                     cfg, pad_id, eos_id, temperature, top_k, top_p,
                     mesh=self.mesh,
@@ -941,8 +958,9 @@ class ContinuousBatcher:
 
         self._run_chunk = _cached_program(
             _CHUNK_PROGRAMS,
+            # graftlint: allow(JIT-003) reason=tuple literal plus env-derived forced-kernel tag; unforced keys are unchanged
             (cfg, pad_id, eos_id, temperature, top_k, top_p,
-             self.mesh),
+             self.mesh) + _kernel_cache_tag(),
             lambda: _build_chunk_program(
                 cfg, pad_id, eos_id, temperature, top_k, top_p,
                 mesh=self.mesh,
@@ -950,7 +968,8 @@ class ContinuousBatcher:
         )[self.kv_layout]
         admit = _cached_program(
             _ADMIT_PROGRAMS,
-            (cfg, max_len, self.mesh),
+            # graftlint: allow(JIT-003) reason=tuple literal plus env-derived forced-kernel tag; unforced keys are unchanged
+            (cfg, max_len, self.mesh) + _kernel_cache_tag(),
             lambda: _build_admit_programs(
                 cfg, max_len, mesh=self.mesh
             ),
@@ -963,6 +982,32 @@ class ContinuousBatcher:
         self._paged_cold_fn = admit["paged_cold"]
         self._paged_warm_fn = admit["paged_warm"]
         self._page_copy_fn = admit["page_copy"]
+
+        # Which attention body the per-token decode step traced into
+        # its program: "kernel" (Pallas paged-attention, shard_mapped
+        # over "tp" when mesh_tp > 1) or "reference" (XLA gather +
+        # softmax). Decided once here with shape probes — use_kernel
+        # only inspects shapes/dtypes, so ShapeDtypeStructs suffice —
+        # and surfaced via /healthz and the serving metrics so bench
+        # contracts can assert which path a replica actually runs.
+        self.kernel_path = "reference"
+        if self._paged and getattr(cfg, "attn_impl", "auto") != "reference":
+            from dlrover_tpu.ops import paged_attention as _pa_probe
+
+            probe_q = jax.ShapeDtypeStruct(
+                (n_slots, cfg.n_heads, cfg.head_dim), cfg.dtype
+            )
+            probe_pool = {
+                name: jax.ShapeDtypeStruct(arr.shape[1:], arr.dtype)
+                for name, arr in self.page_pool.items()
+            }
+            probe_table = jax.ShapeDtypeStruct(
+                tuple(self._table.shape), jnp.int32
+            )
+            if _pa_probe.use_kernel(
+                probe_q, probe_pool, probe_table, tp=self.mesh_tp
+            ):
+                self.kernel_path = "kernel"
 
     # -- mesh placement ----------------------------------------------------
 

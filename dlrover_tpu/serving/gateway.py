@@ -278,6 +278,9 @@ class ServingGateway:
                 "shape": mesh_shape,
                 "n_chips": int(getattr(engine, "n_chips", 1)),
             }
+        kp = getattr(engine, "kernel_path", None)
+        if kp is not None:
+            out["kernel_path"] = kp
         return out
 
     def _prefix_cache(self):

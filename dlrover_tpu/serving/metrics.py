@@ -95,6 +95,7 @@ class ServingMetrics:
             "_paged_swap_resumes",
             "_mesh_tp",
             "_replica_chips",
+            "_kernel_path_steps",
         }
     )
 
@@ -157,6 +158,11 @@ class ServingMetrics:
         # (a replica always occupies at least one device)
         self._mesh_tp = 1
         self._replica_chips = 1
+        # decode-step counters split by attention body: copied from
+        # the engine's kernel_path + step dispatch count each pump.
+        # Both labels always render (zero until taken) so dashboards
+        # can alert on "reference steps > 0" for a kernel deployment.
+        self._kernel_path_steps = {"kernel": 0, "reference": 0}
 
     # ---- ingestion -------------------------------------------------------
 
@@ -310,6 +316,17 @@ class ServingMetrics:
             self._mesh_tp = int(tp)
             self._replica_chips = int(n_chips)
 
+    def update_kernel_path(self, path: str, steps: int):
+        """Refresh the per-attention-body decode-step counter from the
+        engine's kernel_path and cumulative dispatch count. Same max()
+        monotonic guard as the counter blocks above."""
+        if path not in ("kernel", "reference"):
+            return
+        with self._lock:
+            self._kernel_path_steps[path] = max(
+                self._kernel_path_steps[path], int(steps)
+            )
+
     # ---- queries ---------------------------------------------------------
 
     @property
@@ -460,6 +477,11 @@ class ServingMetrics:
     def replica_chips(self) -> int:
         with self._lock:
             return self._replica_chips
+
+    @property
+    def kernel_path_steps(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._kernel_path_steps)
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -709,6 +731,19 @@ class ServingMetrics:
                 "Devices this replica's mesh slice occupies.",
                 self._replica_chips,
             )
+            lines.append(
+                "# HELP serving_kernel_path_steps_total Decode "
+                "dispatches by attention body (Pallas kernel vs XLA "
+                "reference)."
+            )
+            lines.append(
+                "# TYPE serving_kernel_path_steps_total counter"
+            )
+            for path in ("kernel", "reference"):
+                lines.append(
+                    f'serving_kernel_path_steps_total{{path="{path}"}} '
+                    f"{self._kernel_path_steps[path]}"
+                )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()
         return "\n".join(

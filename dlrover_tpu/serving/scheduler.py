@@ -428,6 +428,11 @@ class RequestScheduler:
                     st["host_ms"], st["device_wait_ms"],
                     int(st["dispatches"]), st["overlap_ratio"],
                 )
+                kp = getattr(self.engine, "kernel_path", None)
+                if kp is not None:
+                    self.metrics.update_kernel_path(
+                        kp, int(st["dispatches"])
+                    )
             paged_stats = getattr(self.engine, "paged_stats", None)
             if paged_stats is not None:
                 ps = paged_stats()

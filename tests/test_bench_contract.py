@@ -182,6 +182,16 @@ def test_serve_bench_smoke_emits_driver_contract():
         "mesh_parity_ok",
         "mesh_metrics_ok",
         "n_mesh_requests",
+        # kernel phase: the fused-dispatch evidence axes
+        "kernel_path",
+        "kernel_path_ok",
+        "kernel_metrics_ok",
+        "kernel_forced_path_ok",
+        "kernel_parity_ok",
+        "kernel_tpot_ms",
+        "kernel_ref_tpot_ms",
+        "kernel_tpot_ratio",
+        "n_kernel_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -257,3 +267,22 @@ def test_serve_bench_smoke_emits_driver_contract():
     assert detail["mesh_tp2_tpot_ms_p50"] > 0
     assert detail["mesh_tp1_tpot_ms_p50"] > 0
     assert detail["n_mesh_requests"] > 0
+    # the kernel acceptance floor: the engine must report the dispatch
+    # path the backend warrants ('reference' on the CPU smoke —
+    # interpret kernels must never leak into 'auto' perf numbers; the
+    # bench itself asserts 'kernel' when on a TPU), the metrics counter
+    # for that path must render nonzero, the forced kernel/pinned
+    # reference pair must each land on their named path, and the two
+    # bodies must emit token-identical streams. The TPOT ratio is
+    # recorded but NOT locked <1: interpret-mode Pallas on CPU is pure
+    # overhead by design — the latency win is a TPU fact, parity and
+    # dispatch truthfulness are the portable invariants
+    assert detail["kernel_path"] == "reference"
+    assert detail["kernel_path_ok"] is True
+    assert detail["kernel_metrics_ok"] is True
+    assert detail["kernel_forced_path_ok"] is True
+    assert detail["kernel_parity_ok"] is True
+    assert detail["kernel_tpot_ms"] > 0
+    assert detail["kernel_ref_tpot_ms"] > 0
+    assert detail["kernel_tpot_ratio"] > 0
+    assert detail["n_kernel_requests"] > 0

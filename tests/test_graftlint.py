@@ -38,6 +38,7 @@ from dlrover_tpu.analysis.rules import (
     EagerJnpImportRule,
     HostCopyRule,
     JitSelfCaptureRule,
+    KernelHygieneRule,
     LockDisciplineRule,
     ProgramCacheKeyRule,
     RawMeshRule,
@@ -410,6 +411,66 @@ def test_except_rule_flags_silent_swallows(tmp_path):
     )
     found = hits(BroadExceptRule(), src)
     assert len(found) == 2  # a() and b(); c/d dispose, e is typed
+
+
+OPS_REL = "dlrover_tpu/ops/probe.py"
+
+
+def test_kernel_rule_flags_ungated_pallas_call(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        from jax.experimental import pallas as pl
+        def bad_missing():
+            return pl.pallas_call(kernel, out_shape=o)(x)
+        def bad_hardcoded():
+            return pl.pallas_call(kernel, interpret=True)(x)
+        def good():
+            return pl.pallas_call(kernel, interpret=_interpret())(x)
+        def good_prefixed():
+            return pl.pallas_call(kernel, interpret=fa._interpret())(x)
+        """,
+        rel=OPS_REL,
+    )
+    found = hits(KernelHygieneRule(), src)
+    assert len(found) == 2
+    assert all("interpret" in f.message for f in found)
+
+
+def test_kernel_rule_flags_shard_map_outside_ops_parallel(tmp_path):
+    code = """
+    from jax.experimental.shard_map import shard_map
+    def body(x):
+        return shard_map(f, mesh=m, in_specs=s, out_specs=s)(x)
+    """
+    # serving/ (and any other layer): both the import and the call
+    src = probe(tmp_path, code, rel=ENGINE_REL)
+    assert len(hits(KernelHygieneRule(), src)) == 2
+    src = probe(tmp_path, code, rel="dlrover_tpu/models/decode.py")
+    assert len(hits(KernelHygieneRule(), src)) == 2
+
+
+def test_kernel_rule_allows_shard_map_in_ops_and_parallel(tmp_path):
+    code = """
+    from jax import shard_map
+    def wrap(x):
+        return shard_map(f, mesh=m, in_specs=s, out_specs=s)(x)
+    """
+    for rel in (OPS_REL, "dlrover_tpu/parallel/mesh.py"):
+        src = probe(tmp_path, code, rel=rel)
+        assert not hits(KernelHygieneRule(), src), rel
+
+
+def test_kernel_rule_ignores_pallas_outside_ops(tmp_path):
+    # the interpret gate is an ops/ contract; a (hypothetical)
+    # pallas_call elsewhere is someone else's review problem, and the
+    # rule must not misfire on unrelated serving code
+    src = probe(
+        tmp_path,
+        "def f():\n    return pl.pallas_call(kernel)(x)\n",
+        rel=ENGINE_REL,
+    )
+    assert not hits(KernelHygieneRule(), src)
 
 
 # ---------------------------------------------------------------------------

@@ -231,6 +231,56 @@ class TestMeshParitySweep:
         assert _run(cfg, params, prompts, mesh_spec=2, **kw) == base
 
 
+@pytest.mark.slow
+@pytest.mark.kernels
+class TestForcedKernelParitySweep:
+    """Fuzzed end-to-end sweep for the shard_mapped kernel path: a
+    forced-kernel tp=2 paged engine must emit the same tokens as the
+    unforced tp=1 reference engine, across greedy/sampled x async
+    depth. Runs on a dim=128 (head_dim=32) model — the smallest width
+    the kernel gates accept; tiny() would silently test nothing."""
+
+    CASES = list(itertools.product((0.0, 0.8), (0, 1)))
+
+    @pytest.fixture(scope="class")
+    def kmodel(self):
+        cfg = dataclasses.replace(
+            llama.LlamaConfig.tiny(dim=128, attn_impl="auto"),
+            dtype=jnp.float32,
+        )
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        return cfg, params
+
+    @multi_device
+    @pytest.mark.parametrize("temperature,depth", CASES)
+    def test_forced_tp2_matches_unforced_tp1(
+        self, kmodel, monkeypatch, temperature, depth
+    ):
+        cfg, params = kmodel
+        seed = hash((temperature, depth)) % 2**16
+        rng = np.random.default_rng(seed)
+        prompts = [
+            rng.integers(1, 250, size=int(n)).tolist()
+            for n in rng.integers(2, 12, size=4)
+        ]
+        kw = dict(
+            n_slots=2,
+            max_len=64,
+            max_new_tokens=6,
+            chunk=4,
+            eos_id=None,
+            temperature=temperature,
+            top_k=20 if temperature > 0 else 0,
+            kv_layout="paged",
+            async_depth=depth,
+            seed=7,
+        )
+        monkeypatch.delenv("DLROVER_TPU_FORCE_KERNELS", raising=False)
+        base = _run(cfg, params, prompts, **kw)
+        monkeypatch.setenv("DLROVER_TPU_FORCE_KERNELS", "1")
+        assert _run(cfg, params, prompts, mesh_spec=2, **kw) == base
+
+
 # ---------------------------------------------------------------------------
 # ops supports(): per-shard head gates
 
@@ -272,7 +322,7 @@ class TestOpsSupportsTp:
         assert pa.supports(q, pages, table, tp=2)
         assert not pa.supports(q, pages, table, tp=4)
 
-    def test_paged_kernel_off_under_tp(self):
+    def test_paged_kernel_gate_under_tp(self, monkeypatch):
         from dlrover_tpu.ops import paged_attention as pa
 
         q = jax.ShapeDtypeStruct((2, 4, 64), jnp.float32)
@@ -281,9 +331,16 @@ class TestOpsSupportsTp:
             "v": jax.ShapeDtypeStruct((8, 16, 2, 64), jnp.float32),
         }
         table = np.zeros((2, 4), np.int32)
-        # not shard_mapped yet: tp>1 must take the reference on every
-        # backend (on CPU this also covers the backend gate)
+        # CPU backend, no force: reference regardless of tp (keeps the
+        # engine parity sweeps on the byte-parity formulation)
+        monkeypatch.delenv("DLROVER_TPU_FORCE_KERNELS", raising=False)
         assert not pa.use_kernel(q, pages, table, tp=2)
+        # forced (or real TPU): tp=2 dispatches the SHARD_MAPPED
+        # kernel whenever the per-shard shapes pass supports()
+        monkeypatch.setenv("DLROVER_TPU_FORCE_KERNELS", "1")
+        assert pa.use_kernel(q, pages, table, tp=2)
+        # indivisible per-shard heads still refuse, forced or not
+        assert not pa.use_kernel(q, pages, table, tp=4)
 
 
 # ---------------------------------------------------------------------------

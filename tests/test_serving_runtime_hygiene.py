@@ -97,6 +97,48 @@ def test_steady_state_step_is_transfer_and_recompile_free(
     )
 
 
+@pytest.mark.kernels
+@pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="tp>1 needs >=2 (forced host) devices",
+)
+def test_forced_kernel_tp2_step_is_transfer_and_recompile_free(
+    monkeypatch,
+):
+    """The shard_mapped paged-kernel path must obey the same per-step
+    hygiene as the reference path: no implicit transfers, no hot-path
+    retrace. Needs head_dim>=32 (dim=128) or the kernel gate would
+    silently hand this test the reference program."""
+    monkeypatch.setenv("DLROVER_TPU_FORCE_KERNELS", "1")
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(dim=128, attn_impl="auto"),
+        dtype=jnp.float32,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = _engine(cfg, params, "paged", mesh_spec=2)
+    assert eng.kernel_path == "kernel", "gate refused: test is vacuous"
+    rng = np.random.default_rng(2)
+    for n in (5, 9):
+        eng.submit(rng.integers(1, 250, size=n).tolist())
+
+    eng.step()
+    eng.step()
+    warm = _program_cache_sizes(eng)
+    assert warm.get("_run_chunk", 0) >= 1, warm
+
+    steady_steps = 0
+    with jax.transfer_guard("disallow"):
+        for _ in range(6):
+            if not eng.has_work():
+                break
+            eng.step()
+            steady_steps += 1
+    assert steady_steps >= 4, "steady-state window too short to mean anything"
+    assert _program_cache_sizes(eng) == warm, (
+        "hot-path recompile after warmup on the shard_mapped kernel path"
+    )
+
+
 @pytest.mark.parametrize("layout", ["dense", "paged"])
 def test_steady_state_holds_through_completion_events(model, layout):
     """Slots finishing (done-flag routing, event emission) are part of
