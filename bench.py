@@ -13,6 +13,7 @@ achieved MFU / 0.40 — the reference north-star is >=40 % MFU at scale
 import json
 import os
 import sys
+import threading
 import time
 
 # generation detection + peak table live in utils/prof.py (one copy:
@@ -293,15 +294,11 @@ class _Watchdog:
         while not self._done.wait(tick):
             idle = time.monotonic() - self._last
             if idle > self.timeout_s:
-                print(
-                    _fail_json(
-                        f"no progress for {idle:.0f}s during "
-                        f"'{self._phase}' — backend/tunnel "
-                        "unreachable"
-                    ),
-                    flush=True,
+                _cpu_smoke_fallback(
+                    f"no progress for {idle:.0f}s during "
+                    f"'{self._phase}' — backend/tunnel "
+                    "unreachable"
                 )
-                os._exit(3)
 
 
 def _fail_json(error_msg: str) -> str:
@@ -318,6 +315,89 @@ def _fail_json(error_msg: str) -> str:
     )
 
 
+# the contract is ONE JSON line per run, but two threads can race
+# for it (main's success print vs the watchdog's infra path): the
+# first claimant of the slot owns both the line AND process exit —
+# a loser parks instead of printing/returning, so a fallback child
+# in flight is never rc-0'd out from under by main returning
+_emit_lock = threading.Lock()
+_emitted = False
+
+
+def _claim_emit() -> bool:
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return False
+        _emitted = True
+        return True
+
+
+def _park_forever() -> None:
+    while True:
+        time.sleep(3600)
+
+
+def _emit_once(line: str) -> None:
+    if not _claim_emit():
+        _park_forever()
+    print(line, flush=True)
+
+
+def _cpu_smoke_fallback(reason: str) -> None:
+    """Infra-unreachable terminal path (never returns): instead of the
+    bare 0.0 tok/s/chip line — which reads like a perf regression in
+    the driver's history — re-exec this bench as a CPU smoke run and
+    emit ITS metric labeled backend="cpu-smoke" + the infra diagnosis.
+    Exit stays 3 so the driver still files the round as infra, but the
+    line proves the code path works and names what was unreachable."""
+    if not _claim_emit():
+        _park_forever()  # another thread owns the line and the exit
+    if os.environ.get("BENCH_NO_FALLBACK") == "1":
+        # already the fallback child (or a test pinning the old
+        # behavior): no recursion, fail plainly
+        print(_fail_json(reason), flush=True)
+        os._exit(3)
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # don't re-dial the tunnel
+    env.update(
+        DLROVER_TPU_FORCE_CPU="1",
+        JAX_PLATFORMS="cpu",
+        BENCH_NO_FALLBACK="1",
+        BENCH_PROBE_TIMEOUT="600",
+    )
+    parsed = None
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            timeout=900,
+            env=env,
+        )
+        for cand in (r.stdout or "").strip().splitlines():
+            try:
+                d = json.loads(cand)
+            except json.JSONDecodeError:
+                continue
+            if d.get("metric") == "tokens_per_sec_per_chip":
+                parsed = d
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    if parsed is None or not parsed.get("value"):
+        # even the CPU smoke failed: the original zero-metric line
+        print(_fail_json(reason), flush=True)
+        os._exit(3)
+    parsed.setdefault("detail", {})
+    parsed["detail"]["backend"] = "cpu-smoke"
+    parsed["detail"]["infra_error"] = reason
+    parsed["vs_baseline"] = 0.0
+    print(json.dumps(parsed), flush=True)
+    os._exit(3)
+
+
 def _wait_for_backend(watchdog) -> float:
     """Bounded probe-retry before dialing the backend for real.
 
@@ -328,8 +408,9 @@ def _wait_for_backend(watchdog) -> float:
     can), retrying inside a budget (BENCH_TUNNEL_WAIT, default 1500 s)
     so a flap shorter than ~25 min never costs the round its number.
 
-    Returns seconds spent waiting; raises SystemExit(3) with a
-    diagnosed JSON line if the budget runs out with no answer.
+    Returns seconds spent waiting; if the budget runs out with no
+    answer, falls through to the labeled CPU-smoke line
+    (_cpu_smoke_fallback, exit 3) instead of a bare zero metric.
     """
     if os.environ.get("DLROVER_TPU_FORCE_CPU") == "1":
         return 0.0  # CPU smoke mode: nothing to dial (platform.py:16
@@ -382,14 +463,10 @@ def _wait_for_backend(watchdog) -> float:
             last_err = f"probe hung >{probe_timeout:.0f}s (killed)"
         if time.monotonic() + retry_sleep + probe_timeout > deadline:
             waited = time.monotonic() - t_start
-            print(
-                _fail_json(
-                    f"backend/tunnel unreachable after {attempt} "
-                    f"probes over {waited:.0f}s; last: {last_err}"
-                ),
-                flush=True,
+            _cpu_smoke_fallback(
+                f"backend/tunnel unreachable after {attempt} "
+                f"probes over {waited:.0f}s; last: {last_err}"
             )
-            raise SystemExit(3)
         stop = time.monotonic() + retry_sleep
         while time.monotonic() < stop:
             watchdog.beat(
@@ -532,7 +609,7 @@ def main():
     )
     watchdog.done()
 
-    print(
+    _emit_once(
         json.dumps(
             {
                 "metric": "tokens_per_sec_per_chip",

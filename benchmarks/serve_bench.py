@@ -67,6 +67,7 @@ prefix_hit_rate, ttft_cold_ms_p50, ttft_warm_ms_p50, ...}.
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -96,6 +97,67 @@ if os.environ.get(FORCE_CPU_ENV) == "1" and (
 ensure_cpu_if_forced()
 
 
+def _fail_json(reason: str) -> str:
+    return json.dumps(
+        {
+            "metric": "serve_tokens_per_sec",
+            "value": 0.0,
+            "unit": "tok/s",
+            "vs_baseline": 0.0,
+            "detail": {"error": reason},
+        }
+    )
+
+
+def _cpu_smoke_fallback(reason: str) -> None:
+    """Infra-unreachable terminal path (mirrors bench.py, never
+    returns): re-exec this bench as a CPU smoke run and emit ITS
+    metric labeled backend="cpu-smoke" + the diagnosis, instead of a
+    bare 0.0 tok/s that reads like a serving perf regression. Exit
+    stays 3 so the driver files the round as infra."""
+    if os.environ.get("BENCH_NO_FALLBACK") == "1":
+        print(_fail_json(reason), flush=True)
+        raise SystemExit(3)
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # don't re-dial the tunnel
+    env.update(
+        {
+            FORCE_CPU_ENV: "1",
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_NO_FALLBACK": "1",
+        }
+    )
+    parsed = None
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True,
+            text=True,
+            timeout=850,
+            env=env,
+        )
+        for cand in (r.stdout or "").strip().splitlines():
+            try:
+                d = json.loads(cand)
+            except json.JSONDecodeError:
+                continue
+            if d.get("metric") == "serve_tokens_per_sec":
+                parsed = d
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    if parsed is None or not parsed.get("value"):
+        print(_fail_json(reason), flush=True)
+        raise SystemExit(3)
+    parsed.setdefault("detail", {})
+    parsed["detail"]["backend"] = "cpu-smoke"
+    parsed["detail"]["infra_error"] = reason
+    parsed["vs_baseline"] = 0.0
+    print(json.dumps(parsed), flush=True)
+    raise SystemExit(3)
+
+
 def main():
     from dlrover_tpu.analysis import bench_preflight
 
@@ -117,6 +179,18 @@ def main():
         on_tpu = jax.default_backend() not in ("cpu",)
     except Exception:  # noqa: BLE001
         pass
+
+    # accelerator advertised but unreachable (tunnel down, libtpu
+    # fell back to CPU): emit the labeled CPU-smoke line, not a 0.0
+    if (
+        bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+        and not on_tpu
+        and os.environ.get(FORCE_CPU_ENV) != "1"
+    ):
+        _cpu_smoke_fallback(
+            "accelerator advertised (PALLAS_AXON_POOL_IPS) but jax "
+            "answered backend=cpu — tunnel/libtpu unreachable"
+        )
 
     if on_tpu:
         cfg = llama.LlamaConfig(
@@ -808,6 +882,216 @@ def main():
     # recorded, never locked <1: only the TPU run is a speed claim
     kernel_tpot_ratio = kernel_tpot_ms / max(kernel_ref_tpot_ms, 1e-9)
 
+    # ---- phase 9: disaggregated prefill/decode (MPMD phase split) -----
+    # A mixed long-prefill/short-decode workload on (a) one colocated
+    # replica — every long admission runs its prefill INSIDE the same
+    # engine that is decoding the shorts, stalling their token cadence
+    # — and (b) a prefill-role + decode-role pair on separate devices:
+    # the prefill replica absorbs the long prompts while the decode
+    # replica, which only pays the copy-free page-run adoption (a
+    # scatter, not a forward pass), keeps stepping. The lock is decode
+    # TPOT p99 over the SHORT requests: disaggregated must beat
+    # colocated by a margin. Correctness rides along: greedy byte
+    # parity between the two topologies, success 1.0 including a
+    # deterministic pass with one injected mid-handoff crash (the
+    # resume-by-replay fallback), and zero leaked pages after drain.
+    # Uses a dedicated model sized so per-step decode compute is tiny
+    # while a single long prefill costs hundreds of decode steps — the
+    # phase's signal IS prefill cost, and both the shared pcfg and the
+    # main cfg's smoke prompts are too cheap to stall anything
+    # measurable relative to their own decode step.
+    if on_tpu:
+        dcfg = cfg
+        d_max_len = min(int(cfg.max_seq_len), 2048)
+        d_slots, d_chunk, d_short_new, d_long_new = 8, 4, 64, 1
+        d_short_lo, d_short_hi = 8, 16
+        d_long_lo, d_long_hi = (
+            int(0.75 * d_max_len), int(0.92 * d_max_len)
+        )
+        n_d_short, n_d_long = 6, 4
+    else:
+        import dataclasses
+
+        dcfg = dataclasses.replace(
+            llama.LlamaConfig.tiny(), dtype=jnp.float32,
+            max_seq_len=2048,
+        )
+        d_max_len = 2048
+        d_slots, d_chunk, d_short_new, d_long_new = 6, 1, 16, 1
+        d_short_lo, d_short_hi = 4, 10
+        d_long_lo, d_long_hi = 1600, 1900
+        n_d_short, n_d_long = 4, 4
+    dparams = llama.init_params(dcfg, jax.random.PRNGKey(1))
+    drng = np.random.default_rng(7)
+    d_short_prompts = [
+        drng.integers(
+            1, min(500, dcfg.vocab_size), size=int(n)
+        ).tolist()
+        for n in drng.integers(d_short_lo, d_short_hi, size=n_d_short)
+    ]
+    d_long_prompts = [
+        drng.integers(
+            1, min(500, dcfg.vocab_size), size=int(n)
+        ).tolist()
+        for n in drng.integers(d_long_lo, d_long_hi, size=n_d_long)
+    ]
+    d_slo = SloConfig(
+        max_queue_depth=n_d_short + n_d_long + 4,
+        max_new_tokens=max(d_short_new, d_long_new),
+        default_deadline_s=600.0,
+    )
+    d_devs = jax.local_devices()
+
+    def _drain_pool(scheds):
+        for _ in range(200_000):
+            busy = False
+            for s in scheds:
+                busy = s.pump() or busy
+            if not busy:
+                return
+        raise AssertionError("disagg pool did not drain")
+
+    def _disagg_build(disagg, fi=None):
+        dmetrics = ServingMetrics()
+        dpool = ReplicaPool(metrics=dmetrics)
+        roles = (
+            [
+                ("prefill", d_devs[0]),
+                ("decode", d_devs[min(1, len(d_devs) - 1)]),
+            ]
+            if disagg
+            else [("colocated", d_devs[0])]
+        )
+        scheds = []
+        for role, dev in roles:
+            # each engine committed to its own (virtual) device so the
+            # prefill forward and the decode chunk scan can genuinely
+            # overlap; the device handoff transport device_puts the
+            # shipped run across at adoption
+            with jax.default_device(dev):
+                prm = jax.device_put(dparams, dev)
+                eng = ContinuousBatcher(
+                    dcfg, prm, n_slots=d_slots, max_len=d_max_len,
+                    max_new_tokens=max(d_short_new, d_long_new),
+                    chunk=d_chunk, pad_id=-1, kv_layout="paged",
+                    replica_role=role,
+                )
+            sch = RequestScheduler(eng, d_slo, metrics=dmetrics)
+            dpool.add(InferenceReplica(role, sch))
+            scheds.append(sch)
+        if fi is not None:
+            dpool.handoff.chaos = fi
+            dpool.handoff.chaos_tag = "handoff"
+        # warm the full path outside the timed region: short + long
+        # prefill buckets, the chunk scan, and (disagg) the handoff
+        # gather/scatter + adoption programs
+        for p, mn in (
+            (d_short_prompts[0], 2),
+            (d_long_prompts[0], 2),
+        ):
+            dpool.submit(p, max_new=mn)
+            _drain_pool(scheds)
+        return dpool, scheds, dmetrics
+
+    def _pump_loop(sched, stop):
+        while not stop.is_set():
+            try:
+                busy = sched.pump()
+            except Exception:  # noqa: BLE001 — states carry the story
+                break
+            if not busy:
+                time.sleep(0.0005)
+
+    def _disagg_perf(disagg):
+        dpool, scheds, dmetrics = _disagg_build(disagg)
+        stop = threading.Event()
+        threads = [
+            threading.Thread(
+                target=_pump_loop, args=(s, stop), daemon=True
+            )
+            for s in scheds
+        ]
+        for t in threads:
+            t.start()
+        sreqs = [
+            dpool.submit(p, max_new=d_short_new)
+            for p in d_short_prompts
+        ]
+        # longs land once every short is mid-decode, so their prefills
+        # contend with the shorts' token cadence by construction
+        t_dead = time.monotonic() + 120.0
+        while time.monotonic() < t_dead and any(
+            r.first_token_ts is None for r in sreqs
+        ):
+            time.sleep(0.001)
+        lreqs = [
+            dpool.submit(p, max_new=d_long_new)
+            for p in d_long_prompts
+        ]
+        for r in sreqs + lreqs:
+            r.wait(timeout=300.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        dtpots = sorted(
+            (r.finish_ts - r.first_token_ts)
+            * 1000.0
+            / (len(r.tokens) - 1)
+            for r in sreqs
+            if r.first_token_ts is not None and len(r.tokens) > 1
+        )
+        outs = [list(r.tokens) for r in sreqs + lreqs]
+        done = sum(
+            1
+            for r in sreqs + lreqs
+            if r.state.value == "done"
+        )
+        return pct(dtpots, 0.99), outs, done, dmetrics, scheds
+
+    coloc_runs = [_disagg_perf(False) for _ in range(2)]
+    disagg_runs = [_disagg_perf(True) for _ in range(2)]
+    disagg_coloc_p99 = min(r[0] for r in coloc_runs)
+    disagg_p99 = min(r[0] for r in disagg_runs)
+    n_disagg_total = n_d_short + n_d_long
+    disagg_parity_ok = all(
+        r[1] == coloc_runs[0][1] for r in coloc_runs + disagg_runs
+    )
+    disagg_success_rate = min(
+        r[2] / n_disagg_total for r in disagg_runs
+    )
+    disagg_handoffs = sum(
+        disagg_runs[-1][3].handoff_total.values()
+    )
+    disagg_pages_adopted = int(
+        disagg_runs[-1][4][1].engine.allocator.pages_adopted
+    )
+
+    # crash pass, deterministic pump (no threads): one transient
+    # injected failure on the first post-warm-up handoff — the
+    # package is lost mid-flight and the scheduler must fall back to
+    # resume-by-replay, losing zero requests and zero pages
+    disagg_fi = FaultInjector(seed=0)
+    cpool, cscheds, _ = _disagg_build(True, fi=disagg_fi)
+    disagg_fi.fail_engine_step(
+        "handoff", at_step=cpool.handoff._step
+    )
+    dcreqs = [
+        cpool.submit(p, max_new=d_short_new)
+        for p in d_short_prompts
+    ] + [
+        cpool.submit(p, max_new=d_long_new)
+        for p in d_long_prompts
+    ]
+    _drain_pool(cscheds)
+    assert disagg_fi.fired, "mid-handoff crash never fired"
+    disagg_crash_success = sum(
+        1 for r in dcreqs if r.state.value == "done"
+    ) / len(dcreqs)
+    disagg_crash_leaked = 0
+    for s in cscheds:
+        s.engine.allocator.check()  # refcount/free-list consistency
+        disagg_crash_leaked += int(s.engine.allocator.used_pages)
+
     print(
         json.dumps(
             {
@@ -959,6 +1243,25 @@ def main():
                     ),
                     "kernel_tpot_ratio": round(kernel_tpot_ratio, 3),
                     "n_kernel_requests": len(kern_out),
+                    # disaggregation phase: MPMD phase-split evidence
+                    "disagg_coloc_tpot_p99_ms": round(
+                        disagg_coloc_p99, 3
+                    ),
+                    "disagg_tpot_p99_ms": round(disagg_p99, 3),
+                    "disagg_tpot_p99_ratio": round(
+                        disagg_p99 / max(disagg_coloc_p99, 1e-9), 3
+                    ),
+                    "disagg_parity_ok": disagg_parity_ok,
+                    "disagg_success_rate": round(
+                        disagg_success_rate, 3
+                    ),
+                    "disagg_crash_success_rate": round(
+                        disagg_crash_success, 3
+                    ),
+                    "disagg_crash_leaked_pages": disagg_crash_leaked,
+                    "disagg_handoffs": disagg_handoffs,
+                    "disagg_pages_adopted": disagg_pages_adopted,
+                    "n_disagg_requests": n_disagg_total,
                 },
             }
         ),

@@ -26,6 +26,7 @@ SERVING_PREFIX = "dlrover_tpu/serving/"
 DECODE_FILE = "dlrover_tpu/models/decode.py"
 ENGINE_FILE = SERVING_PREFIX + "engine.py"
 PAGED_KV_FILE = SERVING_PREFIX + "paged_kv.py"
+HANDOFF_FILE = SERVING_PREFIX + "handoff.py"
 
 
 def _in_serving(src: SourceFile) -> bool:
@@ -154,6 +155,10 @@ HOST_COPY_ALLOWED: Dict[str, FrozenSet[str]] = {
     ),
     DECODE_FILE: frozenset(),
     PAGED_KV_FILE: frozenset(),
+    # handoff.py: the host-transport bounce is the module's one D2H
+    # point; export_run's np.asarray only copies the host-resident
+    # prompt (engine.py's submit/_admit category), never KV
+    HANDOFF_FILE: frozenset({"_host_bounce", "export_run"}),
 }
 
 
@@ -951,6 +956,70 @@ class KernelHygieneRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# HANDOFF-001: page-run adoption only through the install entry point
+
+
+# files that ARE the install path: the allocator (owns adopt()) and
+# the handoff module (the one caller)
+_ADOPTION_EXEMPT = (PAGED_KV_FILE, HANDOFF_FILE)
+
+# allocator internals no other serving file may reach into — writing
+# either directly would mint pages the leak check can't see
+_ALLOCATOR_PRIVATE = frozenset({"_refs", "_free"})
+
+
+def adoption_sites(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, what) for every `<expr>.adopt(...)` call and every
+    non-self access to a private allocator field."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "adopt":
+                out.append((node.lineno, f"{ast.unparse(f)}(...)"))
+        elif (
+            isinstance(node, ast.Attribute)
+            and node.attr in _ALLOCATOR_PRIVATE
+            and not (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            )
+        ):
+            out.append((node.lineno, ast.unparse(node)))
+    return out
+
+
+class HandoffAdoptionRule(Rule):
+    id = "HANDOFF-001"
+    severity = CRITICAL
+    title = "page-run adoption only through the allocator entry point"
+    rationale = (
+        "DEVIATIONS §14: cross-replica handoff installs shipped page "
+        "runs through PageAllocator.adopt — the same refcount-1 "
+        "table-write install the prefix pool uses, so the one-CoW-"
+        "site invariant and the zero-leak check() stay true. An "
+        "ad-hoc adopt() call or a poke at the allocator's _refs/_free "
+        "from anywhere else mints pages the accounting can't see."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src) and not any(
+            _matches_file(src.rel, key) for key in _ADOPTION_EXEMPT
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{what} — page adoption and allocator internals "
+                "belong to paged_kv.py/handoff.py only",
+            )
+            for lineno, what in adoption_sites(src.tree)
+        ]
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -966,6 +1035,7 @@ REGISTRY: List[Rule] = [
     ProgramCacheKeyRule(),
     BroadExceptRule(),
     KernelHygieneRule(),
+    HandoffAdoptionRule(),
 ]
 
 

@@ -197,6 +197,11 @@ class _Request:
     # set by preempt-and-swap: the request was swapped to host and
     # re-queued for resume-by-replay (paged layout, pool pressure)
     preempted: bool = False
+    # a serving/handoff.py KVHandoff package: the prompt's KV was
+    # prefilled on another replica and rides in `adopted.data` —
+    # admission installs it instead of running a prefill (cleared at
+    # admission, so a later preemption falls back to plain replay)
+    adopted: Optional[Any] = None
     # how many of `out` are already folded into `prompt` by earlier
     # preemptions — a second preemption must not re-append them
     folded: int = 0
@@ -727,6 +732,7 @@ class ContinuousBatcher:
         n_pages: int = 0,            # pool size (0 = dense-equivalent)
         swap_headroom: int = 1,      # free pages the scheduler keeps
         mesh_spec=None,              # tp degree | {"tp": n} | MeshSpec
+        replica_role: str = "colocated",  # | "prefill" | "decode"
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -746,6 +752,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"async_depth must be 0 (sync) or 1 (one-deep "
                 f"pipeline), got {async_depth}"
+            )
+        if replica_role not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                f"replica_role must be 'colocated', 'prefill' or "
+                f"'decode', got {replica_role!r}"
             )
         _check_positional_capacity(cfg, max_len)
         # ---- serving mesh (GSPMD tensor slice) --------------------------
@@ -775,6 +786,14 @@ class ContinuousBatcher:
         self.chaos = chaos
         self.chaos_tag = chaos_tag
         self._step_no = 0
+        # MPMD phase split: "prefill" admits (admission IS the
+        # prefill — the admit programs write KV cells 0..p-1
+        # synchronously) but never dispatches a decode step; finished
+        # prefills queue in _prefill_ready for the scheduler to export
+        # via serving/handoff.py. "decode" is advisory routing state —
+        # stepping is identical to colocated.
+        self.replica_role = replica_role
+        self._prefill_ready: List[_Request] = []
         # knobs reset() needs to rebuild device state after a crash
         self._kv_quant = kv_quant
         self._prefix_rows = prefix_cache_rows
@@ -1144,6 +1163,19 @@ class ContinuousBatcher:
         self._queue.append(req)
         return req.idx
 
+    def submit_adopted(self, pkg) -> int:
+        """Queue a request whose prompt KV was already prefilled on
+        another replica (a serving/handoff.py KVHandoff package).
+        Admission installs the shipped cells instead of running the
+        prefill; everything downstream (stepping, sampling under the
+        journaled key, retire) is the plain path, which is what makes
+        the colocated run the byte-parity oracle."""
+        idx = self.submit(
+            pkg.prompt, max_new=pkg.max_new, prng_key=pkg.prng_key
+        )
+        self._requests[idx].adopted = pkg
+        return idx
+
     def _pad_to(self, toks: np.ndarray, bucket: int) -> np.ndarray:
         padded = np.full(bucket, self.pad_id, np.int32)
         padded[: len(toks)] = toks
@@ -1151,7 +1183,16 @@ class ContinuousBatcher:
 
     def _admit(self, slot: int, req: _Request):
         p = len(req.prompt)
-        if self._paged:
+        if req.adopted is not None:
+            # cross-replica handoff: install the shipped KV run and
+            # skip the prefill entirely. Cleared immediately — a later
+            # preemption of this slot replays from the prompt like any
+            # other request (the package is single-use by design).
+            from dlrover_tpu.serving import handoff as _handoff
+
+            pkg, req.adopted = req.adopted, None
+            _handoff.adopt_into_slot(self, slot, pkg)
+        elif self._paged:
             if req.preempted:
                 req.preempted = False
                 self._swap_resumes += 1
@@ -1193,6 +1234,11 @@ class ContinuousBatcher:
         self.slot_req[slot] = req
         if self.spec is not None:
             self.spec.begin_slot(slot, req.prompt)
+        if self.replica_role == "prefill":
+            # admission already wrote KV cells 0..p-1: the prefill is
+            # DONE. Park the request for export — step() never
+            # dispatches decode work on this role.
+            self._prefill_ready.append(req)
 
     def _admit_with_prefix(self, slot: int, req: _Request, p: int):
         """Prefix-cached admission: install the longest cached
@@ -1447,6 +1493,12 @@ class ContinuousBatcher:
         self.slot_req[slot] = None
         self.done[slot] = True
         self._dev["done"] = _state_cancel_prog(self._dev["done"], slot)
+        try:
+            # a preempted prefill's KV is gone — it must re-prefill at
+            # re-admission, not export a dead page run
+            self._prefill_ready.remove(req)
+        except ValueError:
+            pass
         self._queue.appendleft(req)
         self._swap_preemptions += 1
 
@@ -1603,7 +1655,7 @@ class ContinuousBatcher:
             for slot in range(self.n_slots):
                 if self.done[slot] and self._queue:
                     self._admit(slot, self._queue.popleft())
-            if not self.done.all():
+            if not self.done.all() and self.replica_role != "prefill":
                 if self.spec is not None:
                     drafts, dlens = self._collect_drafts()
                     if int(dlens.max()) > 0:
@@ -1814,7 +1866,20 @@ class ContinuousBatcher:
                     self._release_slot_pages(slot)
                 if self.prefix_cache is not None:
                     self._release_slot_row(slot)
+        try:
+            self._prefill_ready.remove(req)
+        except ValueError:
+            pass
         return np.asarray(req.out, np.int32)
+
+    def take_prefilled(self) -> List[_Request]:
+        """Drain the prefill-role completion queue: requests whose
+        prompt KV is resident and exportable. Each is still live in
+        its slot (the caller exports via serving/handoff.py and then
+        retire()s it — the export must happen before the slot's pages
+        can be reused)."""
+        out, self._prefill_ready = self._prefill_ready, []
+        return out
 
     def cancel(self, idx: int) -> None:
         """Abort a request wherever it is — still queued or live in a
@@ -1827,6 +1892,10 @@ class ContinuousBatcher:
             return
         try:
             self._queue.remove(req)
+        except ValueError:
+            pass
+        try:
+            self._prefill_ready.remove(req)
         except ValueError:
             pass
         req.done = True
@@ -1909,6 +1978,7 @@ class ContinuousBatcher:
         self._queue.clear()
         self._requests.clear()
         self._pending.clear()
+        self._prefill_ready = []
         self._step_no = 0
         if self.prefix_cache is not None:
             self.prefix_cache = RadixPrefixCache(

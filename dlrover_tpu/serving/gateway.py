@@ -281,6 +281,19 @@ class ServingGateway:
         kp = getattr(engine, "kernel_path", None)
         if kp is not None:
             out["kernel_path"] = kp
+        role = getattr(engine, "replica_role", None)
+        if role is not None:
+            out["replica_role"] = role
+        # phase-handoff health: per-transport migration counts, last
+        # migration latency, per-role waiting depth (duck-typed so
+        # test doubles without the counters stay valid)
+        m = self.metrics
+        if getattr(m, "handoff_total", None) is not None:
+            out["handoff"] = {
+                "total": m.handoff_total,
+                "last_ms": m.handoff_last_ms,
+                "role_queue_depth": m.role_queue_depth,
+            }
         return out
 
     def _prefix_cache(self):

@@ -1,0 +1,332 @@
+"""Cross-replica KV handoff for MPMD phase-split serving.
+
+A prefill-role replica's admission IS the prefill: the admit programs
+write KV cells 0..p-1 synchronously (engine.py), so right after
+admission the slot holds exactly the state a colocated engine would
+hold before its first decode step — zero tokens emitted, carry token
+at cell p-1, per-slot PRNG key drawn. `export_run` packages that
+state (DistServe/Splitwise ship KV too, but stream per-layer during
+prefill; here the paged layout makes the whole run one gather):
+
+- paged: gather the slot's occupied pages out of the page pool — the
+  shipped tensor is [L, n_ship, page_size, KV, hd] per pool entry —
+  plus the prompt, the remaining token budget, and the PRNG key.
+- dense: slice the slot's bank row up to the prompt's pow2 bucket.
+
+`adopt_into_slot` is the decode-side inverse: reserve fresh pages
+through `PageAllocator.adopt` (THE single install entry point —
+graftlint HANDOFF-001), scatter the shipped cells into the local pool,
+and write the slot's table row — the same one-table-write install the
+prefix pool uses, so PR 6's one-CoW-site invariant holds: adopted
+pages arrive at refcount 1, exclusively owned, nothing to copy.
+
+Transports: "device" keeps the gathered arrays device-resident and
+`device_put`s them to the target engine's sharding at adoption (the
+same-process / shared-mesh path); "host" bounces through numpy
+(`_host_bounce`, the module's one allowed D2H site — HOST-001) for
+replicas that do not share a device runtime.
+
+Failure story: the package rides next to a PR-4 `ResumeTicket`. If
+adoption fails anywhere — target incompatible, pool dry, injected
+crash mid-handoff — the scheduler falls back to resume-by-replay:
+re-admit from the ticket and re-prefill. Handoff is an optimization
+with a universal, already-tested fallback, never a new failure mode.
+"""
+
+import dataclasses
+import threading
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving.engine import (
+    _pad_bucket,
+    _table_row_prog,
+)
+from dlrover_tpu.serving.paged_kv import TRASH_PAGE, OutOfPages
+
+
+# ---- shipping programs ---------------------------------------------------
+# Plain jitted functions: jax caches one trace per input shape, and the
+# id vectors are padded to pow2 buckets, so the trace count is bounded
+# by log2(pages_per_slot) / log2(max_len) like the admit programs.
+
+
+@jax.jit
+def _page_gather_prog(arr, ids):
+    """[L, n_pages, ...] x [m] -> [L, m, ...]: pull a page run out of
+    the pool (pad ids point at the trash page — shipped dead weight,
+    never read back)."""
+    return arr[:, ids]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _page_scatter_prog(arr, ids, data):
+    """Inverse: land a shipped run on the adopted page ids. Pad
+    entries all write the trash page; page 0 is garbage by contract
+    so the duplicate writes are harmless. The pool is donated — an
+    adoption must update in place, not copy the whole pool (same
+    rationale as the engine's own donated update programs)."""
+    return arr.at[:, ids].set(data)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _row_slice_prog(arr, slot, w):
+    """Dense bank [L, B, bank_len, ...]: slice one slot's leading `w`
+    cells as [L, 1, w, ...]."""
+    starts = (0, slot) + (0,) * (arr.ndim - 2)
+    sizes = (arr.shape[0], 1, w) + tuple(arr.shape[3:])
+    return jax.lax.dynamic_slice(arr, starts, sizes)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _row_install_prog(arr, data, slot):
+    """Dense inverse: write shipped [L, 1, w, ...] cells into the
+    slot's row head. Cells past the prompt are stale garbage on both
+    sides — dead by the position mask until decode overwrites them.
+    The bank is donated: install in place, never copy the bank."""
+    starts = (0, slot) + (0,) * (arr.ndim - 2)
+    return jax.lax.dynamic_update_slice(arr, data, starts)
+
+
+def _host_bounce(arr) -> np.ndarray:
+    """THE host-transport D2H point (graftlint HOST-001): everything
+    else in this module stays device-resident."""
+    return np.asarray(arr)
+
+
+# ---- the package ---------------------------------------------------------
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """One prefilled request, packaged for adoption elsewhere."""
+
+    prompt: np.ndarray            # [p] int32, the original prompt
+    max_new: int                  # remaining token budget
+    prng_key: np.ndarray          # [2] uint32, the journaled key
+    kv_layout: str                # "dense" | "paged"
+    transport: str                # "device" | "host"
+    n_cells: int                  # prompt cells resident (== p)
+    data: Dict[str, Any]          # pool entry name -> shipped cells
+    page_size: int = 0            # paged only
+    n_ship: int = 0               # occupied pages shipped (paged)
+    src: str = ""                 # source engine's chaos tag
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(v.nbytes for v in self.data.values()))
+
+
+def export_run(engine, idx: int, transport: str = "device") -> KVHandoff:
+    """Package request `idx`'s resident KV for adoption. The slot must
+    still be live — call before retire() frees its pages/row."""
+    if transport not in ("device", "host"):
+        raise ValueError(
+            f"transport must be 'device' or 'host', got {transport!r}"
+        )
+    slot = next(
+        (
+            s
+            for s in range(engine.n_slots)
+            if engine.slot_req[s] is not None
+            and engine.slot_req[s].idx == idx
+        ),
+        None,
+    )
+    if slot is None:
+        raise KeyError(f"request {idx} holds no live slot")
+    req = engine.slot_req[slot]
+    p = len(req.prompt)
+    if engine.kv_layout == "paged":
+        run = engine._slot_pages[slot]
+        n_ship = (p - 1) // engine.page_size + 1
+        ids = np.full(
+            _pad_bucket(n_ship, lo=4), TRASH_PAGE, np.int32
+        )
+        ids[:n_ship] = run[:n_ship]
+        ids_dev = jnp.asarray(ids)
+        data = {
+            name: _page_gather_prog(arr, ids_dev)
+            for name, arr in engine.page_pool.items()
+        }
+        page_size, n_cells = engine.page_size, p
+    else:
+        bank_len = engine.max_len + engine.spec_draft_len
+        w = min(_pad_bucket(p), bank_len)
+        data = {
+            name: _row_slice_prog(arr, slot, w)
+            for name, arr in engine.cache.items()
+        }
+        page_size, n_ship, n_cells = 0, 0, p
+    if transport == "host":
+        data = {name: _host_bounce(v) for name, v in data.items()}
+    return KVHandoff(
+        prompt=np.asarray(req.prompt, np.int32).copy(),
+        max_new=max(int(engine.limit[slot]) - p, 1),
+        prng_key=engine.slot_key[slot].copy(),
+        kv_layout=engine.kv_layout,
+        transport=transport,
+        n_cells=n_cells,
+        data=data,
+        page_size=page_size,
+        n_ship=n_ship,
+        src=getattr(engine, "chaos_tag", ""),
+    )
+
+
+def check_compatible(engine, pkg: KVHandoff) -> None:
+    """Raise ValueError when `engine` cannot adopt `pkg` — the
+    coordinator's cue to try the next target (and ultimately the
+    scheduler's cue to fall back to replay)."""
+    if engine.kv_layout != pkg.kv_layout:
+        raise ValueError(
+            f"kv_layout mismatch: package {pkg.kv_layout!r}, "
+            f"engine {engine.kv_layout!r}"
+        )
+    if pkg.kv_layout == "paged":
+        if engine.page_size != pkg.page_size:
+            raise ValueError(
+                f"page_size mismatch: package {pkg.page_size}, "
+                f"engine {engine.page_size}"
+            )
+    else:
+        bank_len = engine.max_len + engine.spec_draft_len
+        w = next(iter(pkg.data.values())).shape[2]
+        if w > bank_len:
+            raise ValueError(
+                f"shipped row width {w} exceeds engine bank "
+                f"length {bank_len}"
+            )
+    if len(pkg.prompt) + 1 > engine.max_len:
+        raise ValueError(
+            f"prompt length {len(pkg.prompt)} leaves no room to "
+            f"generate (max_len {engine.max_len})"
+        )
+
+
+def _adopt_pages(engine, n: int) -> List[int]:
+    """Reserve `n` pages for a shipped run, reclaiming like
+    _alloc_pages does (evict prefix runs, then preempt) so an
+    oversubscribed decode pool adopts instead of bouncing."""
+    while True:
+        try:
+            return engine.allocator.adopt(n)
+        except OutOfPages:
+            if not engine._reclaim_pages():
+                raise
+
+
+def adopt_into_slot(engine, slot: int, pkg: KVHandoff) -> None:
+    """Install a shipped package into `slot` in place of a prefill.
+    Called from _admit's adoption branch; the admission tail (carry
+    token, pos, limit, key scatter) runs after this, so slot state
+    lands byte-identical to a colocated admission of the same prompt.
+    Raises OutOfPages when the pool cannot back the request even
+    after reclaim — the scheduler's replay fallback."""
+    check_compatible(engine, pkg)
+    if engine.kv_layout == "paged":
+        p = pkg.n_cells
+        limit = min(p + pkg.max_new, engine.max_len)
+        n_need = (
+            (limit - 1 + engine.spec_draft_len) // engine.page_size + 1
+        )
+        adopted = _adopt_pages(engine, pkg.n_ship)
+        try:
+            own = engine._alloc_pages(n_need - pkg.n_ship)
+        except OutOfPages:
+            engine.allocator.free(adopted)
+            raise
+        m = next(iter(pkg.data.values())).shape[1]
+        ids = np.full(m, TRASH_PAGE, np.int32)
+        ids[: pkg.n_ship] = adopted
+        ids_dev = jnp.asarray(ids)
+        for name, arr in engine.page_pool.items():
+            src = jax.device_put(pkg.data[name], arr.sharding)
+            engine.page_pool[name] = _page_scatter_prog(
+                arr, ids_dev, src
+            )
+        run = adopted + own
+        vals = np.full(engine._pages_per_slot, TRASH_PAGE, np.int32)
+        vals[: len(run)] = run
+        engine._table = _table_row_prog(engine._table, slot, vals)
+        engine._slot_pages[slot] = run
+    else:
+        for name, arr in engine.cache.items():
+            src = jax.device_put(pkg.data[name], arr.sharding)
+            engine.cache[name] = _row_install_prog(arr, src, slot)
+
+
+# ---- the coordinator -----------------------------------------------------
+
+
+class HandoffCoordinator:
+    """Routes prefilled requests from prefill-role replicas to decode
+    targets. Wired as each prefill scheduler's `on_handoff` by
+    ReplicaPool.add(); called OUTSIDE the source scheduler's lock
+    (the `_dispatch_failure` discipline — adoption takes the target's
+    lock). Returns True when the request was handled (adopted, or
+    terminally shed by the target's deadline check); False sends the
+    scheduler to the resume-by-replay fallback."""
+
+    # _step is bumped from every prefill scheduler's pump thread —
+    # guard it (graftlint LOCK-001)
+    GUARDED_FIELDS = frozenset({"_step"})
+
+    def __init__(
+        self,
+        pool,
+        chaos=None,
+        chaos_tag: str = "handoff",
+    ):
+        self.pool = pool
+        self.chaos = chaos
+        self.chaos_tag = chaos_tag
+        self._lock = threading.Lock()
+        self._step = 0
+
+    def _targets(self, source) -> List[Any]:
+        """Healthy non-source adopters, decode-role first (colocated
+        replicas are valid fallback targets — they can decode anything
+        — but never steal work from dedicated decoders), least-loaded
+        first for the same reason routing is."""
+        reps = [
+            r
+            for r in self.pool.replicas()
+            if r.scheduler is not source
+            and r.healthy
+            and not r.scheduler.crashed
+            and getattr(r, "role", "colocated") != "prefill"
+        ]
+        decode = [r for r in reps if r.role == "decode"]
+        out = decode or reps
+        out.sort(key=lambda r: r.load())
+        return out
+
+    def on_prefill_done(self, scheduler, ticket, pkg) -> bool:
+        with self._lock:
+            step = self._step
+            self._step += 1
+        if self.chaos is not None:
+            # the mid-handoff crash point: the package is exported,
+            # the source slot retired, nothing adopted yet — exactly
+            # the state resume-by-replay must recover from
+            self.chaos.on_engine_step(self.chaos_tag, step)
+        req = ticket.req
+        for rep in self._targets(scheduler):
+            try:
+                adopted = rep.scheduler.adopt(req, ticket, pkg)
+            except Exception:  # noqa: BLE001 — try the next target
+                logger.warning(
+                    "replica %s cannot adopt request %d",
+                    rep.id, req.id, exc_info=True,
+                )
+                continue
+            # adopted, or shed by the target's deadline check —
+            # terminal either way, replay would not help
+            return True
+        return False

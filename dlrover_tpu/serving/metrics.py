@@ -96,6 +96,9 @@ class ServingMetrics:
             "_mesh_tp",
             "_replica_chips",
             "_kernel_path_steps",
+            "_handoff_total",
+            "_handoff_last_ms",
+            "_role_queue_depth",
         }
     )
 
@@ -163,6 +166,16 @@ class ServingMetrics:
         # Both labels always render (zero until taken) so dashboards
         # can alert on "reference steps > 0" for a kernel deployment.
         self._kernel_path_steps = {"kernel": 0, "reference": 0}
+        # MPMD phase-handoff counters: completed prefill→decode
+        # migrations by transport, the last migration's end-to-end
+        # latency (export already done; this is placement + adoption),
+        # and per-role waiting depth. Fixed label sets so every label
+        # always renders (zero until taken).
+        self._handoff_total = {"device": 0, "host": 0}
+        self._handoff_last_ms = 0.0
+        self._role_queue_depth = {
+            "prefill": 0, "decode": 0, "colocated": 0,
+        }
 
     # ---- ingestion -------------------------------------------------------
 
@@ -315,6 +328,22 @@ class ServingMetrics:
         with self._lock:
             self._mesh_tp = int(tp)
             self._replica_chips = int(n_chips)
+
+    def observe_handoff(self, transport: str, ms: float):
+        """One completed prefill→decode migration over `transport`
+        ("device" | "host")."""
+        if transport not in ("device", "host"):
+            return
+        with self._lock:
+            self._handoff_total[transport] += 1
+            self._handoff_last_ms = float(ms)
+
+    def set_role_queue_depth(self, role: str, depth: int):
+        """Waiting depth of one replica role's scheduler (gauge)."""
+        if role not in ("prefill", "decode", "colocated"):
+            return
+        with self._lock:
+            self._role_queue_depth[role] = int(depth)
 
     def update_kernel_path(self, path: str, steps: int):
         """Refresh the per-attention-body decode-step counter from the
@@ -482,6 +511,21 @@ class ServingMetrics:
     def kernel_path_steps(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._kernel_path_steps)
+
+    @property
+    def handoff_total(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._handoff_total)
+
+    @property
+    def handoff_last_ms(self) -> float:
+        with self._lock:
+            return self._handoff_last_ms
+
+    @property
+    def role_queue_depth(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._role_queue_depth)
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -743,6 +787,32 @@ class ServingMetrics:
                 lines.append(
                     f'serving_kernel_path_steps_total{{path="{path}"}} '
                     f"{self._kernel_path_steps[path]}"
+                )
+            lines.append(
+                "# HELP serving_handoff_total Prefill→decode KV "
+                "migrations completed, by transport."
+            )
+            lines.append("# TYPE serving_handoff_total counter")
+            for transport in ("device", "host"):
+                lines.append(
+                    f'serving_handoff_total{{transport="{transport}"}} '
+                    f"{self._handoff_total[transport]}"
+                )
+            gauge(
+                "serving_handoff_latency_ms",
+                "Latency of the last prefill→decode migration "
+                "(placement + adoption), ms.",
+                self._handoff_last_ms,
+            )
+            lines.append(
+                "# HELP serving_role_queue_depth Requests waiting, "
+                "by replica role."
+            )
+            lines.append("# TYPE serving_role_queue_depth gauge")
+            for role in ("prefill", "decode", "colocated"):
+                lines.append(
+                    f'serving_role_queue_depth{{role="{role}"}} '
+                    f"{self._role_queue_depth[role]}"
                 )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()

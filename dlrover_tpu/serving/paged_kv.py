@@ -59,6 +59,7 @@ class PageAllocator:
         self.pages_freed = 0
         self.pages_shared = 0
         self.cow_copies = 0
+        self.pages_adopted = 0
 
     # -- capacity ----------------------------------------------------
 
@@ -99,6 +100,18 @@ class PageAllocator:
         for p in pages:
             self._refs[p] = 1
         self.pages_allocated += n
+        return pages
+
+    def adopt(self, n: int) -> List[int]:
+        """THE page-run install entry point for cross-replica handoff
+        (graftlint HANDOFF-001): reserve `n` fresh pages to receive a
+        run shipped from a prefill replica. Accounting-wise this IS an
+        alloc — each page comes out at refcount 1, owned exclusively
+        by the adopting slot, so the one-CoW-site invariant holds with
+        nothing to copy — but it is counted separately so the
+        handoff-vs-local admission mix stays observable."""
+        pages = self.alloc(n)
+        self.pages_adopted += n
         return pages
 
     def share(self, pages: List[int]) -> None:
@@ -192,4 +205,5 @@ class PageAllocator:
             "pages_freed": self.pages_freed,
             "pages_shared": self.pages_shared,
             "cow_copies": self.cow_copies,
+            "pages_adopted": self.pages_adopted,
         }

@@ -31,6 +31,7 @@ from dlrover_tpu.serving.failover import (
     CircuitBreaker,
     FailoverManager,
 )
+from dlrover_tpu.serving.handoff import HandoffCoordinator
 from dlrover_tpu.serving.scheduler import (
     AdmissionError,
     RequestScheduler,
@@ -88,6 +89,15 @@ class InferenceReplica:
         self.healthy = True
         self.strikes = 0
 
+    @property
+    def role(self) -> str:
+        """The replica's serving phase ("prefill" | "decode" |
+        "colocated") — the engine's knob, surfaced for routing and
+        the handoff coordinator's target selection."""
+        return getattr(
+            self.scheduler.engine, "replica_role", "colocated"
+        )
+
     # ---- registration ----------------------------------------------------
 
     @property
@@ -140,6 +150,7 @@ class InferenceReplica:
                 "healthy": self.healthy,
                 "mesh_shape": getattr(eng, "mesh_shape", {"tp": 1}),
                 "n_chips": int(getattr(eng, "n_chips", 1)),
+                "role": self.role,
             }
         ).encode()
 
@@ -249,6 +260,10 @@ class ReplicaPool:
             if failover
             else None
         )
+        # MPMD phase split: prefill-role replicas hand finished
+        # prefills to this coordinator, which places them on decode
+        # targets (wired as each prefill scheduler's on_handoff)
+        self.handoff = HandoffCoordinator(self)
         self._lock = threading.Lock()
         self._replicas: Dict[str, InferenceReplica] = {}
         self._last_hint_ts = 0.0
@@ -274,6 +289,11 @@ class ReplicaPool:
         sched = replica.scheduler
         if self.manager is not None and sched.on_failure is None:
             sched.on_failure = self.manager.on_scheduler_failure
+        if (
+            replica.role == "prefill"
+            and getattr(sched, "on_handoff", None) is None
+        ):
+            sched.on_handoff = self.handoff.on_prefill_done
         replica.register()
 
     def remove(self, replica_id: str) -> Optional[InferenceReplica]:
@@ -296,9 +316,19 @@ class ReplicaPool:
         deadline_s: Optional[float] = None,
     ) -> ServeRequest:
         """Least-loaded routing with failover: try healthy replicas in
-        load order until one admits."""
-        candidates = sorted(
+        load order until one admits. Phase-aware: new requests start
+        with a prefill, so prefill-role replicas take them first
+        (decode-role replicas only receive work through the handoff
+        coordinator); with no prefill replica in the pool, colocated
+        ones serve as always, and decode-role replicas are the last
+        resort (they CAN serve end-to-end — better than a 503)."""
+        ranked = sorted(
             self.healthy_replicas(), key=lambda r: r.load()
+        )
+        candidates = (
+            [r for r in ranked if r.role == "prefill"]
+            or [r for r in ranked if r.role == "colocated"]
+            or ranked
         )
         if not candidates:
             # nothing can serve: record a scale-up hint (force bypasses

@@ -35,6 +35,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.serving import handoff as handoff_mod
 from dlrover_tpu.serving.engine import ContinuousBatcher
 from dlrover_tpu.serving.failover import RequestJournal, ResumeTicket
 from dlrover_tpu.serving.metrics import ServingMetrics
@@ -97,6 +98,10 @@ class ServeRequest:
         self.scheduler: Optional["RequestScheduler"] = None
         self.retries = 0
         self.prng_key: Optional[np.ndarray] = None
+        # phase handoff: a KVHandoff package pinned by adopt() — the
+        # next admission installs it instead of prefilling (single-use;
+        # cleared at admission so later replays re-prefill plainly)
+        self.handoff_pkg = None
         # chunks of newly emitted tokens; None terminates the stream
         self.stream: "queue.Queue[Optional[List[int]]]" = queue.Queue()
         self._finished = threading.Event()
@@ -179,6 +184,9 @@ class RequestScheduler:
         metrics: Optional[ServingMetrics] = None,
         clock=time.monotonic,
         on_failure=None,
+        on_handoff=None,
+        handoff_transport: str = "device",
+        max_handoff_retries: int = 2,
     ):
         self.engine = engine
         self.slo = slo or SloConfig()
@@ -186,7 +194,10 @@ class RequestScheduler:
         self._clock = clock
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
-        # EDF heap of (deadline, seq, request). The tiebreak is a
+        # EDF heap of (deadline, prompt_len, seq, request). First
+        # tiebreak is shortest-prompt-first: among equal deadlines a
+        # long prefill must not convoy short ones behind it (the
+        # prefill-phase analog of SJF). Final tiebreak is a
         # scheduler-local sequence, NOT req.id: a failover-readmitted
         # request carries its id from ANOTHER scheduler, and a
         # collision would fall through to comparing ServeRequests.
@@ -200,6 +211,13 @@ class RequestScheduler:
         # raises. Without a callback, affected requests end FAILED.
         self.journal = RequestJournal()
         self.on_failure = on_failure
+        # phase handoff (MPMD split): `on_handoff(scheduler, ticket,
+        # package)` — wired to the pool's HandoffCoordinator — moves a
+        # prefill-role engine's finished prefills to decode replicas.
+        # Returning False (or raising) falls back to resume-by-replay.
+        self.on_handoff = on_handoff
+        self.handoff_transport = handoff_transport
+        self.max_handoff_retries = max_handoff_retries
         self.crashed = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -258,7 +276,10 @@ class RequestScheduler:
             )
             self._next_id += 1
             req.scheduler = self
-            heapq.heappush(self._waiting, (req.deadline, self._seq, req))
+            heapq.heappush(
+                self._waiting,
+                (req.deadline, int(arr.size), self._seq, req),
+            )
             self._seq += 1
             self.metrics.request_submitted()
             self.metrics.set_queue_depth(len(self._waiting))
@@ -293,7 +314,7 @@ class RequestScheduler:
         or at admission (lazy removal) — just drop them. Caller holds
         self._cond (the _locked convention)."""
         while self._waiting:
-            deadline, _, req = self._waiting[0]
+            deadline, _, _, req = self._waiting[0]
             if req.state is not RequestState.QUEUED:
                 heapq.heappop(self._waiting)
                 continue
@@ -350,15 +371,23 @@ class RequestScheduler:
                         )
                     ):
                         break
-                    _, _, req = heapq.heappop(self._waiting)
+                    _, _, _, req = heapq.heappop(self._waiting)
                     if req.state is not RequestState.QUEUED:
                         continue  # cancelled while waiting
-                    prompt, remaining = req.engine_spec()
-                    idx = self.engine.submit(
-                        prompt,
-                        max_new=remaining,
-                        prng_key=req.prng_key,
-                    )
+                    pkg, req.handoff_pkg = req.handoff_pkg, None
+                    if pkg is not None and not req.tokens:
+                        # adopted prefill: install the shipped KV
+                        # instead of replaying the prompt. A package
+                        # outlived by emitted tokens (decode-side
+                        # crash after adoption) is stale — replay.
+                        idx = self.engine.submit_adopted(pkg)
+                    else:
+                        prompt, remaining = req.engine_spec()
+                        idx = self.engine.submit(
+                            prompt,
+                            max_new=remaining,
+                            prng_key=req.prng_key,
+                        )
                     req.state = RequestState.RUNNING
                     self._running[idx] = req
                     self.journal.open(req)
@@ -408,7 +437,17 @@ class RequestScheduler:
                 live = self._running.get(idx)
                 if live is not None:
                     self.journal.record_key(live, key)
+            # phase split: a prefill-role engine's admissions are
+            # complete the moment they land (admission IS the
+            # prefill) — export them for migration, release their
+            # slots, and dispatch to the coordinator OUTSIDE the lock
+            # (it takes the target scheduler's lock)
+            migrations = self._drain_prefilled_locked()
             self.metrics.set_queue_depth(len(self._waiting))
+            self.metrics.set_role_queue_depth(
+                getattr(self.engine, "replica_role", "colocated"),
+                len(self._waiting),
+            )
             self.metrics.set_active_requests(len(self._running))
             pc = getattr(self.engine, "prefix_cache", None)
             if pc is not None:
@@ -444,7 +483,86 @@ class RequestScheduler:
                     int(mesh_shape.get("tp", 1)),
                     int(getattr(self.engine, "n_chips", 1)),
                 )
-            return bool(self._waiting) or bool(self._running)
+            busy = bool(self._waiting) or bool(self._running)
+        for req, ticket, pkg in migrations:
+            self._dispatch_handoff(req, ticket, pkg)
+        return busy or bool(migrations)
+
+    # ---- phase handoff ---------------------------------------------------
+
+    def _drain_prefilled_locked(self):
+        """Under the lock: turn every finished prefill into a
+        (request, ticket, package) migration — export the KV run,
+        snapshot the resume ticket, and release the slot. Only
+        prefill-role engines ever have finished prefills. The ticket
+        is snapshotted BEFORE retire so a failed handoff replays from
+        exactly the exported state."""
+        if (
+            getattr(self.engine, "replica_role", "colocated")
+            != "prefill"
+        ):
+            return []
+        take = getattr(self.engine, "take_prefilled", None)
+        if take is None:
+            return []
+        migrations = []
+        for ereq in take():
+            req = self._running.get(ereq.idx)
+            if req is None:
+                continue  # cancelled between admission and drain
+            pkg = None
+            try:
+                pkg = handoff_mod.export_run(
+                    self.engine,
+                    ereq.idx,
+                    transport=self.handoff_transport,
+                )
+            # graftlint: allow(EXC-001) reason=export failure is logged and the request falls back to resume-by-replay via its ticket
+            except Exception:
+                logger.exception(
+                    "KV export of request %d failed; falling back "
+                    "to replay", req.id,
+                )
+            ticket = self.journal.snapshot(req)
+            if ticket.prng_key is None and pkg is not None:
+                ticket.prng_key = pkg.prng_key
+            self.engine.retire(ereq.idx)
+            del self._running[ereq.idx]
+            self.journal.close(req)
+            migrations.append((req, ticket, pkg))
+        return migrations
+
+    def _dispatch_handoff(self, req, ticket, pkg) -> None:
+        """Outside the lock: hand one migration to the coordinator;
+        on any failure (no coordinator, no target, injected crash
+        mid-handoff) fall back to resume-by-replay — re-admit from
+        the ticket, re-prefill, re-export. Retries are bounded by
+        max_handoff_retries, after which the request fails loudly."""
+        handled = False
+        t0 = time.perf_counter()
+        if pkg is not None and self.on_handoff is not None:
+            try:
+                handled = bool(self.on_handoff(self, ticket, pkg))
+            # graftlint: allow(EXC-001) reason=mid-handoff crash is logged and recovered via the resume-by-replay fallback below
+            except Exception:
+                logger.exception(
+                    "handoff of request %d failed mid-flight", req.id
+                )
+        if handled:
+            self.metrics.observe_handoff(
+                pkg.transport, (time.perf_counter() - t0) * 1000.0
+            )
+            return
+        req.retries += 1
+        if req.retries > self.max_handoff_retries:
+            req._end_failed()
+            self.metrics.request_failed()
+            return
+        try:
+            self.readmit(req, ticket)
+        except AdmissionError:
+            req._end_failed()
+            self.metrics.request_failed()
 
     # ---- failover --------------------------------------------------------
 
@@ -467,7 +585,7 @@ class RequestScheduler:
             tickets.append(self.journal.snapshot(req))
         self._running.clear()
         while self._waiting:
-            _, _, req = heapq.heappop(self._waiting)
+            _, _, _, req = heapq.heappop(self._waiting)
             if req.state is RequestState.QUEUED:
                 tickets.append(self.journal.snapshot(req))
         self.journal = RequestJournal()
@@ -513,7 +631,57 @@ class RequestScheduler:
                 req.prng_key = np.asarray(ticket.prng_key, np.uint32)
             req.scheduler = self
             req.state = RequestState.QUEUED
-            heapq.heappush(self._waiting, (req.deadline, self._seq, req))
+            heapq.heappush(
+                self._waiting,
+                (
+                    req.deadline,
+                    int(len(req.prompt) + len(req.tokens)),
+                    self._seq,
+                    req,
+                ),
+            )
+            self._seq += 1
+            self.metrics.set_queue_depth(len(self._waiting))
+            self._cond.notify_all()
+            return True
+
+    def adopt(
+        self,
+        req: ServeRequest,
+        ticket: ResumeTicket,
+        package,
+    ) -> bool:
+        """Accept a request prefilled on another replica: the
+        KVHandoff package is pinned and installed at the next
+        admission — the copy-free decode-side half of the MPMD phase
+        split. Same contract as readmit(): bypasses the queue-depth
+        bound, honours the deadline (an already-late arrival is shed,
+        returns False), pins the journaled key. Raises (ValueError /
+        AdmissionError) when this engine cannot host the package —
+        the coordinator's cue to try the next target."""
+        handoff_mod.check_compatible(self.engine, package)
+        with self._cond:
+            if self.crashed:
+                raise AdmissionError("replica crashed, pending restart")
+            now = self._clock()
+            if req.deadline <= now:
+                req._end(RequestState.SHED, now)
+                self.metrics.request_shed()
+                return False
+            if ticket.prng_key is not None:
+                req.prng_key = np.asarray(ticket.prng_key, np.uint32)
+            req.handoff_pkg = package
+            req.scheduler = self
+            req.state = RequestState.QUEUED
+            heapq.heappush(
+                self._waiting,
+                (
+                    req.deadline,
+                    int(len(req.prompt)),
+                    self._seq,
+                    req,
+                ),
+            )
             self._seq += 1
             self.metrics.set_queue_depth(len(self._waiting))
             self._cond.notify_all()

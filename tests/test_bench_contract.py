@@ -55,9 +55,15 @@ def test_bench_smoke_emits_driver_contract():
     assert detail["ckpt_roundtrip_ok"] is True
 
 
+@pytest.mark.slow
 def test_bench_watchdog_emits_diagnosed_line():
-    # a dead backend must produce a parseable zero line naming the
-    # stuck phase, not a silent rc=1 (round-3 failure mode)
+    # a dead backend must produce a parseable line naming the stuck
+    # phase, not a silent rc=1 (round-3 failure mode) — and since the
+    # infra fallback, a LABELED cpu-smoke metric instead of the bare
+    # 0.0 that reads like a perf regression in the driver's history.
+    # Slow lane: the fallback child is a FULL CPU-smoke bench run (the
+    # fast tier keeps the no-fallback sibling below, which pins the
+    # diagnosed-line contract without spawning a second bench)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         env={
@@ -65,6 +71,36 @@ def test_bench_watchdog_emits_diagnosed_line():
             "DLROVER_TPU_FORCE_CPU": "1",
             "JAX_PLATFORMS": "cpu",
             "BENCH_PROBE_TIMEOUT": "0.1",
+        },
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=REPO,
+    )
+    assert proc.returncode == 3
+    lines = [
+        ln for ln in proc.stdout.splitlines() if ln.startswith("{")
+    ]
+    assert len(lines) == 1, f"expected ONE JSON line: {lines}"
+    d = json.loads(lines[0])
+    assert d["metric"] == "tokens_per_sec_per_chip"
+    assert d["value"] > 0
+    assert d["detail"]["backend"] == "cpu-smoke"
+    assert "infra_error" in d["detail"]
+
+
+def test_bench_no_fallback_pins_zero_line():
+    # the fallback child sets BENCH_NO_FALLBACK=1 on itself: a second
+    # infra failure inside it must emit the plain zero line, never
+    # recurse into another subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env={
+            **os.environ,
+            "DLROVER_TPU_FORCE_CPU": "1",
+            "JAX_PLATFORMS": "cpu",
+            "BENCH_PROBE_TIMEOUT": "0.1",
+            "BENCH_NO_FALLBACK": "1",
         },
         capture_output=True,
         text=True,
@@ -192,6 +228,17 @@ def test_serve_bench_smoke_emits_driver_contract():
         "kernel_ref_tpot_ms",
         "kernel_tpot_ratio",
         "n_kernel_requests",
+        # disaggregation phase: the MPMD phase-split evidence axes
+        "disagg_coloc_tpot_p99_ms",
+        "disagg_tpot_p99_ms",
+        "disagg_tpot_p99_ratio",
+        "disagg_parity_ok",
+        "disagg_success_rate",
+        "disagg_crash_success_rate",
+        "disagg_crash_leaked_pages",
+        "disagg_handoffs",
+        "disagg_pages_adopted",
+        "n_disagg_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -286,3 +333,22 @@ def test_serve_bench_smoke_emits_driver_contract():
     assert detail["kernel_ref_tpot_ms"] > 0
     assert detail["kernel_tpot_ratio"] > 0
     assert detail["n_kernel_requests"] > 0
+    # the disaggregation acceptance floor: on the mixed long-prefill /
+    # short-decode workload the decode-role replica — which never runs
+    # a prefill forward, only the copy-free page-run adoption — must
+    # beat the colocated engine's short-request TPOT p99 by a real
+    # margin (every colocated long admission stalls the token cadence
+    # for a full prefill). Correctness rides along: greedy byte parity
+    # between topologies, success 1.0 on both the clean passes and the
+    # pass with one injected mid-handoff crash (resume-by-replay
+    # re-prefills the victim), and ZERO pages leaked after drain
+    assert 0.0 < detail["disagg_tpot_p99_ratio"] <= 0.9
+    assert detail["disagg_tpot_p99_ms"] > 0
+    assert detail["disagg_coloc_tpot_p99_ms"] > 0
+    assert detail["disagg_parity_ok"] is True
+    assert detail["disagg_success_rate"] == 1.0
+    assert detail["disagg_crash_success_rate"] == 1.0
+    assert detail["disagg_crash_leaked_pages"] == 0
+    assert detail["disagg_handoffs"] >= 1
+    assert detail["disagg_pages_adopted"] >= 1
+    assert detail["n_disagg_requests"] > 0

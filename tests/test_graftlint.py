@@ -36,6 +36,7 @@ from dlrover_tpu.analysis.rules import (
     ClockDisciplineRule,
     DeviceAllocRule,
     EagerJnpImportRule,
+    HandoffAdoptionRule,
     HostCopyRule,
     JitSelfCaptureRule,
     KernelHygieneRule,
@@ -471,6 +472,56 @@ def test_kernel_rule_ignores_pallas_outside_ops(tmp_path):
         rel=ENGINE_REL,
     )
     assert not hits(KernelHygieneRule(), src)
+
+
+def test_handoff_rule_flags_adhoc_adoption(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        def sneak_pages(self, n):
+            pages = self.engine.allocator.adopt(n)
+            self.engine.allocator._refs[pages[0]] = 2
+            run = self.engine.allocator._free[:n]
+            return pages + run
+        """,
+    )
+    found = hits(HandoffAdoptionRule(), src)
+    assert len(found) == 3
+    assert any("adopt" in f.message for f in found)
+
+
+def test_handoff_rule_ignores_self_private_fields(tmp_path):
+    # the allocator's own methods touch _refs/_free through self —
+    # that IS the install path, not a bypass
+    src = probe(
+        tmp_path,
+        """
+        def alloc(self, n):
+            out, self._free = self._free[:n], self._free[n:]
+            for p in out:
+                self._refs[p] = 1
+            return out
+        """,
+    )
+    assert not hits(HandoffAdoptionRule(), src)
+
+
+def test_handoff_rule_vacuous_on_install_path(tmp_path):
+    # same offender code, impersonating the exempt files: the rule
+    # must not apply there (they ARE the entry point), and the
+    # vacuity guard proves the offender fires elsewhere
+    code = """
+    def install(self, engine, n):
+        return engine.allocator.adopt(n)
+    """
+    for rel in (
+        "dlrover_tpu/serving/paged_kv.py",
+        "dlrover_tpu/serving/handoff.py",
+    ):
+        src = probe(tmp_path, code, rel=rel)
+        assert not hits(HandoffAdoptionRule(), src), rel
+    src = probe(tmp_path, code, rel=SERVING_REL)
+    assert len(hits(HandoffAdoptionRule(), src)) == 1
 
 
 # ---------------------------------------------------------------------------

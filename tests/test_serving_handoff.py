@@ -1,0 +1,283 @@
+"""Disaggregated prefill/decode handoff (dlrover_tpu/serving/handoff.py)
+acceptance tests: fuzzed colocated-vs-disaggregated byte parity across
+{dense, paged} x {greedy, sampled} x {spec on/off} x {device, host}
+transports, crash-at-fuzzed-handoff-step chaos (success 1.0, zero
+leaked pages), and the gateway's /metrics + /healthz handoff
+exposition."""
+
+import dataclasses
+import http.client
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlrover_tpu.models import llama
+from dlrover_tpu.serving.chaos import FaultInjector
+from dlrover_tpu.serving.engine import ContinuousBatcher
+from dlrover_tpu.serving.gateway import ServingGateway
+from dlrover_tpu.serving.metrics import ServingMetrics
+from dlrover_tpu.serving.replica import InferenceReplica, ReplicaPool
+from dlrover_tpu.serving.scheduler import RequestScheduler, SloConfig
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(), dtype=jnp.float32
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 250, size=n).tolist() for n in lengths]
+
+
+def _build_pool(
+    cfg,
+    params,
+    disagg,
+    kv_layout="paged",
+    temperature=0.0,
+    spec_draft_len=0,
+    transport="device",
+    fi=None,
+):
+    """A colocated pool or a prefill+decode pair. The decode engine
+    seeds its sampler DIFFERENTLY (99 vs 7) on purpose: sampled parity
+    with the colocated oracle then proves the per-request PRNG key
+    rides the handoff ticket rather than being redrawn on adoption."""
+    metrics = ServingMetrics()
+    pool = ReplicaPool(metrics=metrics)
+    roles = ["prefill", "decode"] if disagg else ["colocated"]
+    scheds = []
+    for role in roles:
+        eng = ContinuousBatcher(
+            cfg,
+            params,
+            n_slots=3,
+            max_len=64,
+            max_new_tokens=8,
+            chunk=2,
+            pad_id=-1,
+            seed=99 if role == "decode" else 7,
+            temperature=temperature,
+            kv_layout=kv_layout,
+            spec_draft_len=spec_draft_len,
+            replica_role=role,
+        )
+        sch = RequestScheduler(
+            eng,
+            SloConfig(),
+            metrics=metrics,
+            handoff_transport=transport,
+        )
+        pool.add(InferenceReplica(role, sch))
+        scheds.append(sch)
+    if fi is not None:
+        pool.handoff.chaos = fi
+        pool.handoff.chaos_tag = "handoff"
+    return pool, scheds, metrics
+
+
+def _drain(scheds, rounds=100_000):
+    """Deterministic single-threaded drain: alternate pumps so the
+    prefill replica's exports interleave with decode adoption."""
+    for _ in range(rounds):
+        busy = False
+        for s in scheds:
+            busy = s.pump() or busy
+        if not busy:
+            return
+    raise AssertionError("pool did not drain")
+
+
+def _run(cfg, params, disagg, prompts, max_new=6, **kw):
+    pool, scheds, metrics = _build_pool(cfg, params, disagg, **kw)
+    reqs = [pool.submit(p, max_new=max_new) for p in prompts]
+    _drain(scheds)
+    outs = [list(r.tokens) for r in reqs]
+    states = [r.state.value for r in reqs]
+    return outs, states, scheds, metrics
+
+
+class TestDisaggParity:
+    """Fuzzed colocated-vs-disaggregated byte parity: same seeds, same
+    prompts, the phase-split topology must emit identical streams."""
+
+    @pytest.mark.parametrize(
+        "kv_layout,temperature,spec,transport",
+        [
+            ("dense", 0.0, 0, "device"),
+            ("dense", 0.9, 0, "host"),
+            ("paged", 0.0, 0, "host"),
+            ("paged", 0.9, 0, "device"),
+            ("paged", 0.0, 2, "device"),
+            ("paged", 0.9, 2, "host"),
+        ],
+    )
+    def test_parity_sweep(
+        self, model, kv_layout, temperature, spec, transport
+    ):
+        cfg, params = model
+        import zlib
+
+        fuzz = np.random.default_rng(
+            zlib.crc32(
+                f"{kv_layout}/{temperature}/{spec}/{transport}".encode()
+            )
+        )
+        prompts = _prompts(
+            fuzz.integers(3, 20, size=5), seed=int(fuzz.integers(1e6))
+        )
+        kw = dict(
+            kv_layout=kv_layout,
+            temperature=temperature,
+            spec_draft_len=spec,
+            transport=transport,
+        )
+        coloc_outs, coloc_states, _, _ = _run(
+            cfg, params, disagg=False, prompts=prompts, **kw
+        )
+        dis_outs, dis_states, scheds, metrics = _run(
+            cfg, params, disagg=True, prompts=prompts, **kw
+        )
+        assert all(s == "done" for s in coloc_states + dis_states)
+        assert dis_outs == coloc_outs
+        # every request actually migrated (non-vacuity)
+        assert metrics.handoff_total[transport] == len(prompts)
+        # decode-side pages all came through the adoption entry point
+        if kv_layout == "paged":
+            assert scheds[1].engine.allocator.pages_adopted > 0
+
+    def test_decode_replica_never_prefills(self, model):
+        """The phase split is real: the decode engine admits zero
+        requests of its own — everything it serves arrived as an
+        adopted page run with the prompt's KV already written."""
+        cfg, params = model
+        prompts = _prompts((4, 9, 15), seed=3)
+        _, states, scheds, _ = _run(
+            cfg, params, disagg=True, prompts=prompts
+        )
+        assert all(s == "done" for s in states)
+        prefill_eng, decode_eng = (s.engine for s in scheds)
+        assert decode_eng.allocator.pages_adopted > 0
+        # the prefill engine exported everything it admitted: nothing
+        # left resident after the drain on either side
+        assert prefill_eng.allocator.used_pages == 0
+        assert decode_eng.allocator.used_pages == 0
+
+
+class TestHandoffChaos:
+    """A crash at a fuzzed handoff step must cost nothing: the victim
+    re-prefills via resume-by-replay, every request completes, and no
+    page leaks on either allocator."""
+
+    @pytest.mark.parametrize("chaos_seed", [0, 1, 2])
+    def test_crash_at_fuzzed_handoff_step(self, model, chaos_seed):
+        cfg, params = model
+        fi = FaultInjector(seed=chaos_seed)
+        fi.fail_engine_step("handoff", between=(0, 4))
+        pool, scheds, _ = _build_pool(
+            cfg, params, disagg=True, fi=fi
+        )
+        fuzz = np.random.default_rng(chaos_seed)
+        prompts = _prompts(
+            fuzz.integers(3, 20, size=6),
+            seed=100 + chaos_seed,
+        )
+        reqs = [pool.submit(p, max_new=6) for p in prompts]
+        _drain(scheds)
+        assert fi.fired, "the injected handoff crash never fired"
+        done = sum(1 for r in reqs if r.state.value == "done")
+        assert done / len(reqs) == 1.0
+        for s in scheds:
+            s.engine.allocator.check()
+            assert s.engine.allocator.used_pages == 0
+
+    def test_crash_preserves_greedy_parity(self, model):
+        """The re-prefilled victim's stream is byte-identical to the
+        uncrashed colocated run — replay, not approximation."""
+        cfg, params = model
+        prompts = _prompts((5, 12, 8), seed=11)
+        coloc_outs, _, _, _ = _run(
+            cfg, params, disagg=False, prompts=prompts
+        )
+        fi = FaultInjector(seed=1)
+        fi.fail_engine_step("handoff", at_step=1)
+        pool, scheds, _ = _build_pool(
+            cfg, params, disagg=True, fi=fi
+        )
+        reqs = [pool.submit(p, max_new=6) for p in prompts]
+        _drain(scheds)
+        assert fi.fired
+        assert [list(r.tokens) for r in reqs] == coloc_outs
+
+
+class TestGatewayHandoffExposition:
+    def test_metrics_and_healthz_carry_handoff(self, model):
+        """After one real migration, /metrics renders the per-transport
+        counter family + latency gauge + per-role queue depths, and
+        /healthz carries the handoff block."""
+        cfg, params = model
+        pool, scheds, metrics = _build_pool(
+            cfg, params, disagg=True
+        )
+        for rep in pool.replicas():
+            rep.start()
+        gw = ServingGateway(pool, metrics=metrics)
+        gw.start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=120
+            )
+            conn.request(
+                "POST",
+                "/v1/generate",
+                json.dumps(
+                    {
+                        "tokens": _prompts((6,), seed=5)[0],
+                        "max_new": 4,
+                        "deadline_s": 300.0,
+                    }
+                ),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.read()
+            resp.read()
+            conn.close()
+
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=30
+            )
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+            conn.close()
+            for needle in (
+                "# TYPE serving_handoff_total counter",
+                'serving_handoff_total{transport="device"} 1',
+                'serving_handoff_total{transport="host"} 0',
+                "# TYPE serving_handoff_latency_ms gauge",
+                "# TYPE serving_role_queue_depth gauge",
+                'serving_role_queue_depth{role="prefill"}',
+                'serving_role_queue_depth{role="decode"}',
+            ):
+                assert needle in text, text
+
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=30
+            )
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            conn.close()
+            assert health["ok"] is True
+            assert health["handoff"]["total"]["device"] == 1
+            assert health["handoff"]["last_ms"] >= 0.0
+        finally:
+            gw.stop()
+            pool.stop()

@@ -179,6 +179,34 @@ class TestSheddingAndOrder:
         assert urgent.finish_ts <= relaxed.finish_ts
         assert urgent.state is RequestState.DONE
 
+    def test_edf_tie_breaks_shortest_prompt_first(self, model):
+        """Equal deadlines: the shorter prompt dispatches first
+        (cheapest prefill drains the queue fastest), regardless of
+        submission order."""
+        cfg, params = model
+        now = [0.0]
+        sched = RequestScheduler(
+            _engine(cfg, params, n_slots=1),
+            SloConfig(),
+            clock=lambda: now[0],
+        )
+        # longer prompt submitted FIRST — FIFO would run it first,
+        # EDF alone would tie on the identical deadline
+        long_req = sched.submit(
+            _prompts((20,), seed=12)[0], max_new=2, deadline_s=500.0
+        )
+        short_req = sched.submit(
+            _prompts((4,), seed=13)[0], max_new=2, deadline_s=500.0
+        )
+        heap_order = [
+            len(item[3].prompt) for item in sorted(sched._waiting)
+        ]
+        assert heap_order == sorted(heap_order)
+        sched.run_to_completion()
+        assert short_req.finish_ts <= long_req.finish_ts
+        assert short_req.state is RequestState.DONE
+        assert long_req.state is RequestState.DONE
+
     def test_scheduler_parity_with_oracle(self, model):
         """Drained through admission + EDF + slot re-admission, every
         request's stream is still token-for-token the lockstep
