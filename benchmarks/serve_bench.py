@@ -1092,6 +1092,109 @@ def main():
         s.engine.allocator.check()  # refcount/free-list consistency
         disagg_crash_leaked += int(s.engine.allocator.used_pages)
 
+    # ---- phase 10: elastic resize + drain-free weight refresh ---------
+    # Chip loss mid-workload on a tensor-parallel replica: the
+    # scheduler catches ChipLost inside its own pump and re-forms the
+    # mesh live at the largest surviving tp (serving/elastic.py) —
+    # every in-flight request is preempted and replayed instead of
+    # failing over or crashing the replica. The lock is success 1.0
+    # AND greedy byte parity with a no-fault oracle at the original
+    # tp. The reverse direction rides along: a weight refresh staged
+    # mid-drain must fence every request to a single weight version
+    # (no mixed-version step, ever) and commit at the next idle
+    # boundary. tp scales to the host: 4 when the device count and
+    # KV-head divisibility allow (half the slice dies, tp4 -> tp2),
+    # else the mesh phase's tp (tp2 -> tp1 on the CPU smoke).
+    elastic_tp = (
+        4 if (mesh_devices >= 4 and _mesh_kv % 4 == 0) else mesh_tp
+    )
+    elastic_chunk = 2  # several steps per drain: the fault must land
+    # mid-decode, not after a single chunk finished everything
+    elastic_success_rate = 1.0
+    elastic_parity_ok = True
+    elastic_resized_tp = elastic_tp
+    elastic_replayed = 0
+    elastic_downtime_ms = 0.0
+    elastic_metrics_ok = True
+    n_elastic_requests = 0
+    if elastic_tp > 1:
+        el_oracle = ContinuousBatcher(
+            cfg, params, n_slots=n_slots, max_len=max_len,
+            max_new_tokens=max_new, chunk=elastic_chunk, pad_id=-1,
+            mesh_spec=elastic_tp,
+        )
+        el_want = [
+            o.tolist() for o in el_oracle.generate_all(prompts)
+        ]
+        el_fi = FaultInjector(seed=0)
+        el_metrics = ServingMetrics()
+        el_eng = ContinuousBatcher(
+            cfg, params, n_slots=n_slots, max_len=max_len,
+            max_new_tokens=max_new, chunk=elastic_chunk, pad_id=-1,
+            mesh_spec=elastic_tp, chaos=el_fi, chaos_tag="elastic",
+        )
+        el_sched = RequestScheduler(el_eng, slo, metrics=el_metrics)
+        # warm outside the measured drain, then aim the loss a few
+        # steps past the current counter so it lands mid-decode with
+        # work both running and queued
+        el_w = el_sched.submit(prompts[0], max_new=2)
+        el_sched.run_to_completion()
+        assert el_w.state.value == "done"
+        el_fi.lose_chip(
+            "elastic", elastic_tp // 2,
+            at_step=el_eng._step_no + 3,
+        )
+        el_reqs = [
+            el_sched.submit(p, max_new=max_new) for p in prompts
+        ]
+        el_sched.run_to_completion()
+        assert el_fi.fired, "elastic chip-loss plan never fired"
+        n_elastic_requests = len(el_reqs)
+        elastic_success_rate = sum(
+            1 for r in el_reqs if r.state.value == "done"
+        ) / len(el_reqs)
+        elastic_parity_ok = [
+            list(r.tokens) for r in el_reqs
+        ] == el_want
+        elastic_resized_tp = el_eng.mesh_tp
+        el_stats = el_eng.elastic_stats()
+        elastic_replayed = int(el_stats["replayed_requests"])
+        elastic_downtime_ms = el_stats["resize_downtime_ms"]
+        _el_render = el_metrics.render()
+        elastic_metrics_ok = (
+            'serving_resize_total{direction="shrink"} 1'
+            in _el_render
+            and f"serving_mesh_tp {el_eng.mesh_tp}" in _el_render
+        )
+
+    # drain-free refresh, engine-driven for determinism: fresh leaves
+    # with identical values, so the lock is the version fence itself,
+    # not the arithmetic — request 0 drains entirely on version 0
+    # while the swap stays staged, request 1 crosses the submit fence
+    # and runs entirely on version 1
+    er_eng = ContinuousBatcher(
+        cfg, params, n_slots=n_slots, max_len=max_len,
+        max_new_tokens=max_new, chunk=elastic_chunk, pad_id=-1,
+        mesh_spec=elastic_tp,
+    )
+    er_fresh = jax.tree_util.tree_map(lambda x: x + 0, params)
+    er_i0 = er_eng.submit(prompts[0])
+    er_eng.step()                      # mid-drain
+    er_eng.update_params(er_fresh)     # defer mode: stages
+    er_staged_ok = er_eng.weight_version == 0
+    while er_eng.has_work():
+        er_eng.step()
+    er_i1 = er_eng.submit(prompts[1])  # fence: the swap commits here
+    er_committed_ok = er_eng.weight_version == 1
+    while er_eng.has_work():
+        er_eng.step()
+    elastic_refresh_ok = (
+        er_staged_ok
+        and er_committed_ok
+        and er_eng._requests[er_i0].versions == {0}
+        and er_eng._requests[er_i1].versions == {1}
+    )
+
     print(
         json.dumps(
             {
@@ -1262,6 +1365,21 @@ def main():
                     "disagg_handoffs": disagg_handoffs,
                     "disagg_pages_adopted": disagg_pages_adopted,
                     "n_disagg_requests": n_disagg_total,
+                    # elastic phase: chip-loss shrink + drain-free
+                    # weight refresh evidence axes
+                    "elastic_tp": elastic_tp,
+                    "elastic_resized_tp": elastic_resized_tp,
+                    "elastic_success_rate": round(
+                        elastic_success_rate, 3
+                    ),
+                    "elastic_parity_ok": elastic_parity_ok,
+                    "elastic_replayed": elastic_replayed,
+                    "elastic_downtime_ms": round(
+                        elastic_downtime_ms, 3
+                    ),
+                    "elastic_refresh_ok": elastic_refresh_ok,
+                    "elastic_metrics_ok": elastic_metrics_ok,
+                    "n_elastic_requests": n_elastic_requests,
                 },
             }
         ),

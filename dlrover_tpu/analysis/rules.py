@@ -1020,6 +1020,88 @@ class HandoffAdoptionRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# ELASTIC-001: resharding only through designated entry points
+
+
+ELASTIC_FILE = SERVING_PREFIX + "elastic.py"
+
+# resharding primitives: placing arrays onto a (new) sharding, laying
+# a param tree out under a mesh, or minting a serving mesh slice
+_RESHARD_CALLS = frozenset({"device_put", "serving_mesh", "shard_tree"})
+
+# functions allowed to call them, per serving file. engine.py: mesh
+# construction in __init__ plus the three placement helpers every
+# build/rebuild routes through; handoff.py: adoption places shipped
+# KV onto the TARGET engine's existing sharding (a transfer, not a
+# resize). Serving files not listed allow nothing. elastic.py is
+# exempt wholesale (see applies): the resize choreography IS the one
+# sanctioned out-of-construction resharding site.
+_RESHARD_ALLOWED: Dict[str, FrozenSet[str]] = {
+    ENGINE_FILE: frozenset(
+        {"__init__", "_shard_params", "_shard_bank", "_replicate"}
+    ),
+    HANDOFF_FILE: frozenset({"adopt_into_slot"}),
+}
+
+
+def reshard_sites(
+    tree: ast.AST,
+) -> List[Tuple[int, str, Optional[str]]]:
+    """(lineno, call, enclosing-function-name) for every resharding
+    primitive call: bare `device_put`/`serving_mesh`/`shard_tree` or
+    any attribute spelling (jax.device_put, mesh_mod.serving_mesh)."""
+    out = []
+    for node, owner in walk_with_owner(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = None
+        if isinstance(f, ast.Name) and f.id in _RESHARD_CALLS:
+            name = f.id
+        elif isinstance(f, ast.Attribute) and f.attr in _RESHARD_CALLS:
+            name = ast.unparse(f)
+        if name is not None:
+            out.append((node.lineno, name, owner))
+    return out
+
+
+class ElasticReshardRule(Rule):
+    id = "ELASTIC-001"
+    severity = CRITICAL
+    title = "resharding only through designated entry points"
+    rationale = (
+        "DEVIATIONS §15: a live mesh resize must be one choreography "
+        "— serving/elastic.py, built on parallel/mesh.py and "
+        "parallel/sharding.py plus the engine's construction-time "
+        "placement helpers. An ad-hoc device_put-onto-new-sharding "
+        "in an engine method mints a placement the program caches "
+        "(keyed on the mesh) never see, and a mesh minted outside "
+        "the factory can violate the n_kv_heads % tp gate the "
+        "factory validates."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src) and not _matches_file(
+            src.rel, ELASTIC_FILE
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        allowed = _file_config(src.rel, _RESHARD_ALLOWED) or frozenset()
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{call} in {owner or '<module>'}() — resharding "
+                f"allowed only in "
+                f"{sorted(allowed) or 'nothing in this file'}; route "
+                "resizes through serving/elastic.py",
+            )
+            for lineno, call, owner in reshard_sites(src.tree)
+            if owner not in allowed
+        ]
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -1036,6 +1118,7 @@ REGISTRY: List[Rule] = [
     BroadExceptRule(),
     KernelHygieneRule(),
     HandoffAdoptionRule(),
+    ElasticReshardRule(),
 ]
 
 

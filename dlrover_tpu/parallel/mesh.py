@@ -351,3 +351,23 @@ def serving_head_specs(mesh: Mesh) -> Dict[str, PartitionSpec]:
         "pool": PartitionSpec(None, None, ax, None),
         "replicated": PartitionSpec(),
     }
+
+
+def largest_serving_tp(
+    n_chips: int,
+    n_kv_heads: Optional[int] = None,
+    n_devices: Optional[int] = None,
+) -> int:
+    """Largest tp degree a shrunk/grown replica can re-form at: the
+    biggest t <= n_chips that divides `n_kv_heads` (the KV banks shard
+    the head axis) and fits the host's local devices. This is the one
+    shrink/grow policy source for serving/elastic.py — a resize that
+    picked its tp anywhere else could mint a slice serving_mesh_spec
+    would reject. Always >= 1 (tp=1 is every config's fallback)."""
+    if n_devices is None:
+        n_devices = jax.local_device_count()
+    cap = max(1, min(int(n_chips), int(n_devices)))
+    for t in range(cap, 0, -1):
+        if n_kv_heads is None or n_kv_heads % t == 0:
+            return t
+    return 1

@@ -44,6 +44,21 @@ class ReplicaCrashed(ChaosError):
     stand-in for a preempted TPU slice / OOM-killed pod."""
 
 
+class ChipLost(ChaosError):
+    """Injected chip loss: the replica is ALIVE but `n_chips` of its
+    mesh slice are gone (ICI link down, single-chip ECC wreck). Unlike
+    ReplicaCrashed the tag's probes keep passing — the stranded work
+    is recoverable by re-forming the mesh at a smaller tp
+    (serving/elastic.py), not by burying the replica. The injector
+    remembers the lost-chip count per tag (`chips_lost`) so health
+    probes see a degraded-but-alive device set until
+    `restore_chip`/`revive`."""
+
+    def __init__(self, msg: str, n_chips: int = 1):
+        super().__init__(msg)
+        self.n_chips = n_chips
+
+
 class KVFlake(ConnectionError):
     """Injected coordination-KV failure. Subclasses ConnectionError so
     production retry paths treat it exactly like a real master blip."""
@@ -52,10 +67,14 @@ class KVFlake(ConnectionError):
 class _EngineFault:
     """One engine-dispatch plan: at `at_step`, raise or crash."""
 
-    def __init__(self, at_step: int, exc: Exception, crash: bool):
+    def __init__(
+        self, at_step: int, exc: Exception, crash: bool,
+        chips: int = 0,
+    ):
         self.at_step = at_step
         self.exc = exc
         self.crash = crash  # crash => probes fail until revive()
+        self.chips = chips  # >0 => record lost chips (probes stay ok)
         self.fired = False
 
 
@@ -70,7 +89,7 @@ class FaultInjector:
     # plan installation — the only consumer — runs on the test thread
     # before any hook thread exists.
     GUARDED_FIELDS = frozenset(
-        {"_engine", "_slow", "_crashed", "_kv", "fired"}
+        {"_engine", "_slow", "_crashed", "_chips_lost", "_kv", "fired"}
     )
 
     def __init__(self, seed: int = 0):
@@ -80,6 +99,9 @@ class FaultInjector:
         # tag -> (delay_s, from_step, until_step)
         self._slow: Dict[str, Tuple[float, int, int]] = {}
         self._crashed: set = set()
+        # tag -> chips currently lost (degraded-but-alive: probes
+        # stay green, device_health() reports the deficit)
+        self._chips_lost: Dict[str, int] = {}
         # tag -> [remaining_failures, exception factory]
         self._kv: Dict[str, List[Any]] = {}
         self.fired: List[Tuple[str, str, int]] = []  # (kind, tag, step)
@@ -137,6 +159,52 @@ class FaultInjector:
             )
         return step
 
+    def lose_chip(
+        self,
+        tag: str,
+        n_chips: int = 1,
+        at_step: Optional[int] = None,
+        between: Optional[Tuple[int, int]] = None,
+    ) -> int:
+        """Yank `n_chips` devices out from under `tag` at an engine
+        step: the dispatch raises ChipLost ONCE, probes stay green,
+        and `chips_lost(tag)` reports the deficit until
+        `restore_chip()`/`revive()` — the degraded-but-alive shape a
+        live mesh shrink (serving/elastic.py) recovers from, as
+        opposed to the whole-replica death `crash_replica` injects.
+        Returns the (possibly seed-drawn) step."""
+        if n_chips < 1:
+            raise ValueError(f"lose_chip needs n_chips >= 1, got "
+                             f"{n_chips}")
+        step = self._pick_step(at_step, between)
+        with self._lock:
+            self._engine.setdefault(tag, []).append(
+                _EngineFault(
+                    step,
+                    ChipLost(
+                        f"{tag} lost {n_chips} chip(s) @step {step}",
+                        n_chips=n_chips,
+                    ),
+                    crash=False,
+                    chips=n_chips,
+                )
+            )
+        return step
+
+    def chips_lost(self, tag: str) -> int:
+        """Chips currently lost for `tag` (0 = full slice). The
+        device-health hook engine/pool probes consult — the CPU-host
+        stand-in for querying the runtime's device set."""
+        with self._lock:
+            return self._chips_lost.get(tag, 0)
+
+    def restore_chip(self, tag: str) -> None:
+        """The lost chip(s) came back (relinked/replaced): clear the
+        tag's deficit so health probes report a full slice again —
+        the pool's probation re-probe then grows the replica back."""
+        with self._lock:
+            self._chips_lost.pop(tag, None)
+
     def slow_replica(
         self,
         tag: str,
@@ -161,6 +229,7 @@ class FaultInjector:
         the replacement pod came up."""
         with self._lock:
             self._crashed.discard(tag)
+            self._chips_lost.pop(tag, None)
             self._engine.pop(tag, None)
             self._slow.pop(tag, None)
 
@@ -188,6 +257,11 @@ class FaultInjector:
                         fault.fired = True
                         if fault.crash:
                             self._crashed.add(tag)
+                        if fault.chips:
+                            self._chips_lost[tag] = (
+                                self._chips_lost.get(tag, 0)
+                                + fault.chips
+                            )
                         self.fired.append(("engine", tag, step))
                         to_raise = fault.exc
                         break

@@ -1,0 +1,198 @@
+"""Live elastic resize for a serving replica: survive chip loss by
+re-forming the mesh at a smaller tp, grow back when the chip returns.
+
+DLRover's elasticity claim for training — a worker dies, the job
+master re-forms the group and training continues — restated for
+serving: a tp=4 replica that loses a chip should NOT die, evacuate
+and wait for an operator. Every ingredient for re-forming at tp=2
+already exists in this repo:
+
+- resume-by-replay (PR 4): any live request is reconstructible from
+  host data alone — prompt + emitted tokens + its current PRNG key.
+  Greedy replay is byte-identical; sampled replay continues the exact
+  journaled key stream. So a resize does not need to reshard live KV
+  state across topologies: it preempts every slot, rebuilds the banks
+  fresh at the new tp, and replays. (DEVIATIONS §15 contrasts this
+  with true KV resharding and with DLRover's restart-the-worker.)
+- one mesh factory (parallel/mesh.py): `largest_serving_tp` picks the
+  biggest tp <= surviving chips that divides n_kv_heads, and
+  `serving_mesh` builds the slice — the resize cannot mint a mesh the
+  constructor would have rejected.
+- mesh-keyed program caches (PR 9): the mesh joins every program
+  cache key, so after `engine._bind_programs()` the resized engine
+  naturally selects programs specialized (and shard_mapped) for the
+  new tp; the Pallas per-shard head gates re-evaluate via
+  `engine._probe_kernel_path()`.
+
+The choreography here is deliberately the ONLY resharding site
+outside engine construction — graftlint rule ELASTIC-001 pins mesh
+rebuild and param/bank placement to parallel/mesh.py,
+parallel/sharding.py, the engine's construction-time helpers, and
+this module. ALLOC-001 does not apply here by design: the fresh bank
+builds ARE the point of a resize.
+
+What survives a resize untouched: the request queue, the ledger
+(`_requests`/`_pending`), request indices, the chaos step counter,
+and every accumulated stat. What is rebuilt: mesh, param placement,
+KV banks (dense bank or page pool + allocator + table), prefix
+pool/radix, spec drafter state, slot mirrors and their device copies,
+and the jitted program bindings. Replay then reconstructs the live
+KV from host truth.
+"""
+
+import dataclasses
+import time
+
+from dlrover_tpu.common.log import default_logger as logger
+from dlrover_tpu.models.decode import init_kv_cache, init_page_pool
+from dlrover_tpu.parallel.mesh import largest_serving_tp, serving_mesh
+from dlrover_tpu.serving.paged_kv import PageAllocator
+from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
+from dlrover_tpu.serving.speculative import SpeculativeDecoder
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class ResizeReport:
+    """What one live resize did — serve_bench and the pool log it,
+    tests assert on it."""
+
+    old_tp: int
+    new_tp: int
+    replayed: int        # live requests preempted for replay
+    downtime_ms: float   # quiesce -> programs rebound
+    direction: str       # "shrink" | "grow" | "noop"
+
+
+def resize(engine, n_chips: int) -> ResizeReport:
+    """Re-form `engine`'s mesh live at the largest valid tp <=
+    `n_chips`, preempting every live request for byte-identical
+    replay. No-op (still reported) when the target tp equals the
+    current one. The caller holds whatever lock serializes engine
+    access (the scheduler's condition variable); the engine is
+    single-threaded by contract.
+    """
+    if n_chips < 1:
+        raise ValueError(f"resize needs n_chips >= 1, got {n_chips}")
+    t0 = time.perf_counter()
+    cfg = engine.cfg
+    n_kv = getattr(cfg, "n_kv_heads", None) or cfg.n_heads
+    new_tp = largest_serving_tp(n_chips, n_kv_heads=n_kv)
+    # never grow past the constructed slice: those are the only chips
+    # the replica owns (the scale hint priced them)
+    new_tp = min(new_tp, engine._full_tp)
+    old_tp = engine.mesh_tp
+    if new_tp == old_tp:
+        return ResizeReport(old_tp, new_tp, 0, 0.0, "noop")
+    direction = "shrink" if new_tp < old_tp else "grow"
+    logger.info(
+        "elastic resize: tp=%d -> tp=%d (%d chips surviving)",
+        old_tp, new_tp, n_chips,
+    )
+
+    # 1. quiesce: abandon any dispatched-but-unharvested step. The
+    # journal/outputs then reflect the last HARVESTED dispatch — a
+    # consistent pair — and replay regenerates whatever the abandoned
+    # dispatch would have emitted (the PR-4 contract).
+    engine.drain_inflight()
+
+    # 2. journal every live request back to the queue front via the
+    # resume-by-replay path. Reverse slot order: _preempt_slot
+    # appendlefts, so the queue front ends up in ascending slot order
+    # and replay re-admits in the original arrival order.
+    replayed = 0
+    for slot in range(engine.n_slots - 1, -1, -1):
+        req = engine.slot_req[slot]
+        if req is not None and not engine.done[slot]:
+            engine._preempt_slot(slot)
+            replayed += 1
+
+    # 3. re-form the mesh through the one factory. tp=1 drops the
+    # mesh entirely — single-device programs, constrain() identity —
+    # exactly like a tp=1 construction.
+    engine.mesh = (
+        serving_mesh(new_tp, n_kv_heads=n_kv) if new_tp > 1 else None
+    )
+    engine.mesh_tp = new_tp
+
+    # 4. reshard the served params onto the new placement (the
+    # engine's construction-time helper; identity when mesh=None).
+    engine.params = engine._shard_params(engine.params)
+
+    # 5. rebuild the KV banks fresh at the new tp. Live KV is NOT
+    # resharded: replay reconstructs it from host truth, so carrying
+    # the old bank across topologies would be pure waste. Host-planned
+    # slot state and page tables are replicated (engine._replicate),
+    # so the async path and the PageAllocator survive untouched.
+    if engine._paged:
+        engine.allocator = PageAllocator(
+            engine.n_pages, engine.page_size
+        )
+        engine.page_pool = engine._shard_bank(
+            init_page_pool(
+                cfg, engine.n_pages, engine.page_size,
+                quant=engine._kv_quant,
+            )
+        )
+        engine._table = engine._replicate(
+            jnp.zeros(
+                (engine.n_slots, engine._pages_per_slot), jnp.int32
+            )
+        )
+        engine._slot_pages = [[] for _ in range(engine.n_slots)]
+        engine._row_pages = {}
+    else:
+        engine.cache = engine._shard_bank(
+            init_kv_cache(
+                cfg,
+                engine.n_slots,
+                engine.max_len + engine.spec_draft_len,
+                quant=engine._kv_quant,
+            )
+        )
+    if engine.prefix_cache is not None:
+        engine.prefix_cache = RadixPrefixCache(
+            engine._prefix_rows,
+            block=engine._prefix_block,
+            on_evict=(
+                engine._on_prefix_evict if engine._paged else None
+            ),
+        )
+        engine.pool = engine._shard_bank(
+            init_kv_cache(cfg, engine._prefix_rows, engine.max_len)
+        )
+    if engine.spec is not None:
+        ng_max, ng_min, thresh, probe = engine._spec_knobs
+        engine.spec = SpeculativeDecoder(
+            engine.n_slots,
+            engine.spec_draft_len,
+            ngram_max=ng_max,
+            ngram_min=ng_min,
+            threshold=thresh,
+            probe_interval=probe,
+        )
+    engine._slot_row = [None] * engine.n_slots
+
+    # 6. zero the slot mirrors (every slot freed by preemption) and
+    # re-upload them under the new mesh's replicated placement.
+    engine.tok[:] = engine.pad_id
+    engine.pos[:] = 0
+    engine.limit[:] = 0
+    engine.done[:] = True
+    engine.slot_key[:] = 0
+    engine._dev = engine._device_state()
+    engine._inflight = None
+
+    # 7. rebind the jitted programs: the mesh is in every cache key,
+    # so this selects (or builds) programs specialized for the new tp;
+    # the Pallas head gates re-evaluate at the new shard width.
+    engine._bind_programs()
+    engine._probe_kernel_path()
+
+    downtime_ms = (time.perf_counter() - t0) * 1e3
+    engine._elastic_resize[direction] += 1
+    engine._elastic_downtime_ms += downtime_ms
+    engine._elastic_replayed += replayed
+    return ResizeReport(old_tp, new_tp, replayed, downtime_ms,
+                        direction)

@@ -197,6 +197,10 @@ class _Request:
     # set by preempt-and-swap: the request was swapped to host and
     # re-queued for resume-by-replay (paged layout, pool pressure)
     preempted: bool = False
+    # weight versions whose dispatches emitted this request's tokens
+    # (elastic refresh observability: exactly one entry under the
+    # deferred fence; a second only across an opted-in live swap)
+    versions: set = dataclasses.field(default_factory=set)
     # a serving/handoff.py KVHandoff package: the prompt's KV was
     # prefilled on another replica and rides in `adopted.data` —
     # admission installs it instead of running a prefill (cleared at
@@ -692,6 +696,7 @@ class _Inflight:
     old_pos: Optional[np.ndarray] = None    # chunk: pos at dispatch
     dlens: Optional[np.ndarray] = None      # spec: drafted lengths
     was_live: Optional[np.ndarray] = None   # spec: live at dispatch
+    version: int = 0                # weight version at dispatch
 
 
 class ContinuousBatcher:
@@ -733,6 +738,8 @@ class ContinuousBatcher:
         swap_headroom: int = 1,      # free pages the scheduler keeps
         mesh_spec=None,              # tp degree | {"tp": n} | MeshSpec
         replica_role: str = "colocated",  # | "prefill" | "decode"
+        weight_refresh_mode: str = "defer",  # | "live" | "raise"
+        weight_refresh_replay: bool = True,  # live mode: replay slots
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -775,6 +782,28 @@ class ContinuousBatcher:
             self.mesh_tp = tp
             if tp > 1:
                 self.mesh = serving_mesh(tp, n_kv_heads=n_kv)
+        # ---- elastic state ----------------------------------------------
+        # The constructed tp is the grow-back target after a shrink;
+        # weight refreshes are version-tagged (the version joins every
+        # program-cache key so no stale closure can serve old weights)
+        # and stage mid-drain instead of silently mixing policies.
+        self._full_tp = self.mesh_tp
+        if weight_refresh_mode not in ("live", "defer", "raise"):
+            raise ValueError(
+                f"weight_refresh_mode must be 'live', 'defer' or "
+                f"'raise', got {weight_refresh_mode!r}"
+            )
+        self.weight_refresh_mode = weight_refresh_mode
+        self.weight_refresh_replay = weight_refresh_replay
+        self._weight_version = 0
+        self._staged_params = None
+        self._bound_keys: List[Any] = []  # (cache, key) pairs in use
+        self._elastic_resize = {"shrink": 0, "grow": 0}
+        self._elastic_refresh = {
+            "committed": 0, "deferred": 0, "rolled_back": 0,
+        }
+        self._elastic_downtime_ms = 0.0
+        self._elastic_replayed = 0
         self.cfg = cfg
         self.params = self._shard_params(params)
         self.n_slots = n_slots
@@ -963,34 +992,69 @@ class ContinuousBatcher:
                 threshold=spec_accept_threshold,
                 probe_interval=spec_probe_interval,
             )
-            self._run_spec = _cached_program(
-                _SPEC_PROGRAMS,
-                # graftlint: allow(JIT-003) reason=tuple literal plus env-derived forced-kernel tag; unforced keys are unchanged
-                (cfg, pad_id, eos_id, temperature, top_k, top_p,
-                 spec_draft_len, self.mesh) + _kernel_cache_tag(),
-                lambda: _build_spec_program(
-                    cfg, pad_id, eos_id, temperature, top_k, top_p,
-                    mesh=self.mesh,
-                ),
-            )[self.kv_layout]
         self.spec_draft_len = spec_draft_len
 
+        # sampling knobs survive as engine state: an elastic resize or
+        # a weight refresh re-runs the program-cache lookups
+        # (_bind_programs) with the same sampling tuple under a new
+        # mesh / weight-version key
+        self._sampling = (temperature, top_k, top_p)
+        self._bind_programs()
+        self._probe_kernel_path()
+
+    def _bind_programs(self) -> None:
+        """(Re)bind the jitted programs for the CURRENT (cfg, sampling
+        knobs, mesh, weight version). Called at construction, again by
+        serving/elastic.py after a mesh resize (the mesh is in every
+        cache key, so a resized engine naturally selects freshly
+        specialized programs), and by a committed weight refresh (the
+        version component retires the prior version's entries so no
+        stale closure can ever serve old weights)."""
+        cfg = self.cfg
+        temperature, top_k, top_p = self._sampling
+        version = self._weight_version
+        self._bound_keys = []
+        if self.spec is not None:
+            key = (
+                (cfg, self.pad_id, self.eos_id, temperature, top_k,
+                 top_p, self.spec_draft_len, self.mesh, version)
+                + _kernel_cache_tag()
+            )
+            self._bound_keys.append((_SPEC_PROGRAMS, key))
+            self._run_spec = _cached_program(
+                _SPEC_PROGRAMS,
+                # graftlint: allow(JIT-003) reason=hashable tuple literal assigned above and recorded in _bound_keys so a weight refresh can retire the prior version's entries
+                key,
+                lambda: _build_spec_program(
+                    cfg, self.pad_id, self.eos_id, temperature,
+                    top_k, top_p, mesh=self.mesh,
+                ),
+            )[self.kv_layout]
+        key = (
+            (cfg, self.pad_id, self.eos_id, temperature, top_k, top_p,
+             self.mesh, version) + _kernel_cache_tag()
+        )
+        self._bound_keys.append((_CHUNK_PROGRAMS, key))
         self._run_chunk = _cached_program(
             _CHUNK_PROGRAMS,
-            # graftlint: allow(JIT-003) reason=tuple literal plus env-derived forced-kernel tag; unforced keys are unchanged
-            (cfg, pad_id, eos_id, temperature, top_k, top_p,
-             self.mesh) + _kernel_cache_tag(),
+            # graftlint: allow(JIT-003) reason=hashable tuple literal assigned above and recorded in _bound_keys so a weight refresh can retire the prior version's entries
+            key,
             lambda: _build_chunk_program(
-                cfg, pad_id, eos_id, temperature, top_k, top_p,
-                mesh=self.mesh,
+                cfg, self.pad_id, self.eos_id, temperature, top_k,
+                top_p, mesh=self.mesh,
             ),
         )[self.kv_layout]
+        key = (
+            (cfg, self.max_len, self.mesh, version)
+            + _kernel_cache_tag()
+        )
+        self._bound_keys.append((_ADMIT_PROGRAMS, key))
         admit = _cached_program(
             _ADMIT_PROGRAMS,
-            # graftlint: allow(JIT-003) reason=tuple literal plus env-derived forced-kernel tag; unforced keys are unchanged
-            (cfg, max_len, self.mesh) + _kernel_cache_tag(),
+            # graftlint: allow(JIT-003) reason=hashable tuple literal assigned above and recorded in _bound_keys so a weight refresh can retire the prior version's entries
+            key,
             lambda: _build_admit_programs(
-                cfg, max_len, mesh=self.mesh
+                cfg, self.max_len, mesh=self.mesh
             ),
         )
         self._admit_fn = admit["admit"]
@@ -1002,19 +1066,23 @@ class ContinuousBatcher:
         self._paged_warm_fn = admit["paged_warm"]
         self._page_copy_fn = admit["page_copy"]
 
-        # Which attention body the per-token decode step traced into
-        # its program: "kernel" (Pallas paged-attention, shard_mapped
-        # over "tp" when mesh_tp > 1) or "reference" (XLA gather +
-        # softmax). Decided once here with shape probes — use_kernel
-        # only inspects shapes/dtypes, so ShapeDtypeStructs suffice —
-        # and surfaced via /healthz and the serving metrics so bench
-        # contracts can assert which path a replica actually runs.
+    def _probe_kernel_path(self) -> None:
+        """Which attention body the per-token decode step traced into
+        its program: "kernel" (Pallas paged-attention, shard_mapped
+        over "tp" when mesh_tp > 1) or "reference" (XLA gather +
+        softmax). Decided with shape probes — use_kernel only
+        inspects shapes/dtypes, so ShapeDtypeStructs suffice — at
+        construction and re-decided after an elastic resize (the
+        per-shard head gates re-evaluate at the new tp). Surfaced via
+        /healthz and the serving metrics so bench contracts can
+        assert which path a replica actually runs."""
+        cfg = self.cfg
         self.kernel_path = "reference"
         if self._paged and getattr(cfg, "attn_impl", "auto") != "reference":
             from dlrover_tpu.ops import paged_attention as _pa_probe
 
             probe_q = jax.ShapeDtypeStruct(
-                (n_slots, cfg.n_heads, cfg.head_dim), cfg.dtype
+                (self.n_slots, cfg.n_heads, cfg.head_dim), cfg.dtype
             )
             probe_pool = {
                 name: jax.ShapeDtypeStruct(arr.shape[1:], arr.dtype)
@@ -1115,12 +1183,190 @@ class ContinuousBatcher:
             k *= 2
         return k
 
-    def update_params(self, params) -> None:
-        """Swap the served weights (e.g. after a PPO update). Shapes
-        must match; the compiled programs are reused as-is. Call
-        between generate_all() drains — mid-drain the batch would mix
-        policies."""
-        self.params = self._shard_params(params)
+    @property
+    def weight_version(self) -> int:
+        """Monotonic version of the served weights. Joins every
+        program-cache key; requests/tickets record the version their
+        tokens were produced under."""
+        return self._weight_version
+
+    def update_params(self, params, mode: Optional[str] = None) -> None:
+        """Swap the served weights (a PPO update / a promoted
+        checkpoint), version-tagged. `mode` (default: the engine's
+        `weight_refresh_mode` knob) decides what happens when work is
+        in flight:
+
+        - "defer": stage the new tree and commit at the next idle
+          boundary — every in-flight request completes under the
+          version it started on (the fence). An idle engine commits
+          immediately. This replaces the old behavior, which silently
+          mixed policies mid-drain.
+        - "raise": refuse a mid-drain swap with RuntimeError — for
+          callers that wanted the call-between-drains contract
+          enforced, not worked around.
+        - "live": drain-free swap at the next dispatch boundary: any
+          in-flight dispatch is abandoned (drain_inflight — replay
+          regenerates its tokens), the version bumps, the
+          program-cache keys retire the prior version's entries, and
+          with `weight_refresh_replay` every live slot is preempted
+          and replayed under the new version on its journaled key
+          stream — otherwise live requests keep their old-version KV
+          and finish under the new weights. Either way a single
+          dispatch carries exactly one version: no mixed-version
+          step exists.
+
+        A poisoned refresh (tree structure / shape / dtype mismatch)
+        raises with the prior params and version still serving, and
+        counts as rolled_back in the elastic stats."""
+        mode = mode or self.weight_refresh_mode
+        if mode not in ("live", "defer", "raise"):
+            raise ValueError(
+                f"update_params mode must be 'live', 'defer' or "
+                f"'raise', got {mode!r}"
+            )
+        busy = self.has_work()
+        if mode == "raise" and busy:
+            raise RuntimeError(
+                "update_params while requests are in flight would mix "
+                "policies mid-drain; finish the drain, or refresh "
+                "with mode='defer' (fence) or mode='live' (versioned "
+                "swap)"
+            )
+        if mode == "defer" and busy:
+            try:
+                self._check_refresh_tree(params)
+            except Exception:
+                self._elastic_refresh["rolled_back"] += 1
+                raise
+            self._staged_params = params
+            self._elastic_refresh["deferred"] += 1
+            return
+        self._commit_refresh(
+            params,
+            replay=(
+                mode == "live" and busy and self.weight_refresh_replay
+            ),
+        )
+
+    def _check_refresh_tree(self, params) -> None:
+        """A poisoned refresh must fail BEFORE any engine state
+        changes: same tree structure, same leaf shapes and dtypes as
+        the currently served params."""
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        if old_def != new_def:
+            raise ValueError(
+                "weight refresh rejected: parameter tree structure "
+                "does not match the served params"
+            )
+        for o, n in zip(old_leaves, new_leaves):
+            o_shape = tuple(getattr(o, "shape", ()))
+            n_shape = tuple(getattr(n, "shape", ()))
+            if o_shape != n_shape or (
+                getattr(o, "dtype", None) != getattr(n, "dtype", None)
+            ):
+                raise ValueError(
+                    f"weight refresh rejected: leaf mismatch "
+                    f"{n_shape}/{getattr(n, 'dtype', None)} vs served "
+                    f"{o_shape}/{getattr(o, 'dtype', None)}"
+                )
+
+    def _commit_refresh(self, params, replay: bool = False) -> None:
+        """Apply a refresh now: validate, abandon any in-flight
+        dispatch, reshard, bump the version, rebind programs (the
+        version joins every cache key) and retire the old version's
+        cache entries. Any failure rolls back to the prior
+        params/version — the engine keeps serving."""
+        old_params = self.params
+        old_version = self._weight_version
+        old_keys = list(self._bound_keys)
+        try:
+            self._check_refresh_tree(params)
+            self.drain_inflight()
+            self.params = self._shard_params(params)
+            self._weight_version = old_version + 1
+            self._bind_programs()
+        except Exception:
+            self.params = old_params
+            self._weight_version = old_version
+            self._bind_programs()
+            self._elastic_refresh["rolled_back"] += 1
+            raise
+        for cache, key in old_keys:
+            cache.pop(key, None)  # retire stale-version closures
+        if replay:
+            # reverse order: _preempt_slot appendlefts, so the queue
+            # front comes out in ascending slot order for replay
+            for slot in range(self.n_slots - 1, -1, -1):
+                req = self.slot_req[slot]
+                if req is not None and not self.done[slot]:
+                    self._preempt_slot(slot)
+                    self._elastic_replayed += 1
+        self._staged_params = None
+        self._elastic_refresh["committed"] += 1
+
+    def _maybe_commit_refresh(self) -> None:
+        """Apply a deferred weight refresh once the engine is idle —
+        the fence boundary: nothing live, queued or in flight, so no
+        request ever spans the swap. Checked at submit() and step()."""
+        if self._staged_params is not None and not self.has_work():
+            self._commit_refresh(self._staged_params)
+
+    # -- elastic resize ----------------------------------------------------
+
+    def device_health(self) -> Dict[str, int]:
+        """Live device-set health for this replica's slice. On the
+        chaos-wired CPU host the deficit comes from the injector's
+        lose_chip plans; a real-TPU runtime probe slots in here
+        without changing any caller (pool probation, scheduler
+        resize, serve_bench)."""
+        lost = 0
+        if self.chaos is not None:
+            lost = int(self.chaos.chips_lost(self.chaos_tag))
+        total = int(self._full_tp)
+        return {
+            "chips_total": total,
+            "chips_lost": min(lost, total),
+            "chips_up": max(total - lost, 0),
+        }
+
+    def surviving_chips(self) -> int:
+        return self.device_health()["chips_up"]
+
+    def resize(self, n_chips: Optional[int] = None):
+        """Re-form this replica's mesh live at the largest valid tp
+        <= `n_chips` surviving devices (default: what device_health
+        reports). In-flight requests are preempted to the engine
+        queue and replayed byte-identically at the new tp. Delegates
+        the choreography to serving/elastic.py — the ONE resharding
+        site outside construction (graftlint ELASTIC-001)."""
+        from dlrover_tpu.serving import elastic as elastic_mod
+
+        if n_chips is None:
+            n_chips = self.surviving_chips()
+        return elastic_mod.resize(self, n_chips)
+
+    def elastic_stats(self) -> Dict[str, float]:
+        """Elastic counters for metrics exposition (the scheduler
+        copies these into ServingMetrics after each pump)."""
+        return {
+            "resize_shrink": float(self._elastic_resize["shrink"]),
+            "resize_grow": float(self._elastic_resize["grow"]),
+            "refresh_committed": float(
+                self._elastic_refresh["committed"]
+            ),
+            "refresh_deferred": float(
+                self._elastic_refresh["deferred"]
+            ),
+            "refresh_rolled_back": float(
+                self._elastic_refresh["rolled_back"]
+            ),
+            "resize_downtime_ms": float(self._elastic_downtime_ms),
+            "replayed_requests": float(self._elastic_replayed),
+            "weight_version": float(self._weight_version),
+            "tp": float(self.mesh_tp),
+            "full_tp": float(self._full_tp),
+        }
 
     # -- admission ---------------------------------------------------------
 
@@ -1136,6 +1382,9 @@ class ContinuousBatcher:
         pins the request's sampling key (a failover re-admission
         continues the journaled key stream); omitted, the engine
         draws one from its seed at admission."""
+        # a deferred weight refresh commits BEFORE the request enters
+        # the queue: it starts (and fences) on the new version
+        self._maybe_commit_refresh()
         arr = np.asarray(prompt, np.int32)
         if arr.ndim != 1 or arr.size == 0:
             raise ValueError("prompt must be a non-empty 1-D sequence")
@@ -1487,7 +1736,8 @@ class ContinuousBatcher:
         req.max_new = max(int(self.limit[slot]) - len(req.prompt), 1)
         req.prng_key = self.slot_key[slot].copy()
         req.preempted = True
-        self._release_slot_pages(slot)
+        if self._paged:  # dense slots have no page run to free
+            self._release_slot_pages(slot)
         if self.prefix_cache is not None:
             self._release_slot_row(slot)
         self.slot_req[slot] = None
@@ -1500,7 +1750,8 @@ class ContinuousBatcher:
         except ValueError:
             pass
         self._queue.appendleft(req)
-        self._swap_preemptions += 1
+        if self._paged:
+            self._swap_preemptions += 1
 
     def _release_slot_pages(self, slot: int) -> None:
         """Drop a slot's page run — pure host accounting. No device
@@ -1643,6 +1894,7 @@ class ContinuousBatcher:
         surface shifts by one call."""
         t0 = time.perf_counter()
         self._wait_this_step = 0.0
+        self._maybe_commit_refresh()  # deferred swap at idle fence
         try:
             if self.chaos is not None:
                 # before any admission or dispatch: an injected fault
@@ -1712,6 +1964,7 @@ class ContinuousBatcher:
                 arrays=(tok, pos, done, keys, emitted),
                 dispatched_at=0.0,
                 old_pos=self.pos.copy(),
+                version=self._weight_version,
             )
         )
 
@@ -1754,6 +2007,7 @@ class ContinuousBatcher:
                 dispatched_at=0.0,
                 dlens=dlens,
                 was_live=~self.done,
+                version=self._weight_version,
             )
         )
 
@@ -1796,11 +2050,11 @@ class ContinuousBatcher:
                         int(n_emit[slot]),
                     )
         self.tok, self.pos, self.slot_key = tok, pos, keys
-        return self._emit_events(emitted, counts, done)
+        return self._emit_events(emitted, counts, done, pend.version)
 
     def _emit_events(
         self, emitted: np.ndarray, counts: np.ndarray,
-        new_done: np.ndarray,
+        new_done: np.ndarray, version: int = 0,
     ) -> List[StepEvent]:
         """Shared post-dispatch bookkeeping: `counts[slot]` leading
         entries of `emitted[slot]` are the slot's real new tokens."""
@@ -1813,6 +2067,10 @@ class ContinuousBatcher:
                 int(t) for t in emitted[slot][: int(counts[slot])]
             ]
             req.out.extend(new_toks)
+            if new_toks:
+                # one dispatch carries one version: the set grows past
+                # a single entry only across an opted-in live swap
+                req.versions.add(version)
             if self.spec is not None and new_toks:
                 # whichever path emitted them, the drafter's context
                 # must see every token or proposals go stale

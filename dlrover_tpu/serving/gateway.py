@@ -294,6 +294,19 @@ class ServingGateway:
                 "last_ms": m.handoff_last_ms,
                 "role_queue_depth": m.role_queue_depth,
             }
+        # elastic health: resize/refresh counters, the served weight
+        # version, and the engine's live device-set health (same
+        # duck-typing as the handoff block)
+        if getattr(m, "resize_total", None) is not None:
+            out["elastic"] = {
+                "resize_total": m.resize_total,
+                "weight_refresh_total": m.weight_refresh_total,
+                "resize_downtime_ms": m.resize_downtime_ms,
+                "weight_version": m.weight_version,
+            }
+        health_fn = getattr(engine, "device_health", None)
+        if callable(health_fn):
+            out["device_health"] = health_fn()
         return out
 
     def _prefix_cache(self):

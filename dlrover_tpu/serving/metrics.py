@@ -99,6 +99,11 @@ class ServingMetrics:
             "_handoff_total",
             "_handoff_last_ms",
             "_role_queue_depth",
+            "_resize_total",
+            "_weight_refresh_total",
+            "_resize_downtime_ms",
+            "_weight_version",
+            "_replica_degradations",
         }
     )
 
@@ -176,6 +181,17 @@ class ServingMetrics:
         self._role_queue_depth = {
             "prefill": 0, "decode": 0, "colocated": 0,
         }
+        # elastic counters: copied from the engine's elastic_stats()
+        # each pump. Fixed label sets so every label always renders
+        # (zero until taken); the degradation counter is fed by the
+        # pool's health thread, not the engine.
+        self._resize_total = {"shrink": 0, "grow": 0}
+        self._weight_refresh_total = {
+            "committed": 0, "deferred": 0, "rolled_back": 0,
+        }
+        self._resize_downtime_ms = 0.0
+        self._weight_version = 0
+        self._replica_degradations = 0
 
     # ---- ingestion -------------------------------------------------------
 
@@ -344,6 +360,40 @@ class ServingMetrics:
             return
         with self._lock:
             self._role_queue_depth[role] = int(depth)
+
+    def replica_degraded(self):
+        """One replica entered the degraded (shrunk-but-alive) state —
+        distinct from ejection: it keeps serving."""
+        with self._lock:
+            self._replica_degradations += 1
+
+    def update_elastic(self, stats: Dict[str, float]):
+        """Refresh elastic resize / weight-refresh counters from the
+        engine's elastic_stats(). Running totals get the same max()
+        monotonic guard as the blocks above (a multi-replica pool may
+        share one exposition); tp/chips already flow through
+        set_mesh, and the weight version is a gauge."""
+        with self._lock:
+            self._resize_total["shrink"] = max(
+                self._resize_total["shrink"],
+                int(stats.get("resize_shrink", 0)),
+            )
+            self._resize_total["grow"] = max(
+                self._resize_total["grow"],
+                int(stats.get("resize_grow", 0)),
+            )
+            for outcome in ("committed", "deferred", "rolled_back"):
+                self._weight_refresh_total[outcome] = max(
+                    self._weight_refresh_total[outcome],
+                    int(stats.get(f"refresh_{outcome}", 0)),
+                )
+            self._resize_downtime_ms = max(
+                self._resize_downtime_ms,
+                float(stats.get("resize_downtime_ms", 0.0)),
+            )
+            self._weight_version = int(
+                stats.get("weight_version", self._weight_version)
+            )
 
     def update_kernel_path(self, path: str, steps: int):
         """Refresh the per-attention-body decode-step counter from the
@@ -526,6 +576,31 @@ class ServingMetrics:
     def role_queue_depth(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._role_queue_depth)
+
+    @property
+    def resize_total(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._resize_total)
+
+    @property
+    def weight_refresh_total(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._weight_refresh_total)
+
+    @property
+    def resize_downtime_ms(self) -> float:
+        with self._lock:
+            return self._resize_downtime_ms
+
+    @property
+    def weight_version(self) -> int:
+        with self._lock:
+            return self._weight_version
+
+    @property
+    def replica_degradations(self) -> int:
+        with self._lock:
+            return self._replica_degradations
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -814,6 +889,45 @@ class ServingMetrics:
                     f'serving_role_queue_depth{{role="{role}"}} '
                     f"{self._role_queue_depth[role]}"
                 )
+            lines.append(
+                "# HELP serving_resize_total Live mesh resizes "
+                "(chip loss shrink / probation grow-back), by "
+                "direction."
+            )
+            lines.append("# TYPE serving_resize_total counter")
+            for direction in ("shrink", "grow"):
+                lines.append(
+                    f'serving_resize_total{{direction="{direction}"}} '
+                    f"{self._resize_total[direction]}"
+                )
+            lines.append(
+                "# HELP serving_weight_refresh_total Live weight "
+                "refreshes, by outcome."
+            )
+            lines.append("# TYPE serving_weight_refresh_total counter")
+            for outcome in ("committed", "deferred", "rolled_back"):
+                lines.append(
+                    f'serving_weight_refresh_total'
+                    f'{{outcome="{outcome}"}} '
+                    f"{self._weight_refresh_total[outcome]}"
+                )
+            counter(
+                "serving_resize_downtime_ms_total",
+                "Cumulative quiesce-to-rebound downtime across live "
+                "resizes, ms.",
+                f"{self._resize_downtime_ms:.6g}",
+            )
+            gauge(
+                "serving_weight_version",
+                "Version of the currently served weights.",
+                self._weight_version,
+            )
+            counter(
+                "serving_replica_degradations_total",
+                "Replicas that entered the degraded (shrunk-but-"
+                "alive) state.",
+                self._replica_degradations,
+            )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()
         return "\n".join(

@@ -88,6 +88,12 @@ class InferenceReplica:
         self.kv_backoff_s = kv_backoff_s
         self.healthy = True
         self.strikes = 0
+        # degraded = alive but serving on a shrunk mesh slice (chip
+        # loss survived via serving/elastic.py). Distinct from
+        # ejection: a degraded replica keeps routing weight and must
+        # NOT accrue breaker strikes — the pool's probation re-probe
+        # grows it back when the chips return.
+        self.degraded = False
 
     @property
     def role(self) -> str:
@@ -151,6 +157,7 @@ class InferenceReplica:
                 "mesh_shape": getattr(eng, "mesh_shape", {"tp": 1}),
                 "n_chips": int(getattr(eng, "n_chips", 1)),
                 "role": self.role,
+                "degraded": self.degraded,
             }
         ).encode()
 
@@ -240,8 +247,13 @@ class ReplicaPool:
         max_retries: int = 2,
         breaker_backoff_base_s: float = 0.5,
         breaker_backoff_max_s: float = 30.0,
+        elastic_resize: bool = True,
     ):
         self.kv = kv
+        # degraded-replica handling: shrink a chip-lossy replica live
+        # (and grow it back when the chips return) instead of letting
+        # the loss surface as breaker strikes / ejection
+        self.elastic_resize = elastic_resize
         self.max_strikes = max_strikes
         self.hint_cooldown_s = hint_cooldown_s
         self.advisor = advisor
@@ -388,6 +400,11 @@ class ReplicaPool:
             # mid-serve: probation includes the rebuild
             ok = rep.restart()
         if ok:
+            # degraded-but-alive is NOT a breaker matter: a shrunk
+            # replica still serves, so it must not accrue strikes (in
+            # HALF_OPEN a single record_failure would re-trip). The
+            # elastic check shrinks/grows it under the scheduler lock.
+            self._elastic_check(rep)
             breaker.record_success()
             rep.strikes = 0
             if not rep.healthy:
@@ -407,6 +424,57 @@ class ReplicaPool:
                     "replica %s ejected (breaker open, retry in "
                     "%.2fs)", rep.id, breaker.retry_in_s,
                 )
+
+    def _elastic_check(self, rep: InferenceReplica) -> None:
+        """Degraded-state step for one HEALTHY replica: consult the
+        engine's device health and re-form its mesh live when the
+        slice changed — shrink while chips are missing, grow back
+        toward the constructed slice on the probation re-probe once
+        they return. Runs through the scheduler's lock-held
+        resize_engine so it never races a dispatch. The chip-
+        denominated scale hint reprices automatically: it live-reads
+        engine.n_chips, which a resize mutates."""
+        if not self.elastic_resize:
+            return
+        eng = rep.scheduler.engine
+        health_fn = getattr(eng, "device_health", None)
+        resize = getattr(rep.scheduler, "resize_engine", None)
+        if health_fn is None or resize is None:
+            return
+        health = health_fn()
+        lost = int(health.get("chips_lost", 0))
+        if lost > 0 and not rep.degraded:
+            rep.degraded = True
+            logger.warning(
+                "replica %s degraded: %d of %d chip(s) lost",
+                rep.id, lost, int(health.get("chips_total", 0)),
+            )
+            if self.metrics is not None:
+                degr = getattr(self.metrics, "replica_degraded", None)
+                if degr is not None:
+                    degr()
+        try:
+            # resize toward whatever the surviving slice supports —
+            # a no-op (reported, not rebuilt) when the engine already
+            # runs at the right tp, so steady-state probes are cheap
+            report = resize(None)
+        except Exception:  # noqa: BLE001 — resize failure ≠ probe failure
+            logger.exception(
+                "elastic resize of replica %s failed", rep.id
+            )
+            return
+        if report is not None and report.direction != "noop":
+            logger.warning(
+                "replica %s resized tp=%d -> tp=%d (%s), %d "
+                "request(s) replaying",
+                rep.id, report.old_tp, report.new_tp,
+                report.direction, report.replayed,
+            )
+        if lost == 0 and rep.degraded:
+            rep.degraded = False
+            logger.info(
+                "replica %s restored to its full slice", rep.id
+            )
 
     def aggregate_pressure(self) -> float:
         reps = self.healthy_replicas()

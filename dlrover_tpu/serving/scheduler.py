@@ -36,6 +36,7 @@ import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving import handoff as handoff_mod
+from dlrover_tpu.serving.chaos import ChipLost
 from dlrover_tpu.serving.engine import ContinuousBatcher
 from dlrover_tpu.serving.failover import RequestJournal, ResumeTicket
 from dlrover_tpu.serving.metrics import ServingMetrics
@@ -187,8 +188,13 @@ class RequestScheduler:
         on_handoff=None,
         handoff_transport: str = "device",
         max_handoff_retries: int = 2,
+        elastic_resize: bool = True,
     ):
         self.engine = engine
+        # chip loss mid-pump re-forms the mesh live (elastic.py)
+        # instead of crashing the replica; off => ChipLost takes the
+        # plain crash/failover path like any other engine failure
+        self.elastic_resize = elastic_resize
         self.slo = slo or SloConfig()
         self.metrics = metrics or ServingMetrics()
         self._clock = clock
@@ -394,6 +400,37 @@ class RequestScheduler:
                 events = (
                     self.engine.step() if self.engine.has_work() else []
                 )
+            except ChipLost as exc:
+                # the replica is ALIVE but its slice shrank: re-form
+                # the mesh live at the surviving tp instead of
+                # crashing the whole replica. In-flight requests are
+                # preempted to the engine queue and replayed
+                # byte-identically (serving/elastic.py); the
+                # scheduler's _running map keeps its entries — the
+                # engine re-admits the same indices after the resize.
+                events = []
+                handled = False
+                if self.elastic_resize:
+                    try:
+                        report = self.engine.resize(
+                            self.engine.surviving_chips()
+                        )
+                        logger.warning(
+                            "chip loss (%d gone): resized tp=%d -> "
+                            "tp=%d, %d request(s) replaying, "
+                            "%.1fms downtime",
+                            exc.n_chips, report.old_tp, report.new_tp,
+                            report.replayed, report.downtime_ms,
+                        )
+                        handled = True
+                    # graftlint: allow(EXC-001) reason=resize failure is logged and falls back to the crash/failover path below
+                    except Exception:
+                        logger.exception(
+                            "live resize after chip loss failed; "
+                            "crashing replica"
+                        )
+                if not handled:
+                    failure = (self._crash_locked(), exc)
             # graftlint: allow(EXC-001) reason=failure is logged and dispatched outside the lock by _dispatch_failure below
             except Exception as exc:
                 failure = (self._crash_locked(), exc)
@@ -483,6 +520,9 @@ class RequestScheduler:
                     int(mesh_shape.get("tp", 1)),
                     int(getattr(self.engine, "n_chips", 1)),
                 )
+            es = getattr(self.engine, "elastic_stats", None)
+            if es is not None:
+                self.metrics.update_elastic(es())
             busy = bool(self._waiting) or bool(self._running)
         for req, ticket, pkg in migrations:
             self._dispatch_handoff(req, ticket, pkg)
@@ -705,6 +745,32 @@ class RequestScheduler:
             req._end(RequestState.CANCELLED, self._clock())
             self.metrics.request_cancelled()
             return True
+
+    # ---- elastic ---------------------------------------------------------
+
+    def resize_engine(self, n_chips: Optional[int] = None):
+        """Resize the engine's mesh under the scheduler lock (the
+        pool's probe thread drives shrink-on-probe and grow-back from
+        here). pump() holds the same lock through engine.step(), so
+        the resize lands at a dispatch boundary, never mid-step.
+        Returns the ResizeReport, or None on a crashed scheduler."""
+        with self._cond:
+            if self.crashed:
+                return None
+            report = self.engine.resize(n_chips)
+            self._cond.notify_all()
+            return report
+
+    def refresh_weights(self, params, mode: Optional[str] = None):
+        """Version-tagged, drain-free weight refresh under the
+        scheduler lock: dispatches serialize on the same lock, so the
+        swap (or its staging, under the defer fence) can never land
+        mid-step — no request is ever served by a mixed-version
+        dispatch. `mode` overrides the engine's weight_refresh_mode
+        knob for this call."""
+        with self._cond:
+            self.engine.update_params(params, mode=mode)
+            self._cond.notify_all()
 
     def restart(self) -> None:
         """Bring a crashed scheduler back: rebuild the engine's

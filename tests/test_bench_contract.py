@@ -352,3 +352,19 @@ def test_serve_bench_smoke_emits_driver_contract():
     assert detail["disagg_handoffs"] >= 1
     assert detail["disagg_pages_adopted"] >= 1
     assert detail["n_disagg_requests"] > 0
+    # the elastic acceptance floor: chip loss mid-workload on the
+    # tp=2 replica (8 virtual devices force the mesh path) must
+    # re-form LIVE at tp=1 — success 1.0 with every request byte-
+    # identical to the no-fault oracle, at least one in-flight
+    # request replayed through the resize, the shrink counter on
+    # /metrics — and the drain-free weight refresh must hold its
+    # version fence (no request ever spans two weight versions)
+    assert detail["elastic_tp"] == 2
+    assert detail["elastic_resized_tp"] == 1
+    assert detail["elastic_success_rate"] == 1.0
+    assert detail["elastic_parity_ok"] is True
+    assert detail["elastic_replayed"] >= 1
+    assert detail["elastic_downtime_ms"] > 0
+    assert detail["elastic_refresh_ok"] is True
+    assert detail["elastic_metrics_ok"] is True
+    assert detail["n_elastic_requests"] > 0

@@ -36,6 +36,7 @@ from dlrover_tpu.analysis.rules import (
     ClockDisciplineRule,
     DeviceAllocRule,
     EagerJnpImportRule,
+    ElasticReshardRule,
     HandoffAdoptionRule,
     HostCopyRule,
     JitSelfCaptureRule,
@@ -522,6 +523,101 @@ def test_handoff_rule_vacuous_on_install_path(tmp_path):
         assert not hits(HandoffAdoptionRule(), src), rel
     src = probe(tmp_path, code, rel=SERVING_REL)
     assert len(hits(HandoffAdoptionRule(), src)) == 1
+
+
+# ---------------------------------------------------------------------------
+# ELASTIC-001: resharding only through designated entry points
+
+
+def test_elastic_rule_flags_adhoc_reshard(tmp_path):
+    # an engine method outside the designated owners moving arrays
+    # onto a new sharding inline — the footgun a live resize must
+    # route through serving/elastic.py instead
+    src = probe(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def step(self):
+                self.params = jax.device_put(self.params, self.sh)
+                self.mesh = serving_mesh(2, n_kv_heads=2)
+        """,
+        rel=ENGINE_REL,
+    )
+    found = hits(ElasticReshardRule(), src)
+    assert len(found) == 2
+    assert all("elastic" in f.message for f in found)
+
+
+def test_elastic_rule_allows_designated_owners(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def __init__(self, tp):
+                self.mesh = serving_mesh(tp, n_kv_heads=2)
+
+            def _shard_params(self, params):
+                return jax.device_put(params, self.sh)
+
+            def _replicate(self, x):
+                return jax.device_put(x, self.rep)
+        """,
+        rel=ENGINE_REL,
+    )
+    assert not hits(ElasticReshardRule(), src)
+
+
+def test_elastic_rule_vacuous_on_elastic_module(tmp_path):
+    # the same offender inside serving/elastic.py is the DESIGNED
+    # reshard path: exempt there, flagged anywhere else (vacuity
+    # guard on the exemption)
+    code = """
+    import jax
+
+    def resize(engine, tp):
+        engine.mesh = serving_mesh(tp, n_kv_heads=2)
+        engine.params = jax.device_put(engine.params, engine.sh)
+    """
+    src = probe(
+        tmp_path, code, rel="dlrover_tpu/serving/elastic.py"
+    )
+    assert not hits(ElasticReshardRule(), src)
+    src = probe(tmp_path, code, rel=SERVING_REL)
+    assert len(hits(ElasticReshardRule(), src)) == 2
+
+
+def test_elastic_rule_unlisted_serving_file_allows_nothing(tmp_path):
+    # a serving file with no allowlist entry gets no owners at all:
+    # every reshard primitive there is a finding
+    src = probe(
+        tmp_path,
+        """
+        def rebalance(pool):
+            return shard_tree(pool.params, pool.mesh)
+        """,
+        rel="dlrover_tpu/serving/replica.py",
+    )
+    assert len(hits(ElasticReshardRule(), src)) == 1
+
+
+def test_elastic_rule_ignores_outside_serving(tmp_path):
+    # parallel/mesh.py and the ops layer build meshes by design —
+    # the rule is a serving-layer invariant only
+    src = probe(
+        tmp_path,
+        """
+        import jax
+
+        def make(tp):
+            return jax.device_put(1.0, None), serving_mesh(tp)
+        """,
+        rel="dlrover_tpu/parallel/mesh.py",
+    )
+    assert not hits(ElasticReshardRule(), src)
 
 
 # ---------------------------------------------------------------------------
