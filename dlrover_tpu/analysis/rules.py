@@ -1102,6 +1102,90 @@ class ElasticReshardRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# ADAPTER-001: adapter-bank allocation/eviction only in adapters.py
+
+
+ADAPTERS_FILE = SERVING_PREFIX + "adapters.py"
+
+# bank constructors/mutators owned by serving/adapters.py: building a
+# fresh stacked bank, jit-scattering one slot of it, and the cache's
+# private eviction/upload internals. The engine (and everything else)
+# goes through DeviceAdapterCache.acquire/release/rebuild and reads
+# .bank — never mints or pokes bank state itself.
+_ADAPTER_BANK_CALLS = frozenset(
+    {"init_adapter_bank", "_bank_slot_write", "_take_slot", "_upload"}
+)
+
+# cache internals no other serving file may reach into — mutating
+# either directly desyncs the LRU order / pin counts from the device
+# bank's slot contents
+_ADAPTER_CACHE_PRIVATE = frozenset({"_resident", "_pins"})
+
+
+def adapter_bank_sites(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, what) for every adapter-bank constructor/mutator call
+    (bare name or any attribute spelling) and every non-self access to
+    a private adapter-cache field."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Name)
+                and f.id in _ADAPTER_BANK_CALLS
+            ):
+                out.append((node.lineno, f"{f.id}(...)"))
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr in _ADAPTER_BANK_CALLS
+            ):
+                out.append((node.lineno, f"{ast.unparse(f)}(...)"))
+        elif (
+            isinstance(node, ast.Attribute)
+            and node.attr in _ADAPTER_CACHE_PRIVATE
+            and not (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            )
+        ):
+            out.append((node.lineno, ast.unparse(node)))
+    return out
+
+
+class AdapterBankRule(Rule):
+    id = "ADAPTER-001"
+    severity = CRITICAL
+    title = "adapter-bank allocation/eviction only in adapters.py"
+    rationale = (
+        "DEVIATIONS §16: the stacked device adapter bank is built "
+        "once and mutated only through the LRU cache's pinned-aware "
+        "slot recycling in serving/adapters.py — slot indices live "
+        "inside admitted requests' device state, so an ad-hoc bank "
+        "build or slot write anywhere else can re-point a decoding "
+        "request at another tenant's weights, and a poke at the "
+        "cache's _resident/_pins desyncs eviction from the pins that "
+        "make it safe."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src) and not _matches_file(
+            src.rel, ADAPTERS_FILE
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{what} — adapter-bank construction and slot "
+                "recycling belong to serving/adapters.py only; go "
+                "through DeviceAdapterCache.acquire/release/rebuild",
+            )
+            for lineno, what in adapter_bank_sites(src.tree)
+        ]
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -1119,6 +1203,7 @@ REGISTRY: List[Rule] = [
     KernelHygieneRule(),
     HandoffAdoptionRule(),
     ElasticReshardRule(),
+    AdapterBankRule(),
 ]
 
 

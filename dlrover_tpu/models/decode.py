@@ -245,6 +245,7 @@ def _block(
     start,                   # scalar: cache slot of x's first token
     plain_causal: bool = False,
     mesh=None,
+    lora=None,               # (bank slices, idx, scale) or None
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One decoder block writing its K/V into the cache. Prefill is
     S=prompt_len/start=0; decode is S=1/start=pos. The projections,
@@ -252,17 +253,19 @@ def _block(
     write + position-masked attention are the only decode-specific
     parts. `_attn_qkv`/`_attn_residual` get mesh=None on purpose:
     their constraints speak the TRAINING axis names; the serving tp
-    layout is pinned inside `_write_cache_and_attend`."""
+    layout is pinned inside `_write_cache_and_attend`. `lora` carries
+    one layer's stacked adapter bank slices for batched multi-adapter
+    serving (see `_forward_cached`)."""
     lp = _compute_weights(cfg, layer_params)
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
-    q, k, v = _attn_qkv(cfg, None, h, lp, positions)
+    q, k, v = _attn_qkv(cfg, None, h, lp, positions, lora=lora)
     attn, layer_cache = _write_cache_and_attend(
         q, k, v, layer_cache, positions, start, cfg.head_dim,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
         plain_causal=plain_causal,
         mesh=mesh,
     )
-    x = _attn_residual(cfg, None, x, attn, lp)
+    x = _attn_residual(cfg, None, x, attn, lp, lora=lora)
     x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
     return x, layer_cache
 
@@ -271,6 +274,8 @@ def _block_gpt(
     cfg, x, lp, layer_cache, positions, start,
     plain_causal: bool = False,
     mesh=None,
+    lora=None,  # rejected upstream (_check_adapters); kept for the
+                # shared block-call signature
 ):
     """GPT-2 pre-LN block with cache write — built from gpt.py's own
     helpers; the cache write + masked attention are the only
@@ -308,14 +313,33 @@ def _check_positional_capacity(cfg, max_len: int):
         )
 
 
+def _check_adapters(cfg, adapters):
+    if adapters is not None and _is_gpt(cfg):
+        raise ValueError(
+            "multi-adapter serving targets the llama attention "
+            "projections; GPT's fused qkv has no per-target bank"
+        )
+
+
 def _forward_cached(
     cfg, params, tokens, cache, positions, start,
     plain_causal: bool = False,
     mesh=None,
+    adapters=None,
 ):
     """tokens [B,S] → logits [B,S,V], writing the cache at
     [start, start+S). Family dispatch: llama (RoPE/GQA/RMSNorm) or
-    GPT-2 (learned positions, pre-LN, tied wte head)."""
+    GPT-2 (learned positions, pre-LN, tied wte head).
+
+    `adapters` (serving/adapters.py) enables batched multi-adapter
+    LoRA: {"bank": per-target stacked arrays with leading [L, S]
+    (wq_a [L, S, in, r], wq_b [L, S, r, out], …), "idx": [B] int32
+    per-row cache slot, "scale": [S] f32}. The bank rides the layer
+    scan's xs next to the params/cache, so each block gathers its own
+    layer's [S, …] slices and adds the per-row delta inside the
+    projections. When None the scan carries the EXACT pre-adapter
+    pytree — the base program is structurally untouched."""
+    _check_adapters(cfg, adapters)
     gpt = _is_gpt(cfg)
     if gpt:
         x = (
@@ -329,19 +353,29 @@ def _forward_cached(
 
     def body(carry, inp):
         h = carry
-        layer_params, layer_cache = inp
+        if adapters is None:
+            layer_params, layer_cache = inp
+            lora = None
+        else:
+            layer_params, layer_cache, layer_bank = inp
+            lora = (layer_bank, adapters["idx"], adapters["scale"])
         h, layer_cache = block(
             cfg, h, layer_params, layer_cache, positions, start,
             plain_causal=plain_causal,
             mesh=mesh,
+            lora=lora,
         )
         return h, layer_cache
 
     # the cache dict scans as a pytree: each layer body sees its own
     # {"k","v"[,"k_scale","v_scale"]} slice and emits the updated one
-    x, cache_new = jax.lax.scan(
-        body, x, (params["layers"], dict(cache))
+    xs = (
+        (params["layers"], dict(cache))
+        if adapters is None
+        else (params["layers"], dict(cache), dict(adapters["bank"]))
     )
+    x, scanned = jax.lax.scan(body, x, xs)
+    cache_new = scanned
     if gpt:
         from dlrover_tpu.models.gpt import _layer_norm
 
@@ -364,6 +398,7 @@ def prefill(
     tokens: jax.Array,  # [B, P]
     cache: Dict[str, jax.Array],
     mesh=None,
+    adapters=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Fill the cache from a prompt; returns (last-token logits, cache)."""
     b, p = tokens.shape
@@ -374,6 +409,7 @@ def prefill(
         cfg, params, tokens, cache, positions, 0,
         plain_causal=p > 1,
         mesh=mesh,
+        adapters=adapters,
     )
     return logits[:, -1], cache
 
@@ -385,6 +421,7 @@ def decode_step(
     cache: Dict[str, jax.Array],
     pos,                # position of `token`: scalar, or [B] per slot
     mesh=None,
+    adapters=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One cached step → (next-token logits [B,V], updated cache).
 
@@ -399,7 +436,8 @@ def decode_step(
     else:
         positions = jnp.broadcast_to(pos, (b, 1))
     logits, cache = _forward_cached(
-        cfg, params, token[:, None], cache, positions, pos, mesh=mesh
+        cfg, params, token[:, None], cache, positions, pos, mesh=mesh,
+        adapters=adapters,
     )
     return logits[:, 0], cache
 
@@ -411,6 +449,7 @@ def verify_step(
     cache: Dict[str, jax.Array],
     pos,                # [B] position of tokens[:, 0] per slot
     mesh=None,
+    adapters=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Batched speculative verify: run the target model over all S
     positions per row in ONE compiled forward (the speculative
@@ -434,7 +473,8 @@ def verify_step(
     pos = jnp.asarray(pos, jnp.int32)
     positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
     logits, cache = _forward_cached(
-        cfg, params, tokens, cache, positions, pos, mesh=mesh
+        cfg, params, tokens, cache, positions, pos, mesh=mesh,
+        adapters=adapters,
     )
     return logits, cache
 
@@ -518,10 +558,14 @@ def prefill_into_slot(
     cache: Dict[str, jax.Array],
     slot,
     mesh=None,
+    adapters=None,
 ) -> Dict[str, jax.Array]:
     """Run a single-sequence prefill and install its K/V into row
     `slot` of a multi-slot cache — the admission step of continuous
-    batching (rl/serve.py).
+    batching (rl/serve.py). `adapters` carries a 1-row idx vector for
+    the admitted request's adapter slot (the prefill K/V must come
+    from the adapted projections, or decode would attend a base-model
+    prefix).
 
     Pad-tail correctness: cells beyond the prompt's true length hold
     pad-token K/V, but the decode mask (`cols <= pos`) hides every
@@ -535,7 +579,9 @@ def prefill_into_slot(
             f"{cache['k'].shape[2]}"
         )
     mini = init_kv_cache(cfg, 1, p, quant="k_scale" in cache)
-    _, mini = prefill(cfg, params, prompt[None], mini, mesh=mesh)
+    _, mini = prefill(
+        cfg, params, prompt[None], mini, mesh=mesh, adapters=adapters
+    )
     out = {}
     for name, arr in cache.items():
         out[name] = jax.lax.dynamic_update_slice(
@@ -571,14 +617,20 @@ def exact_row_cache(cfg, max_len: int) -> Dict[str, jax.Array]:
 
 
 def prefill_exact_row(
-    cfg, params, prompt: jax.Array, max_len: int, mesh=None
+    cfg, params, prompt: jax.Array, max_len: int, mesh=None,
+    adapters=None,
 ) -> Dict[str, jax.Array]:
     """Cold-admission prefill: run `prompt` [P] (pad tail fine) into a
     fresh exact row. The forward is identical to prefill_into_slot's
     (plain-causal attention never reads the cache, so an unquantized
-    target changes nothing about the computed K/V)."""
+    target changes nothing about the computed K/V). `adapters` (1-row
+    idx) serves the paged cold-admit of an adaptered request; rows
+    bound for the SHARED prefix pool must pass None — published
+    prefixes are base-model K/V by contract."""
     row = exact_row_cache(cfg, max_len)
-    _, row = prefill(cfg, params, prompt[None], row, mesh=mesh)
+    _, row = prefill(
+        cfg, params, prompt[None], row, mesh=mesh, adapters=adapters
+    )
     return row
 
 
@@ -776,25 +828,27 @@ def _write_pages_and_attend(
 
 
 def _block_paged(
-    cfg, x, layer_params, layer_pool, table, positions, mesh=None
+    cfg, x, layer_params, layer_pool, table, positions, mesh=None,
+    lora=None,
 ):
     """Llama block over paged KV — identical projections/residuals to
-    `_block`; only the cache write + view differ."""
+    `_block` (including the per-slot `lora` deltas); only the cache
+    write + view differ."""
     lp = _compute_weights(cfg, layer_params)
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
-    q, k, v = _attn_qkv(cfg, None, h, lp, positions)
+    q, k, v = _attn_qkv(cfg, None, h, lp, positions, lora=lora)
     attn, layer_pool = _write_pages_and_attend(
         q, k, v, layer_pool, table, positions, cfg.head_dim,
         mesh=mesh,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
     )
-    x = _attn_residual(cfg, None, x, attn, lp)
+    x = _attn_residual(cfg, None, x, attn, lp, lora=lora)
     x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
     return x, layer_pool
 
 
 def _block_gpt_paged(
-    cfg, x, lp, layer_pool, table, positions, mesh=None
+    cfg, x, lp, layer_pool, table, positions, mesh=None, lora=None
 ):
     from dlrover_tpu.models import gpt
 
@@ -810,11 +864,14 @@ def _block_gpt_paged(
 
 
 def _forward_paged(
-    cfg, params, tokens, pool, table, positions, mesh=None
+    cfg, params, tokens, pool, table, positions, mesh=None,
+    adapters=None,
 ):
     """tokens [B, S] → logits [B, S, V] over the paged pool; the
     layer scan mirrors `_forward_cached` (the pool pytree scans over
-    its leading layer axis; the table is shared by every layer)."""
+    its leading layer axis; the table is shared by every layer), as
+    does the optional `adapters` bank riding the xs."""
+    _check_adapters(cfg, adapters)
     gpt = _is_gpt(cfg)
     if gpt:
         x = (
@@ -828,16 +885,25 @@ def _forward_paged(
 
     def body(carry, inp):
         h = carry
-        layer_params, layer_pool = inp
+        if adapters is None:
+            layer_params, layer_pool = inp
+            lora = None
+        else:
+            layer_params, layer_pool, layer_bank = inp
+            lora = (layer_bank, adapters["idx"], adapters["scale"])
         h, layer_pool = block(
             cfg, h, layer_params, layer_pool, table, positions,
             mesh=mesh,
+            lora=lora,
         )
         return h, layer_pool
 
-    x, pool_new = jax.lax.scan(
-        body, x, (params["layers"], dict(pool))
+    xs = (
+        (params["layers"], dict(pool))
+        if adapters is None
+        else (params["layers"], dict(pool), dict(adapters["bank"]))
     )
+    x, pool_new = jax.lax.scan(body, x, xs)
     if gpt:
         from dlrover_tpu.models.gpt import _layer_norm
 
@@ -855,7 +921,8 @@ def _forward_paged(
 
 
 def paged_decode_step(
-    cfg, params, token: jax.Array, pool, table, pos, mesh=None
+    cfg, params, token: jax.Array, pool, table, pos, mesh=None,
+    adapters=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One cached step over paged KV → (logits [B, V], pool). The
     paged twin of `decode_step` ([B] per-slot positions only — the
@@ -865,12 +932,14 @@ def paged_decode_step(
     logits, pool = _forward_paged(
         cfg, params, token[:, None], pool, table, positions,
         mesh=mesh,
+        adapters=adapters,
     )
     return logits[:, 0], pool
 
 
 def paged_verify_step(
-    cfg, params, tokens: jax.Array, pool, table, pos, mesh=None
+    cfg, params, tokens: jax.Array, pool, table, pos, mesh=None,
+    adapters=None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Batched speculative verify over paged KV — the paged twin of
     `verify_step`. The engine sizes each request's page run for
@@ -880,7 +949,8 @@ def paged_verify_step(
     pos = jnp.asarray(pos, jnp.int32)
     positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
     logits, pool = _forward_paged(
-        cfg, params, tokens, pool, table, positions, mesh=mesh
+        cfg, params, tokens, pool, table, positions, mesh=mesh,
+        adapters=adapters,
     )
     return logits, pool
 

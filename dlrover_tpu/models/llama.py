@@ -287,15 +287,46 @@ def _compute_weights(cfg: LlamaConfig, layer_params) -> Dict:
     return out
 
 
-def _attn_qkv(cfg: LlamaConfig, mesh, h, lp, positions):
+def _slot_lora_delta(h, a, b, idx, scale):
+    """Per-row LoRA delta gathered from a stacked adapter bank — the
+    BGMV formulation of multi-adapter serving (serving/adapters.py):
+    row i of `h` [B, S, in] uses adapter cache slot idx[i], so the
+    delta is scale[idx] * (h @ A[idx]) @ B[idx] with A [S, in, r] and
+    B [S, r, out]. Slot 0 holds the all-zero adapter by convention,
+    so adapterless rows add an exact zero and the token stream is
+    unchanged. rank·in FLOPs per row — noise on the MXU."""
+    hr = jnp.einsum("bsi,bir->bsr", h, a[idx].astype(h.dtype))
+    d = jnp.einsum("bsr,bro->bso", hr, b[idx].astype(h.dtype))
+    return scale[idx].astype(h.dtype)[:, None, None] * d
+
+
+def _attn_qkv(cfg: LlamaConfig, mesh, h, lp, positions, lora=None):
     """Projections + RoPE of one block — shared by the training layer
     and the KV-cache decoder (models/decode.py), so there is exactly
-    one definition of the attention inputs."""
+    one definition of the attention inputs.
+
+    `lora` (serving only) is a (bank, idx, scale) triple of one
+    layer's stacked adapter slices: per-row deltas are added to the
+    raw projections BEFORE the head reshape and RoPE — RoPE is linear
+    in its input, so a pre-rotation delta equals rotating the
+    merged-weight projection."""
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     b, s, _ = h.shape
-    q = checkpoint_name((h @ lp["wq"]).reshape(b, s, H, hd), "qkv_proj")
-    k = checkpoint_name((h @ lp["wk"]).reshape(b, s, KV, hd), "qkv_proj")
-    v = checkpoint_name((h @ lp["wv"]).reshape(b, s, KV, hd), "qkv_proj")
+    hq, hk, hv = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    if lora is not None:
+        bank, idx, scale = lora
+        hq = hq + _slot_lora_delta(
+            h, bank["wq_a"], bank["wq_b"], idx, scale
+        )
+        hk = hk + _slot_lora_delta(
+            h, bank["wk_a"], bank["wk_b"], idx, scale
+        )
+        hv = hv + _slot_lora_delta(
+            h, bank["wv_a"], bank["wv_b"], idx, scale
+        )
+    q = checkpoint_name(hq.reshape(b, s, H, hd), "qkv_proj")
+    k = checkpoint_name(hk.reshape(b, s, KV, hd), "qkv_proj")
+    v = checkpoint_name(hv.reshape(b, s, KV, hd), "qkv_proj")
     q = constrain(q, mesh, ("data", "fsdp"), "seq", "tensor", None)
     k = constrain(k, mesh, ("data", "fsdp"), "seq", "tensor", None)
     v = constrain(v, mesh, ("data", "fsdp"), "seq", "tensor", None)
@@ -304,16 +335,21 @@ def _attn_qkv(cfg: LlamaConfig, mesh, h, lp, positions):
     return q, k, v
 
 
-def _attn_residual(cfg: LlamaConfig, mesh, x, attn, lp):
-    """Output projection + residual (shared with decode)."""
+def _attn_residual(cfg: LlamaConfig, mesh, x, attn, lp, lora=None):
+    """Output projection + residual (shared with decode). `lora` adds
+    the per-slot wo delta to the projection (same triple as
+    `_attn_qkv`)."""
     b, s, _ = x.shape
     attn = checkpoint_name(
         attn.reshape(b, s, cfg.n_heads * cfg.head_dim), "attn_out"
     )
-    return x + constrain(
-        checkpoint_name(attn @ lp["wo"], "attn_proj"),
-        mesh, ("data", "fsdp"), "seq", None,
-    )
+    o = checkpoint_name(attn @ lp["wo"], "attn_proj")
+    if lora is not None:
+        bank, idx, scale = lora
+        o = o + _slot_lora_delta(
+            attn, bank["wo_a"], bank["wo_b"], idx, scale
+        )
+    return x + constrain(o, mesh, ("data", "fsdp"), "seq", None)
 
 
 def _mlp_residual(cfg: LlamaConfig, mesh, x, layer_params, lp):

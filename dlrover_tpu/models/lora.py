@@ -62,6 +62,32 @@ class LoraConfig:
             )
 
 
+def validate_targets(params: Params, targets) -> None:
+    """Raise unless every target names a stacked ``[L, in, out]`` leaf
+    of ``params["layers"]``. A typo'd target (``"wq "``, ``"w_q"``)
+    must fail loudly here — downstream it would otherwise silently
+    no-op (nothing injects, nothing merges). Shared by `inject`, by
+    `merge`, and by the serving AdapterRegistry."""
+    layers = params["layers"]
+    for t in targets:
+        if t not in layers:
+            raise KeyError(
+                f"lora target {t!r} not in params['layers'] "
+                f"(have {sorted(k for k in layers if not is_adapter_path(k))})"
+            )
+        if layers[t].ndim != 3:
+            raise ValueError(
+                f"lora target {t!r} must be stacked [L, in, out], "
+                f"got shape {layers[t].shape}"
+            )
+
+
+def adapter_base(key: str) -> str:
+    """Base-weight key an adapter leaf points at
+    (``wq_lora_a`` -> ``wq``)."""
+    return key.split(LORA_A)[0].split(LORA_B)[0]
+
+
 def inject(
     cfg: LlamaConfig, params: Params, lora: LoraConfig,
     key: jax.Array, param_dtype=jnp.float32,
@@ -75,20 +101,11 @@ def inject(
     Targets are keys of params["layers"] with shape [L, in, out]
     (wq/wk/wv/wo, and w_gate/w_up/w_down if listed). Base weights are
     untouched — freezing happens in the optimizer."""
+    validate_targets(params, lora.targets)
     layers = dict(params["layers"])
     keys = jax.random.split(key, len(lora.targets))
     for t, k in zip(lora.targets, keys):
-        if t not in layers:
-            raise KeyError(
-                f"lora target {t!r} not in params['layers'] "
-                f"(have {sorted(layers)})"
-            )
         w = layers[t]
-        if w.ndim != 3:
-            raise ValueError(
-                f"lora target {t!r} must be stacked [L, in, out], "
-                f"got shape {w.shape}"
-            )
         L, d_in, d_out = w.shape
         layers[t + LORA_A] = (
             jax.random.normal(k, (L, d_in, lora.rank), param_dtype)
@@ -149,7 +166,7 @@ def load_adapters(params: Params, adapters: Params) -> Params:
     for k, v in adapters["layers"].items():
         if not is_adapter_path(k):
             raise KeyError(f"{k!r} is not an adapter leaf")
-        base = k.split(LORA_A)[0].split(LORA_B)[0]
+        base = adapter_base(k)
         if base not in layers:
             raise KeyError(
                 f"adapter {k!r} has no base weight {base!r}"
@@ -164,7 +181,27 @@ def merge(cfg: LlamaConfig, params: Params) -> Params:
     """Fold adapters into the base weights and drop them:
     W <- W + (alpha/r) A @ B in param dtype. The result is a plain
     full-parameter pytree — exportable to HF via models/convert.py
-    (merge-to-full, reference fine_tuning merge_and_unload)."""
+    (merge-to-full, reference fine_tuning merge_and_unload).
+
+    Every adapter leaf must resolve to an existing base weight and
+    carry its A/B partner — a stray leaf (typo'd target renamed by
+    hand, half a pair dropped by a bad checkpoint filter) would
+    otherwise be silently discarded instead of merged."""
+    for k in params["layers"]:
+        if not is_adapter_path(k):
+            continue
+        base = adapter_base(k)
+        if base not in params["layers"]:
+            raise KeyError(
+                f"adapter leaf {k!r} has no base weight {base!r} to "
+                f"merge into — a typo'd target silently no-ops "
+                f"without this check"
+            )
+        partner = base + (LORA_B if k.endswith(LORA_A) else LORA_A)
+        if partner not in params["layers"]:
+            raise KeyError(
+                f"adapter leaf {k!r} is missing its pair {partner!r}"
+            )
     layers = {}
     for k, v in params["layers"].items():
         if is_adapter_path(k):

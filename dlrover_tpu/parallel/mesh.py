@@ -353,6 +353,35 @@ def serving_head_specs(mesh: Mesh) -> Dict[str, PartitionSpec]:
     }
 
 
+def serving_adapter_specs(mesh: Mesh) -> Dict[str, PartitionSpec]:
+    """PartitionSpecs for the stacked device adapter banks a serving
+    replica gathers per-slot LoRA deltas from (serving/adapters.py):
+    per target ``t``, ``t_a`` is ``[L, S, in, r]`` and ``t_b`` is
+    ``[L, S, r, out]`` (S = device cache slots, slot 0 the zero
+    adapter), plus a ``scale`` vector ``[S]``.
+
+    Layout mirrors the base projections' serving placement so the
+    delta adds zero collectives under tp>1: wq/wk/wv are
+    output-column split on ``"tp"``, so their B banks shard the
+    output axis the same way while the tiny ``x @ A`` rank
+    activations stay replicated (rank never shards); wo is replicated
+    like the base out-projection, so its whole bank is too."""
+    if SERVING_TP_AXIS not in getattr(mesh, "axis_names", ()):
+        raise ValueError(
+            f"serving_adapter_specs needs a mesh with a "
+            f"{SERVING_TP_AXIS!r} axis (serving_mesh builds one); got "
+            f"axes {getattr(mesh, 'axis_names', None)}"
+        )
+    col = PartitionSpec(None, None, None, SERVING_TP_AXIS)
+    rep = PartitionSpec()
+    return {
+        "wq_b": col, "wk_b": col, "wv_b": col,
+        "wq_a": rep, "wk_a": rep, "wv_a": rep,
+        "wo_a": rep, "wo_b": rep,
+        "scale": rep,
+    }
+
+
 def largest_serving_tp(
     n_chips: int,
     n_kv_heads: Optional[int] = None,

@@ -24,8 +24,16 @@ inference:
   chaos.py     — deterministic, seed-driven fault injection (replica
                  crash, slow replica, engine-step exception, flaky
                  coordination KV) via hooks, not monkeypatching
+  adapters.py  — multi-adapter LoRA serving: host registry + LRU
+                 device adapter bank feeding the engine's batched
+                 per-slot delta path (one base forward, many adapters)
 """
 
+from dlrover_tpu.serving.adapters import (
+    AdapterCacheFull,
+    AdapterRegistry,
+    DeviceAdapterCache,
+)
 from dlrover_tpu.serving.chaos import ChaosError, ChaosKV, FaultInjector, ReplicaCrashed
 from dlrover_tpu.serving.engine import ContinuousBatcher, GenerationEngine
 from dlrover_tpu.serving.failover import (
@@ -56,11 +64,14 @@ from dlrover_tpu.serving.replica import (
 from dlrover_tpu.serving.gateway import ServingGateway
 
 __all__ = [
+    "AdapterCacheFull",
+    "AdapterRegistry",
     "AdmissionError",
     "ChaosError",
     "ChaosKV",
     "CircuitBreaker",
     "ContinuousBatcher",
+    "DeviceAdapterCache",
     "FailoverManager",
     "FaultInjector",
     "GenerationEngine",

@@ -1,0 +1,444 @@
+"""Multi-adapter LoRA serving: the host registry and the stacked
+device adapter bank behind `ContinuousBatcher(adapter_registry=...)`.
+
+One merged-weight replica per fine-tune costs memory ∝ tenants; a
+weight swap per request costs throughput ∝ 1/tenants. This module
+removes both walls the S-LoRA/punica way, restated for TPU static
+shapes: adapters live in a STACKED device bank — per attention target
+``t`` a pair ``t_a [L, S, in, r]`` / ``t_b [L, S, r, out]`` plus a
+``scale [S]`` vector, where S = `cache_slots` + 1 and slot 0 holds
+the all-zero adapter — and every forward gathers each batch row's
+slices by the engine's per-slot adapter-index vector, adding
+``scale[idx] * (x @ A[idx]) @ B[idx]`` inside the projections
+(models/llama._slot_lora_delta). Heterogeneous adapters batch
+through ONE base-model forward; `adapter_id=None` rows ride slot 0
+and stay byte-identical to the adapterless engine.
+
+Two pieces:
+
+- `AdapterRegistry` — host-side store of adapter pytrees
+  (register/unregister/version), shared by every replica in a
+  process. Registration validates targets and shapes against the
+  model config up front, so a typo'd adapter 400s at the gateway
+  instead of 500ing from deep inside a compiled program.
+- `DeviceAdapterCache` — one per engine: the stacked device bank and
+  an LRU of which adapters occupy its slots. Residency follows the
+  prefix-pool discipline: a slot is PINNED while any ledger entry
+  references it (acquire/release refcounts) and only unpinned slots
+  evict, least-recently-used first. Misses upload through one jitted
+  scatter (`_bank_slot_write`); device-side the bank never
+  reallocates, so program shapes — and therefore program-cache keys —
+  stay fixed for the life of the engine.
+
+Bank allocation and eviction are confined to this module by graftlint
+rule ADAPTER-001 (the ALLOC-001/HANDOFF-001 shape): the engine and
+the elastic resize hold references and call methods, they never mint
+banks of their own. Placement is injected (`place=engine._shard_bank`
+with `parallel.mesh.serving_adapter_specs`), so this module issues no
+device_put of its own and ELASTIC-001's resharding pin holds.
+"""
+
+import collections
+import functools
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.models.lora import LORA_A, LORA_B, adapter_base
+
+Params = Dict[str, Any]
+
+# the serving bank covers the attention projections — the defaults of
+# LoraConfig.targets and the only targets the decode delta path
+# gathers (MLP targets would triple the bank for workloads that
+# rarely train them; DEVIATIONS §16)
+SERVING_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+class AdapterCacheFull(RuntimeError):
+    """Every device cache slot is pinned by a live request — the
+    caller should keep the request queued and retry after a release
+    (the scheduler's pump does exactly that)."""
+
+
+def _target_dims(cfg) -> Dict[str, Tuple[int, int]]:
+    """(in, out) of each attention projection — what adapter shapes
+    must match and what the zero bank is sized from."""
+    heads = cfg.n_heads
+    kv = getattr(cfg, "n_kv_heads", None) or cfg.n_heads
+    hd = cfg.head_dim
+    return {
+        "wq": (cfg.dim, heads * hd),
+        "wk": (cfg.dim, kv * hd),
+        "wv": (cfg.dim, kv * hd),
+        "wo": (heads * hd, cfg.dim),
+    }
+
+
+class _HostAdapter:
+    """One registered adapter: per-target host arrays + its scale."""
+
+    __slots__ = ("a", "b", "rank", "scale", "version")
+
+    def __init__(self, a, b, rank, scale, version):
+        self.a = a          # {target: np [L, in, r]} (missing = zero)
+        self.b = b          # {target: np [L, r, out]}
+        self.rank = rank
+        self.scale = scale  # alpha / rank
+        self.version = version
+
+
+class AdapterRegistry:
+    """Host-side adapter store, safe to share across the gateway /
+    scheduler / pump threads. Holds NOTHING device-resident — device
+    residency is each engine's DeviceAdapterCache."""
+
+    GUARDED_FIELDS = frozenset({"_store", "_version"})
+
+    def __init__(self, cfg, max_rank: int = 8):
+        if max_rank < 1:
+            raise ValueError(f"max_rank must be >= 1, got {max_rank}")
+        self.cfg = cfg
+        self.max_rank = int(max_rank)
+        self._dims = _target_dims(cfg)
+        self._lock = threading.Lock()
+        self._store: Dict[str, _HostAdapter] = {}
+        self._version = 0
+
+    def _validate(self, adapter_id, adapters):
+        """Shape-check an adapter pytree against the model config;
+        returns ({target: a}, {target: b}, rank). Pure function of the
+        arguments — called outside the lock."""
+        if not isinstance(adapter_id, str) or not adapter_id:
+            raise ValueError(
+                f"adapter_id must be a non-empty string, got "
+                f"{adapter_id!r}"
+            )
+        layers = adapters.get("layers") if isinstance(
+            adapters, dict
+        ) else None
+        if not isinstance(layers, dict) or not layers:
+            raise ValueError(
+                "adapters must be an adapter_state_dict-style pytree "
+                "{'layers': {'<t>_lora_a': ..., '<t>_lora_b': ...}}"
+            )
+        a_arrs: Dict[str, np.ndarray] = {}
+        b_arrs: Dict[str, np.ndarray] = {}
+        for k, v in layers.items():
+            if LORA_A in k:
+                side, dest = LORA_A, a_arrs
+            elif LORA_B in k:
+                side, dest = LORA_B, b_arrs
+            else:
+                raise ValueError(
+                    f"{k!r} is not an adapter leaf (expected "
+                    f"'<target>{LORA_A}' / '<target>{LORA_B}')"
+                )
+            t = adapter_base(k)
+            if t not in self._dims:
+                raise ValueError(
+                    f"adapter target {t!r} is not servable — the "
+                    f"device bank covers {SERVING_TARGETS}"
+                )
+            dest[t] = np.asarray(v)
+        rank = None
+        for t in sorted(set(a_arrs) | set(b_arrs)):
+            if t not in a_arrs or t not in b_arrs:
+                raise ValueError(
+                    f"adapter target {t!r} is missing half its "
+                    f"A/B pair"
+                )
+            d_in, d_out = self._dims[t]
+            a, b = a_arrs[t], b_arrs[t]
+            want_a = (self.cfg.n_layers, d_in)
+            if a.ndim != 3 or (a.shape[0], a.shape[1]) != want_a:
+                raise ValueError(
+                    f"{t}{LORA_A} must be [L={want_a[0]}, "
+                    f"in={want_a[1]}, r], got {a.shape}"
+                )
+            want_b = (self.cfg.n_layers, d_out)
+            if b.ndim != 3 or (b.shape[0], b.shape[2]) != want_b:
+                raise ValueError(
+                    f"{t}{LORA_B} must be [L={want_b[0]}, r, "
+                    f"out={want_b[1]}], got {b.shape}"
+                )
+            r = a.shape[2]
+            if b.shape[1] != r:
+                raise ValueError(
+                    f"{t}: A rank {r} != B rank {b.shape[1]}"
+                )
+            if rank is None:
+                rank = r
+            elif r != rank:
+                raise ValueError(
+                    f"mixed ranks across targets ({rank} vs {r}): "
+                    "the stacked bank scales per SLOT, so one "
+                    "adapter must use one rank"
+                )
+        if rank > self.max_rank:
+            raise ValueError(
+                f"adapter rank {rank} exceeds the bank's max_rank "
+                f"{self.max_rank} (registry knob)"
+            )
+        return a_arrs, b_arrs, rank
+
+    def register(
+        self, adapter_id: str, adapters: Params, alpha: float = 16.0
+    ) -> int:
+        """Validate + store an adapter pytree
+        (models/lora.adapter_state_dict form); returns its version.
+        Re-registering an id bumps the version — device caches
+        re-upload on their next acquire."""
+        a_arrs, b_arrs, rank = self._validate(adapter_id, adapters)
+        with self._lock:
+            self._version += 1
+            rec = _HostAdapter(
+                a_arrs, b_arrs, rank, float(alpha) / rank,
+                self._version,
+            )
+            self._store[adapter_id] = rec
+            return rec.version
+
+    def unregister(self, adapter_id: str) -> None:
+        with self._lock:
+            if adapter_id not in self._store:
+                raise KeyError(f"unknown adapter {adapter_id!r}")
+            del self._store[adapter_id]
+
+    def get(self, adapter_id: str) -> _HostAdapter:
+        with self._lock:
+            rec = self._store.get(adapter_id)
+        if rec is None:
+            raise KeyError(f"unknown adapter {adapter_id!r}")
+        return rec
+
+    def __contains__(self, adapter_id) -> bool:
+        with self._lock:
+            return adapter_id in self._store
+
+    def ids(self):
+        with self._lock:
+            return sorted(self._store)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+    def version(self, adapter_id: str) -> int:
+        return self.get(adapter_id).version
+
+
+def init_adapter_bank(
+    cfg, cache_slots: int, max_rank: int, dtype
+) -> Dict[str, jax.Array]:
+    """The stacked zero bank: per target ``t_a [L, S, in, max_rank]``
+    and ``t_b [L, S, max_rank, out]`` plus ``scale [S]``, with
+    S = cache_slots + 1 and slot 0 the permanent zero adapter
+    (`adapter_id=None` rows gather an exact-zero delta there).
+    Rank padding is delta-exact: zero rows of A contribute zero to
+    ``x @ A``, zero columns of B multiply them by zero again."""
+    dims = _target_dims(cfg)
+    s = cache_slots + 1
+    bank: Dict[str, jax.Array] = {}
+    for t, (d_in, d_out) in dims.items():
+        bank[t + "_a"] = jnp.zeros(
+            (cfg.n_layers, s, d_in, max_rank), dtype
+        )
+        bank[t + "_b"] = jnp.zeros(
+            (cfg.n_layers, s, max_rank, d_out), dtype
+        )
+    bank["scale"] = jnp.zeros((s,), jnp.float32)
+    return bank
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _bank_slot_write(bank, update, slot):
+    """Scatter one adapter's stacked slices into bank slot `slot` —
+    the upload path's single compiled program (slot is traced, so
+    every upload shares it). Donation rewrites the bank in place;
+    sharding propagates from the donated operand."""
+    out = {}
+    for name, arr in bank.items():
+        if arr.ndim == 1:  # the scale vector
+            out[name] = arr.at[slot].set(update[name])
+        else:
+            out[name] = arr.at[:, slot].set(
+                update[name].astype(arr.dtype)
+            )
+    return out
+
+
+class DeviceAdapterCache:
+    """Per-engine device residency for registered adapters: the
+    stacked bank plus an LRU slot map with pinned-while-referenced
+    eviction (the prefix-pool refcount discipline). Single-threaded
+    by the engine's own contract — the scheduler serializes engine
+    access — so no lock lives here."""
+
+    def __init__(
+        self,
+        cfg,
+        registry: AdapterRegistry,
+        cache_slots: int,
+        dtype=None,
+        place: Optional[Callable] = None,
+    ):
+        if cache_slots < 1:
+            raise ValueError(
+                f"adapter_cache_slots must be >= 1, got {cache_slots}"
+            )
+        self.cfg = cfg
+        self.registry = registry
+        self.cache_slots = int(cache_slots)
+        self.max_rank = registry.max_rank
+        self._dims = _target_dims(cfg)
+        self._dtype = dtype if dtype is not None else cfg.dtype
+        self._place = place if place is not None else (lambda b: b)
+        self.bank = self._place(
+            init_adapter_bank(
+                cfg, self.cache_slots, self.max_rank, self._dtype
+            )
+        )
+        # id -> device slot, insertion order == recency (LRU front)
+        self._resident: "collections.OrderedDict[str, int]" = (
+            collections.OrderedDict()
+        )
+        self._uploaded_version: Dict[str, int] = {}
+        self._pins: collections.Counter = collections.Counter()
+        self._free = list(range(self.cache_slots, 0, -1))  # pop() -> 1
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.uploads = 0
+
+    # -- residency -----------------------------------------------------
+
+    def acquire(self, adapter_id: Optional[str]) -> int:
+        """Pin `adapter_id` into the bank and return its device slot
+        (0 for None — the zero adapter needs no pin). Uploads on miss
+        or on a stale version; raises KeyError for unregistered ids
+        and AdapterCacheFull when every slot is pinned."""
+        if adapter_id is None:
+            return 0
+        rec = self.registry.get(adapter_id)
+        slot = self._resident.get(adapter_id)
+        if (
+            slot is not None
+            and self._uploaded_version.get(adapter_id) == rec.version
+        ):
+            self.hits += 1
+            self._resident.move_to_end(adapter_id)
+            self._pins[adapter_id] += 1
+            return slot
+        self.misses += 1
+        if slot is None:
+            slot = self._take_slot()
+            self._resident[adapter_id] = slot
+        else:  # re-registered under the same id: refresh in place
+            self._resident.move_to_end(adapter_id)
+        self._upload(slot, rec)
+        self._uploaded_version[adapter_id] = rec.version
+        self._pins[adapter_id] += 1
+        return slot
+
+    def release(self, adapter_id: Optional[str]) -> None:
+        """Drop one pin. The adapter STAYS resident (that is the
+        cache) — it merely becomes evictable."""
+        if adapter_id is None:
+            return
+        if self._pins[adapter_id] <= 0:
+            raise RuntimeError(
+                f"release() without a matching acquire() for "
+                f"{adapter_id!r}"
+            )
+        self._pins[adapter_id] -= 1
+        if self._pins[adapter_id] == 0:
+            del self._pins[adapter_id]
+
+    def _take_slot(self) -> int:
+        if self._free:
+            return self._free.pop()
+        for victim, slot in self._resident.items():  # LRU first
+            if self._pins.get(victim, 0) == 0:
+                del self._resident[victim]
+                del self._uploaded_version[victim]
+                self.evictions += 1
+                return slot
+        raise AdapterCacheFull(
+            f"all {self.cache_slots} adapter cache slots are pinned "
+            f"by live requests"
+        )
+
+    # -- device writes -------------------------------------------------
+
+    def _upload(self, slot: int, rec: _HostAdapter) -> None:
+        update = {"scale": np.float32(rec.scale)}
+        for t, (d_in, d_out) in self._dims.items():
+            a = np.zeros(
+                (self.cfg.n_layers, d_in, self.max_rank), np.float32
+            )
+            b = np.zeros(
+                (self.cfg.n_layers, self.max_rank, d_out), np.float32
+            )
+            if t in rec.a:
+                a[:, :, : rec.rank] = rec.a[t]
+                b[:, : rec.rank, :] = rec.b[t]
+            update[t + "_a"] = a
+            update[t + "_b"] = b
+        self.bank = _bank_slot_write(self.bank, update, slot)
+        self.uploads += 1
+
+    def rebuild(self, place: Optional[Callable] = None) -> None:
+        """Elastic-resize hook (serving/elastic.py): re-mint the bank
+        under a NEW placement and re-upload every resident adapter
+        into its existing slot — the id->slot map survives, so
+        preempted requests replay against the same indices. Ids
+        unregistered since their upload are dropped (their slots
+        free) rather than served stale."""
+        if place is not None:
+            self._place = place
+        self.bank = self._place(
+            init_adapter_bank(
+                self.cfg, self.cache_slots, self.max_rank, self._dtype
+            )
+        )
+        for adapter_id in list(self._resident):
+            try:
+                rec = self.registry.get(adapter_id)
+            except KeyError:
+                slot = self._resident.pop(adapter_id)
+                self._uploaded_version.pop(adapter_id, None)
+                self._pins.pop(adapter_id, None)
+                self._free.append(slot)
+                continue
+            self._upload(self._resident[adapter_id], rec)
+            self._uploaded_version[adapter_id] = rec.version
+
+    # -- introspection -------------------------------------------------
+
+    def slot_of(self, adapter_id: Optional[str]) -> Optional[int]:
+        if adapter_id is None:
+            return 0
+        return self._resident.get(adapter_id)
+
+    def resident_ids(self):
+        """Most-recently-used last — the replica heartbeat's routing
+        hint payload."""
+        return list(self._resident)
+
+    def pinned_count(self) -> int:
+        return sum(1 for v in self._pins.values() if v > 0)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "slots": self.cache_slots,
+            "resident": len(self._resident),
+            "pinned": self.pinned_count(),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "uploads": self.uploads,
+        }

@@ -172,6 +172,16 @@ def resize(engine, n_chips: int) -> ResizeReport:
             threshold=thresh,
             probe_interval=probe,
         )
+    if engine._adapter_cache is not None:
+        # re-mint the stacked adapter bank under the new placement and
+        # re-upload every resident adapter into its EXISTING slot: the
+        # id->slot map survives, so preempted adaptered requests (whose
+        # pins ride their ledger entries across the resize) replay
+        # against unchanged bank indices.
+        engine._adapter_cache.rebuild(
+            place=engine._adapter_bank_place
+        )
+
     engine._slot_row = [None] * engine.n_slots
 
     # 6. zero the slot mirrors (every slot freed by preemption) and
@@ -181,6 +191,7 @@ def resize(engine, n_chips: int) -> ResizeReport:
     engine.limit[:] = 0
     engine.done[:] = True
     engine.slot_key[:] = 0
+    engine.adapt[:] = 0
     engine._dev = engine._device_state()
     engine._inflight = None
 

@@ -85,6 +85,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dlrover_tpu.models.decode import (
+    _check_adapters,
     _check_positional_capacity,
     _mask_top_k,
     _mask_top_p,
@@ -109,11 +110,13 @@ from dlrover_tpu.models.decode import (
 )
 from dlrover_tpu.parallel.mesh import (
     named,
+    serving_adapter_specs,
     serving_kv_spec,
     serving_mesh,
     serving_mesh_spec,
 )
 from dlrover_tpu.parallel.sharding import replicated, shard_tree
+from dlrover_tpu.serving.adapters import DeviceAdapterCache
 from dlrover_tpu.serving.paged_kv import (
     TRASH_PAGE,
     OutOfPages,
@@ -209,6 +212,12 @@ class _Request:
     # how many of `out` are already folded into `prompt` by earlier
     # preemptions — a second preemption must not re-append them
     folded: int = 0
+    # multi-adapter serving: the registry id this request decodes
+    # under (None = base model) and its resolved device-bank slot.
+    # The slot is PINNED from submit to retire/cancel, so it cannot
+    # be remapped under a live (or preempted) request.
+    adapter_id: Optional[str] = None
+    adapter_slot: int = 0
 
 
 # one step() event: (request idx, tokens emitted this chunk, finished)
@@ -256,8 +265,20 @@ def _kernel_cache_tag() -> tuple:
     return ("forced-kernels",) if fa.force_kernels() else ()
 
 
+def _lora_operand(abank, aidx):
+    """Assemble the `adapters` operand models/decode.py expects from
+    the stacked device bank + a per-row adapter-index vector. Shared
+    by the chunk/spec/admit lora program variants."""
+    return {
+        "bank": {k: v for k, v in abank.items() if k != "scale"},
+        "idx": aidx,
+        "scale": abank["scale"],
+    }
+
+
 def _build_chunk_program(
-    cfg, pad_id, eos_id, temperature, top_k, top_p, mesh=None
+    cfg, pad_id, eos_id, temperature, top_k, top_p, mesh=None,
+    adapters=False,
 ):
     def _warp(logits):
         logits = logits / temperature
@@ -382,11 +403,85 @@ def _build_chunk_program(
         pool = scatter_pool_window(pool, view, table, start, k)
         return pool, tok, pos, done, keys, emitted.T  # [B, k]
 
-    return {"dense": _run_chunk, "paged": _run_chunk_paged}
+    if not adapters:
+        return {"dense": _run_chunk, "paged": _run_chunk_paged}
+
+    # multi-adapter variants: same scan, same _advance, with the
+    # stacked adapter bank + the per-slot adapter-index vector riding
+    # as trailing read-only operands (the bank changes only via
+    # host-side upload scatters, never inside a chunk). Base rows
+    # carry index 0 — the permanent zero adapter — so a mixed batch
+    # is ONE dispatch whatever its adapter composition.
+    @partial(jax.jit, donate_argnums=(0,), static_argnums=(7,))
+    def _run_chunk_lora(
+        cache, params, tok, pos, done, limit, keys, k, abank, aidx
+    ):
+        ad = _lora_operand(abank, aidx)
+
+        def body(carry, _):
+            cache, tok, pos, done, keys = carry
+            logits, cache = decode_step(
+                cfg, params, tok, cache, pos, mesh=mesh, adapters=ad
+            )
+            tok, pos, done, keys, nxt = _advance(
+                logits, tok, pos, done, limit, keys
+            )
+            return (cache, tok, pos, done, keys), nxt
+
+        (cache, tok, pos, done, keys), emitted = jax.lax.scan(
+            body, (cache, tok, pos, done, keys), None, length=k,
+        )
+        return cache, tok, pos, done, keys, emitted.T  # [B, k]
+
+    @partial(jax.jit, donate_argnums=(0,), static_argnums=(8,))
+    def _run_chunk_paged_lora(
+        pool, table, params, tok, pos, done, limit, keys, k,
+        abank, aidx,
+    ):
+        ad = _lora_operand(abank, aidx)
+        table = jnp.where(done[:, None], 0, table)
+        if on_tpu:
+            def body(carry, _):
+                pool, tok, pos, done, keys = carry
+                logits, pool = paged_decode_step(
+                    cfg, params, tok, pool, table, pos, mesh=mesh,
+                    adapters=ad,
+                )
+                tok, pos, done, keys, nxt = _advance(
+                    logits, tok, pos, done, limit, keys
+                )
+                return (pool, tok, pos, done, keys), nxt
+
+            (pool, tok, pos, done, keys), emitted = jax.lax.scan(
+                body, (pool, tok, pos, done, keys), None, length=k,
+            )
+            return pool, tok, pos, done, keys, emitted.T  # [B, k]
+
+        view = gather_pool_view(pool, table)
+        start = pos
+
+        def body(carry, _):
+            cache, tok, pos, done, keys = carry
+            logits, cache = decode_step(
+                cfg, params, tok, cache, pos, mesh=mesh, adapters=ad
+            )
+            tok, pos, done, keys, nxt = _advance(
+                logits, tok, pos, done, limit, keys
+            )
+            return (cache, tok, pos, done, keys), nxt
+
+        (view, tok, pos, done, keys), emitted = jax.lax.scan(
+            body, (view, tok, pos, done, keys), None, length=k,
+        )
+        pool = scatter_pool_window(pool, view, table, start, k)
+        return pool, tok, pos, done, keys, emitted.T  # [B, k]
+
+    return {"dense": _run_chunk_lora, "paged": _run_chunk_paged_lora}
 
 
 def _build_spec_program(
-    cfg, pad_id, eos_id, temperature, top_k, top_p, mesh=None
+    cfg, pad_id, eos_id, temperature, top_k, top_p, mesh=None,
+    adapters=False,
 ):
     """The speculative alternative to the chunk scan: ONE verify
     forward over K+1 positions per slot, acceptance on device, and
@@ -516,10 +611,58 @@ def _build_spec_program(
         )
         return (pool,) + out
 
-    return {"dense": _run_spec, "paged": _run_spec_paged}
+    if not adapters:
+        return {"dense": _run_spec, "paged": _run_spec_paged}
+
+    # multi-adapter verify: identical acceptance; the adapted
+    # projections run inside the SAME verify forward, so a draft is
+    # judged against the adapter the slot decodes under
+    @partial(jax.jit, donate_argnums=(0,))
+    def _run_spec_lora(
+        cache, params, tok, pos, done, limit, keys, drafts,
+        draft_len, abank, aidx,
+    ):
+        ad = _lora_operand(abank, aidx)
+        tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
+        logits, cache = verify_step(
+            cfg, params, tokens, cache, pos, mesh=mesh, adapters=ad
+        )
+        out = _accept(
+            logits, tok, pos, done, limit, keys, drafts, draft_len
+        )
+        return (cache,) + out
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _run_spec_paged_lora(
+        pool, table, params, tok, pos, done, limit, keys, drafts,
+        draft_len, abank, aidx,
+    ):
+        ad = _lora_operand(abank, aidx)
+        tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
+        table = jnp.where(done[:, None], 0, table)
+        if on_tpu:
+            logits, pool = paged_verify_step(
+                cfg, params, tokens, pool, table, pos, mesh=mesh,
+                adapters=ad,
+            )
+        else:
+            view = gather_pool_view(pool, table)
+            logits, view = verify_step(
+                cfg, params, tokens, view, pos, mesh=mesh,
+                adapters=ad,
+            )
+            pool = scatter_pool_window(
+                pool, view, table, pos, tokens.shape[1]
+            )
+        out = _accept(
+            logits, tok, pos, done, limit, keys, drafts, draft_len
+        )
+        return (pool,) + out
+
+    return {"dense": _run_spec_lora, "paged": _run_spec_paged_lora}
 
 
-def _build_admit_programs(cfg, max_len, mesh=None):
+def _build_admit_programs(cfg, max_len, mesh=None, adapters=False):
     """Admission + prefix-pool programs. Each retraces once per
     prompt/suffix BUCKET (log2(max_len) shapes total); slot/row/start
     are traced scalars so no recompile per slot, row, or prefix
@@ -605,7 +748,7 @@ def _build_admit_programs(cfg, max_len, mesh=None):
     def _page_copy_fn(pages, src, dst):
         return pool_copy_page(pages, src, dst)
 
-    return {
+    progs = {
         "admit": _admit_fn,
         "cold": _admit_cold_fn,
         "warm": _admit_warm_fn,
@@ -615,6 +758,43 @@ def _build_admit_programs(cfg, max_len, mesh=None):
         "paged_warm": _paged_warm_fn,
         "page_copy": _page_copy_fn,
     }
+    if not adapters:
+        return progs
+
+    # ---- adaptered admissions ---------------------------------------
+    # An adaptered prompt's K/V must come from the ADAPTED projections
+    # (RoPE is linear, so the pre-rotation delta equals what merged
+    # weights would have rotated), and it bypasses the shared prefix
+    # pool entirely — published prefixes are base-model K/V by
+    # contract, so there is no warm/hit/publish lora variant at all.
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _admit_lora_fn(cache, params, prompt, slot, abank, aslot):
+        ad = _lora_operand(
+            abank, jnp.full((1,), aslot, jnp.int32)
+        )
+        return prefill_into_slot(
+            cfg, params, prompt, cache, slot, mesh=mesh, adapters=ad
+        )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _paged_cold_lora_fn(
+        pages, table, params, prompt, slot, table_row, abank, aslot
+    ):
+        ad = _lora_operand(
+            abank, jnp.full((1,), aslot, jnp.int32)
+        )
+        row = prefill_exact_row(
+            cfg, params, prompt, max_len, mesh=mesh, adapters=ad
+        )
+        pages = paged_install_row(
+            pages, row, table_row, 0, prompt.shape[0]
+        )
+        return pages, table.at[slot].set(table_row), row
+
+    progs["admit_lora"] = _admit_lora_fn
+    progs["paged_cold_lora"] = _paged_cold_lora_fn
+    return progs
 
 
 # ---------------------------------------------------------------------------
@@ -643,6 +823,16 @@ def _state_admit_prog(tok, pos, done, limit, keys,
 @jax.jit
 def _state_cancel_prog(done, slot):
     return done.at[slot].set(True)
+
+
+@jax.jit
+def _state_adapt_prog(adapt, slot, val):
+    """Admission scatter for the per-slot adapter-index vector (only
+    minted when multi-adapter serving is on). Release paths need no
+    scatter: a done row's stale index gathers harmlessly — its
+    output is discarded and its frozen rewrites are dead by the
+    position mask (dense) or trash-routed (paged)."""
+    return adapt.at[slot].set(val)
 
 
 # page-table scatters (kv_layout="paged"): the device table [B, P] is
@@ -740,6 +930,8 @@ class ContinuousBatcher:
         replica_role: str = "colocated",  # | "prefill" | "decode"
         weight_refresh_mode: str = "defer",  # | "live" | "raise"
         weight_refresh_replay: bool = True,  # live mode: replay slots
+        adapter_registry=None,       # serving/adapters.AdapterRegistry
+        adapter_cache_slots: int = 8,  # device adapter bank slots (LRU)
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -914,6 +1106,25 @@ class ContinuousBatcher:
             self.cache = self._shard_bank(
                 init_kv_cache(cfg, n_slots, bank_len, quant=kv_quant)
             )
+        # ---- multi-adapter LoRA serving (serving/adapters.py) -----------
+        # One stacked device bank whose slot 0 is the permanent zero
+        # adapter; every request gathers its slot's A/B slices inside
+        # the SAME compiled programs, so heterogeneous-adapter traffic
+        # batches through one base-model forward. Leaving the registry
+        # unset keeps every structure — _dev, program-cache keys,
+        # admission paths — byte-identical to the adapterless engine.
+        self.adapter_registry = adapter_registry
+        self._adapter_cache = None
+        if adapter_registry is not None:
+            # GPT's fused qkv has no per-target bank — fail at
+            # construction, not from inside a compiled program
+            _check_adapters(cfg, adapter_registry)
+            self._adapter_cache = DeviceAdapterCache(
+                cfg,
+                adapter_registry,
+                adapter_cache_slots,
+                place=self._adapter_bank_place,
+            )
         # host MIRRORS of the slot state (tiny [B] vectors). The truth
         # lives on device in self._dev; these track it so admission
         # and scheduler decisions (_next_chunk_len, free_slots,
@@ -924,6 +1135,9 @@ class ContinuousBatcher:
         self.pos = np.zeros(n_slots, np.int32)
         self.limit = np.zeros(n_slots, np.int32)
         self.done = np.ones(n_slots, bool)   # all free initially
+        # per-slot adapter-bank index (0 = the zero adapter); joins
+        # the device state only when multi-adapter serving is on
+        self.adapt = np.zeros(n_slots, np.int32)
         self.async_depth = async_depth
         self._dev = self._device_state()
         # the one dispatched-but-unharvested device step (async mode)
@@ -1013,12 +1227,13 @@ class ContinuousBatcher:
         cfg = self.cfg
         temperature, top_k, top_p = self._sampling
         version = self._weight_version
+        lora_on = self._adapter_cache is not None
         self._bound_keys = []
         if self.spec is not None:
             key = (
                 (cfg, self.pad_id, self.eos_id, temperature, top_k,
                  top_p, self.spec_draft_len, self.mesh, version)
-                + _kernel_cache_tag()
+                + _kernel_cache_tag() + self._adapter_tag()
             )
             self._bound_keys.append((_SPEC_PROGRAMS, key))
             self._run_spec = _cached_program(
@@ -1027,12 +1242,13 @@ class ContinuousBatcher:
                 key,
                 lambda: _build_spec_program(
                     cfg, self.pad_id, self.eos_id, temperature,
-                    top_k, top_p, mesh=self.mesh,
+                    top_k, top_p, mesh=self.mesh, adapters=lora_on,
                 ),
             )[self.kv_layout]
         key = (
             (cfg, self.pad_id, self.eos_id, temperature, top_k, top_p,
-             self.mesh, version) + _kernel_cache_tag()
+             self.mesh, version)
+            + _kernel_cache_tag() + self._adapter_tag()
         )
         self._bound_keys.append((_CHUNK_PROGRAMS, key))
         self._run_chunk = _cached_program(
@@ -1041,12 +1257,12 @@ class ContinuousBatcher:
             key,
             lambda: _build_chunk_program(
                 cfg, self.pad_id, self.eos_id, temperature, top_k,
-                top_p, mesh=self.mesh,
+                top_p, mesh=self.mesh, adapters=lora_on,
             ),
         )[self.kv_layout]
         key = (
             (cfg, self.max_len, self.mesh, version)
-            + _kernel_cache_tag()
+            + _kernel_cache_tag() + self._adapter_tag()
         )
         self._bound_keys.append((_ADMIT_PROGRAMS, key))
         admit = _cached_program(
@@ -1054,7 +1270,7 @@ class ContinuousBatcher:
             # graftlint: allow(JIT-003) reason=hashable tuple literal assigned above and recorded in _bound_keys so a weight refresh can retire the prior version's entries
             key,
             lambda: _build_admit_programs(
-                cfg, self.max_len, mesh=self.mesh
+                cfg, self.max_len, mesh=self.mesh, adapters=lora_on
             ),
         )
         self._admit_fn = admit["admit"]
@@ -1065,6 +1281,28 @@ class ContinuousBatcher:
         self._paged_cold_fn = admit["paged_cold"]
         self._paged_warm_fn = admit["paged_warm"]
         self._page_copy_fn = admit["page_copy"]
+        self._admit_lora_fn = admit.get("admit_lora")
+        self._paged_cold_lora_fn = admit.get("paged_cold_lora")
+
+    def _adapter_tag(self) -> tuple:
+        """Program-cache key component for multi-adapter serving: the
+        bank's static shape signature (slot count and max rank change
+        every traced program). Empty when adapters are off, so
+        adapterless keys stay byte-identical to pre-adapter builds —
+        and keep sharing their cached programs."""
+        if self._adapter_cache is None:
+            return ()
+        c = self._adapter_cache
+        return ("adapters", c.cache_slots, c.max_rank)
+
+    def _adapter_args(self) -> tuple:
+        """Trailing operands for the lora program variants: (stacked
+        device bank, per-slot adapter-index vector). Empty when
+        multi-adapter serving is off — the base programs take no such
+        operands."""
+        if self._adapter_cache is None:
+            return ()
+        return (self._adapter_cache.bank, self._dev["adapt"])
 
     def _probe_kernel_path(self) -> None:
         """Which attention body the per-token decode step traced into
@@ -1108,19 +1346,39 @@ class ContinuousBatcher:
             params, self.mesh, _serving_param_shardings()
         )
 
-    def _shard_bank(self, bank):
+    def _shard_bank(self, bank, specs=None):
         """Place a KV bank (dense slot bank, paged page pool, or the
         exact prefix pool — dicts of [L, rows, cells, KV, hd] arrays;
         int8 scales ride along with hd==1) with the KV head axis
-        sharded and every host-planned axis replicated. Identity
-        without a mesh."""
+        sharded and every host-planned axis replicated. `specs` (a
+        name -> PartitionSpec dict) overrides the per-array placement
+        — the stacked adapter bank's column split rides through here
+        so device_put stays inside ELASTIC-001's designated helpers.
+        Identity without a mesh."""
         if self.mesh is None or bank is None:
             return bank
-        sharding = named(self.mesh, serving_kv_spec())
+        if specs is None:
+            sharding = named(self.mesh, serving_kv_spec())
+            return {
+                name: jax.device_put(arr, sharding)
+                for name, arr in bank.items()
+            }
         return {
-            name: jax.device_put(arr, sharding)
+            name: jax.device_put(arr, named(self.mesh, specs[name]))
             for name, arr in bank.items()
         }
+
+    def _adapter_bank_place(self, bank):
+        """DeviceAdapterCache placement callback: B banks of the
+        sharded projections split their output columns on "tp" like
+        the base weights (so the per-row delta lands on already-local
+        columns — zero extra collectives); A banks, the wo pair and
+        the scale vector replicate. Identity without a mesh."""
+        if self.mesh is None:
+            return bank
+        return self._shard_bank(
+            bank, specs=serving_adapter_specs(self.mesh)
+        )
 
     def _replicate(self, x):
         """Replicated placement for host-planned device state (slot
@@ -1145,13 +1403,18 @@ class ContinuousBatcher:
         """Upload the host mirrors once; from here on the device
         copies advance through the chunk/spec programs and the
         scatter programs — never by per-dispatch re-upload."""
-        return {
+        state = {
             "tok": self._replicate(jnp.asarray(self.tok)),
             "pos": self._replicate(jnp.asarray(self.pos)),
             "done": self._replicate(jnp.asarray(self.done)),
             "limit": self._replicate(jnp.asarray(self.limit)),
             "keys": self._replicate(jnp.asarray(self.slot_key)),
         }
+        if self._adapter_cache is not None:
+            # joins the resident state ONLY when adapters are on: the
+            # adapterless _dev keeps its exact pre-adapter structure
+            state["adapt"] = self._replicate(jnp.asarray(self.adapt))
+        return state
 
     def _next_chunk_len(self) -> int:
         """Dispatch size: `chunk` steps, shortened only when EVERY
@@ -1375,6 +1638,7 @@ class ContinuousBatcher:
         prompt: Sequence[int],
         max_new: Optional[int] = None,
         prng_key: Optional[np.ndarray] = None,
+        adapter_id: Optional[str] = None,
     ) -> int:
         """Queue one request; returns its index in the output list.
         `max_new` caps THIS request's generation (vLLM-style
@@ -1398,6 +1662,20 @@ class ContinuousBatcher:
                 f"prompt length {arr.size} leaves no room to generate "
                 f"(max_len {self.max_len})"
             )
+        if adapter_id is not None and self._adapter_cache is None:
+            raise ValueError(
+                "adapter_id requires an engine constructed with "
+                "adapter_registry=... (multi-adapter serving is off)"
+            )
+        aslot = 0
+        if self._adapter_cache is not None and adapter_id is not None:
+            # resolve + PIN the device slot for the request's whole
+            # ledger life (released at retire/cancel; preemption keeps
+            # it — a replay must land on the same bank index). Raises
+            # KeyError for an unregistered id and AdapterCacheFull
+            # when every slot is pinned, both BEFORE the request
+            # enters the ledger, so a refused submit leaks nothing.
+            aslot = self._adapter_cache.acquire(adapter_id)
         req = _Request(
             idx=self._next_idx, prompt=arr, max_new=max_new or 0,
             prng_key=(
@@ -1405,6 +1683,7 @@ class ContinuousBatcher:
                 if prng_key is None
                 else np.asarray(prng_key, np.uint32).reshape(2)
             ),
+            adapter_id=adapter_id, adapter_slot=aslot,
         )
         self._next_idx += 1
         self._requests[req.idx] = req
@@ -1446,6 +1725,20 @@ class ContinuousBatcher:
                 req.preempted = False
                 self._swap_resumes += 1
             self._admit_paged(slot, req, p)
+        elif req.adapter_id is not None:
+            # adaptered admission: the prompt K/V must come from the
+            # ADAPTED projections, and it never installs from (or
+            # publishes into) the shared prefix pool — published
+            # prefixes are base-model K/V by contract
+            bucket = min(_pad_bucket(p), self.max_len)
+            self.cache = self._admit_lora_fn(
+                self.cache,
+                self.params,
+                jnp.asarray(self._pad_to(req.prompt, bucket)),
+                slot,
+                self._adapter_cache.bank,
+                req.adapter_slot,
+            )
         elif self.prefix_cache is None:
             bucket = min(_pad_bucket(p), self.max_len)
             self.cache = self._admit_fn(
@@ -1480,6 +1773,11 @@ class ContinuousBatcher:
                 int(self.limit[slot]), self.slot_key[slot],
             )
         )
+        if self._adapter_cache is not None:
+            self.adapt[slot] = req.adapter_slot
+            d["adapt"] = _state_adapt_prog(
+                d["adapt"], slot, int(req.adapter_slot)
+            )
         self.slot_req[slot] = req
         if self.spec is not None:
             self.spec.begin_slot(slot, req.prompt)
@@ -1583,9 +1881,13 @@ class ContinuousBatcher:
         Pool pressure is resolved inline: evict unreferenced prefix
         runs, then preempt-and-swap the coldest live request."""
         pc = self.prefix_cache
+        # adaptered requests bypass the prefix cache both ways: a
+        # published prefix holds base-model K/V (wrong bytes for this
+        # adapter), and this adapter's K/V must never publish
+        lora = req.adapter_id is not None
         n_need = self._request_pages(req)
         matched, row, start = 0, None, 0
-        if pc is not None:
+        if pc is not None and not lora:
             matched, row = pc.match(req.prompt)
             start = min(matched, p)
             while (
@@ -1646,6 +1948,20 @@ class ContinuousBatcher:
                 start,
             )
             pc.record_admission(start)
+        elif lora:
+            bucket = min(_pad_bucket(p), self.max_len)
+            # adapted prefill; `work` stays None — the exact row this
+            # program returns must never publish into the shared pool
+            self.page_pool, self._table, _ = self._paged_cold_lora_fn(
+                self.page_pool,
+                self._table,
+                self.params,
+                self._pad_to(req.prompt, bucket),
+                slot,
+                vals,
+                self._adapter_cache.bank,
+                req.adapter_slot,
+            )
         else:
             bucket = min(_pad_bucket(p), self.max_len)
             self.page_pool, self._table, work = self._paged_cold_fn(
@@ -1827,6 +2143,44 @@ class ContinuousBatcher:
         s["swap_resumes"] = float(self._swap_resumes)
         return s
 
+    def adapter_stats(self) -> Dict[str, float]:
+        """Adapter-serving telemetry for ServingMetrics / the gateway:
+        registry size, device-bank residency, hit/miss/eviction/upload
+        counters, and live adaptered requests. {} when multi-adapter
+        serving is off."""
+        if self._adapter_cache is None:
+            return {}
+        s = {
+            k: float(v)
+            for k, v in self._adapter_cache.stats().items()
+        }
+        s["registered"] = float(len(self.adapter_registry))
+        s["active_requests"] = float(
+            sum(
+                1
+                for r in self._requests.values()
+                if r.adapter_id is not None
+            )
+        )
+        return s
+
+    def adapter_active(self) -> Dict[str, int]:
+        """Ledger-live (queued, in-slot, or finished-unretired)
+        request count per adapter id — the gateway's per-adapter
+        active block."""
+        out: Dict[str, int] = {}
+        for r in self._requests.values():
+            if r.adapter_id is not None:
+                out[r.adapter_id] = out.get(r.adapter_id, 0) + 1
+        return out
+
+    def adapter_residency(self) -> List[str]:
+        """Adapter ids resident in the device bank (MRU last) — the
+        replica heartbeat's routing hint; [] when adapters are off."""
+        if self._adapter_cache is None:
+            return []
+        return self._adapter_cache.resident_ids()
+
     # -- the loop ----------------------------------------------------------
 
     def has_work(self) -> bool:
@@ -1939,18 +2293,19 @@ class ContinuousBatcher:
     def _dispatch_chunk(self) -> None:
         d = self._dev
         k = self._next_chunk_len()
+        lora = self._adapter_args()
         if self._paged:
             pool, tok, pos, done, keys, emitted = self._run_chunk(
                 self.page_pool, self._table, self.params,
                 d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
-                k,
+                k, *lora,
             )
             self.page_pool = pool
         else:
             cache, tok, pos, done, keys, emitted = self._run_chunk(
                 self.cache, self.params,
                 d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
-                k,
+                k, *lora,
             )
             self.cache = cache
         d.update(tok=tok, pos=pos, done=done, keys=keys)
@@ -1979,13 +2334,14 @@ class ContinuousBatcher:
         self, drafts: np.ndarray, dlens: np.ndarray
     ) -> None:
         d = self._dev
+        lora = self._adapter_args()
         if self._paged:
             (
                 pool, tok, pos, done, keys, emitted, n_emit, accepted
             ) = self._run_spec(
                 self.page_pool, self._table, self.params,
                 d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
-                jnp.asarray(drafts), jnp.asarray(dlens),
+                jnp.asarray(drafts), jnp.asarray(dlens), *lora,
             )
             self.page_pool = pool
         else:
@@ -1994,7 +2350,7 @@ class ContinuousBatcher:
             ) = self._run_spec(
                 self.cache, self.params,
                 d["tok"], d["pos"], d["done"], d["limit"], d["keys"],
-                jnp.asarray(drafts), jnp.asarray(dlens),
+                jnp.asarray(drafts), jnp.asarray(dlens), *lora,
             )
             self.cache = cache
         d.update(tok=tok, pos=pos, done=done, keys=keys)
@@ -2128,6 +2484,11 @@ class ContinuousBatcher:
             self._prefill_ready.remove(req)
         except ValueError:
             pass
+        if req.adapter_id is not None:
+            # unpin the adapter slot with the ledger entry: residency
+            # survives (that is the cache), the slot just becomes
+            # evictable once no other request references it
+            self._adapter_cache.release(req.adapter_id)
         return np.asarray(req.out, np.int32)
 
     def take_prefilled(self) -> List[_Request]:
@@ -2173,6 +2534,8 @@ class ContinuousBatcher:
                 if self.prefix_cache is not None:
                     self._release_slot_row(slot)
                 break
+        if req.adapter_id is not None:
+            self._adapter_cache.release(req.adapter_id)
 
     def live_request_keys(self) -> Dict[int, np.ndarray]:
         """idx -> current per-slot PRNG key for every live request —
@@ -2226,6 +2589,16 @@ class ContinuousBatcher:
         self.limit[:] = 0
         self.done[:] = True
         self.slot_key[:] = 0
+        self.adapt[:] = 0
+        if self._adapter_cache is not None:
+            # drop every ledger pin (the ledger itself is dropped
+            # below) and re-mint the bank: a crash mid-upload leaves
+            # the donated bank as untrustworthy as the KV banks.
+            # rebuild() re-uploads residents from the host registry.
+            for req in self._requests.values():
+                if req.adapter_id is not None:
+                    self._adapter_cache.release(req.adapter_id)
+            self._adapter_cache.rebuild()
         # fresh device copies too — the crash may have struck with a
         # dispatch in flight; its outputs (and the in-flight record)
         # must never leak into the restarted engine
@@ -2274,10 +2647,12 @@ class ContinuousBatcher:
         # drain complete: drop the request ledger, or a long-lived
         # engine (e.g. one PPO trainer across 100k rollouts) retains
         # every prompt + output list ever served and leaks host RAM
-        out = [
-            np.asarray(self._requests.pop(i).out, np.int32)
-            for i in self._pending
-        ]
+        out = []
+        for i in self._pending:
+            req = self._requests.pop(i)
+            if req.adapter_id is not None:
+                self._adapter_cache.release(req.adapter_id)
+            out.append(np.asarray(req.out, np.int32))
         self._pending = {}
         return out
 

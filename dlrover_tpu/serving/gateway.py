@@ -41,7 +41,7 @@ from dlrover_tpu.serving.scheduler import (
 )
 
 _GENERATE_FIELDS = frozenset(
-    {"tokens", "max_new", "deadline_s", "stream"}
+    {"tokens", "max_new", "deadline_s", "stream", "adapter_id"}
 )
 
 
@@ -79,6 +79,11 @@ def _validate_generate(payload) -> Optional[str]:
     stream = payload.get("stream")
     if stream is not None and not isinstance(stream, bool):
         return "'stream' must be a bool"
+    adapter_id = payload.get("adapter_id")
+    if adapter_id is not None and (
+        not isinstance(adapter_id, str) or not adapter_id
+    ):
+        return "'adapter_id' must be a non-empty string"
     return None
 
 
@@ -158,11 +163,29 @@ class ServingGateway:
                 if reason is not None:
                     self._json(400, {"error": reason})
                     return
+                adapter_id = payload.get("adapter_id")
+                if adapter_id is not None and not gw._adapter_known(
+                    adapter_id
+                ):
+                    # a typo'd adapter id is a CLIENT error, caught at
+                    # the door — not a 500 from deep in the engine and
+                    # not a 429 the client would uselessly retry
+                    self._json(
+                        400,
+                        {"error": f"unknown adapter {adapter_id!r}"},
+                    )
+                    return
+                kw = (
+                    {}
+                    if adapter_id is None
+                    else {"adapter_id": adapter_id}
+                )
                 try:
                     req = gw.backend.submit(
                         payload["tokens"],
                         max_new=payload.get("max_new"),
                         deadline_s=payload.get("deadline_s"),
+                        **kw,
                     )
                 except NoHealthyReplicasError as e:
                     # availability, not backpressure: retrying the
@@ -307,6 +330,17 @@ class ServingGateway:
         health_fn = getattr(engine, "device_health", None)
         if callable(health_fn):
             out["device_health"] = health_fn()
+        # multi-adapter serving: registry size, device-cache traffic,
+        # and per-adapter live request counts (single-scheduler
+        # scoping like the blocks above; {} engines are elided)
+        astats = getattr(engine, "adapter_stats", None)
+        if callable(astats):
+            a = astats()
+            if a:
+                out["adapters"] = a
+                active = getattr(engine, "adapter_active", None)
+                if callable(active):
+                    out["adapters"]["active"] = active()
         return out
 
     def _prefix_cache(self):
@@ -328,6 +362,25 @@ class ServingGateway:
         engine = getattr(self.backend, "engine", None)
         stats = getattr(engine, "paged_stats", None)
         return stats() if callable(stats) else {}
+
+    def _adapter_known(self, adapter_id: str) -> bool:
+        """Whether ANY engine behind this gateway can serve
+        `adapter_id`: the single scheduler's registry, or — pool
+        backend — any replica's. No registry anywhere means
+        multi-adapter serving is off and every adapter id is
+        unknown."""
+        engines = []
+        eng = getattr(self.backend, "engine", None)
+        if eng is not None:
+            engines.append(eng)
+        reps = getattr(self.backend, "replicas", None)
+        if callable(reps):
+            engines.extend(r.scheduler.engine for r in reps())
+        for e in engines:
+            reg = getattr(e, "adapter_registry", None)
+            if reg is not None and adapter_id in reg:
+                return True
+        return False
 
     @property
     def port(self) -> int:

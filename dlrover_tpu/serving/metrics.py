@@ -104,6 +104,15 @@ class ServingMetrics:
             "_resize_downtime_ms",
             "_weight_version",
             "_replica_degradations",
+            "_adapter_hits",
+            "_adapter_misses",
+            "_adapter_evictions",
+            "_adapter_uploads",
+            "_adapter_registered",
+            "_adapter_resident",
+            "_adapter_pinned",
+            "_adapter_slots",
+            "_adapter_active",
         }
     )
 
@@ -192,6 +201,19 @@ class ServingMetrics:
         self._resize_downtime_ms = 0.0
         self._weight_version = 0
         self._replica_degradations = 0
+        # multi-adapter serving: device-bank cache traffic (counters,
+        # copied from the engine's adapter_stats() each pump with the
+        # usual max() monotonic guard) and registry/residency gauges.
+        # All zero when multi-adapter serving is off.
+        self._adapter_hits = 0
+        self._adapter_misses = 0
+        self._adapter_evictions = 0
+        self._adapter_uploads = 0
+        self._adapter_registered = 0
+        self._adapter_resident = 0
+        self._adapter_pinned = 0
+        self._adapter_slots = 0
+        self._adapter_active = 0
 
     # ---- ingestion -------------------------------------------------------
 
@@ -393,6 +415,33 @@ class ServingMetrics:
             )
             self._weight_version = int(
                 stats.get("weight_version", self._weight_version)
+            )
+
+    def update_adapters(self, stats: Dict[str, float]):
+        """Refresh multi-adapter serving telemetry from the engine's
+        adapter_stats(). Cache traffic totals get the same max()
+        monotonic guard as the blocks above; registry size, residency,
+        pins, and live adaptered requests are gauges."""
+        with self._lock:
+            self._adapter_hits = max(
+                self._adapter_hits, int(stats.get("hits", 0))
+            )
+            self._adapter_misses = max(
+                self._adapter_misses, int(stats.get("misses", 0))
+            )
+            self._adapter_evictions = max(
+                self._adapter_evictions,
+                int(stats.get("evictions", 0)),
+            )
+            self._adapter_uploads = max(
+                self._adapter_uploads, int(stats.get("uploads", 0))
+            )
+            self._adapter_registered = int(stats.get("registered", 0))
+            self._adapter_resident = int(stats.get("resident", 0))
+            self._adapter_pinned = int(stats.get("pinned", 0))
+            self._adapter_slots = int(stats.get("slots", 0))
+            self._adapter_active = int(
+                stats.get("active_requests", 0)
             )
 
     def update_kernel_path(self, path: str, steps: int):
@@ -601,6 +650,32 @@ class ServingMetrics:
     def replica_degradations(self) -> int:
         with self._lock:
             return self._replica_degradations
+
+    @property
+    def adapter_hits(self) -> int:
+        with self._lock:
+            return self._adapter_hits
+
+    @property
+    def adapter_misses(self) -> int:
+        with self._lock:
+            return self._adapter_misses
+
+    @property
+    def adapter_evictions(self) -> int:
+        with self._lock:
+            return self._adapter_evictions
+
+    @property
+    def adapter_registered(self) -> int:
+        with self._lock:
+            return self._adapter_registered
+
+    @property
+    def adapter_hit_rate(self) -> float:
+        with self._lock:
+            looked = self._adapter_hits + self._adapter_misses
+            return self._adapter_hits / looked if looked else 0.0
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -927,6 +1002,51 @@ class ServingMetrics:
                 "Replicas that entered the degraded (shrunk-but-"
                 "alive) state.",
                 self._replica_degradations,
+            )
+            gauge(
+                "serving_adapters_registered",
+                "LoRA adapters in the registry.",
+                self._adapter_registered,
+            )
+            gauge(
+                "serving_adapter_bank_resident",
+                "LoRA adapters resident in the device bank.",
+                self._adapter_resident,
+            )
+            gauge(
+                "serving_adapter_bank_pinned",
+                "Resident adapters pinned by live requests.",
+                self._adapter_pinned,
+            )
+            gauge(
+                "serving_adapter_bank_slots",
+                "Device adapter-bank cache slots.",
+                self._adapter_slots,
+            )
+            gauge(
+                "serving_adapter_active_requests",
+                "Live requests decoding through an adapter.",
+                self._adapter_active,
+            )
+            counter(
+                "serving_adapter_cache_hits_total",
+                "Adapter admissions served from the device bank.",
+                self._adapter_hits,
+            )
+            counter(
+                "serving_adapter_cache_misses_total",
+                "Adapter admissions that required an upload.",
+                self._adapter_misses,
+            )
+            counter(
+                "serving_adapter_cache_evictions_total",
+                "Adapter bank slots recycled (LRU).",
+                self._adapter_evictions,
+            )
+            counter(
+                "serving_adapter_uploads_total",
+                "Host-to-device adapter weight uploads.",
+                self._adapter_uploads,
             )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()

@@ -158,8 +158,29 @@ class InferenceReplica:
                 "n_chips": int(getattr(eng, "n_chips", 1)),
                 "role": self.role,
                 "degraded": self.degraded,
+                # LoRA adapters resident in this replica's device bank
+                # (MRU-last) — the pool's routing prefers a replica
+                # that already holds the request's adapter, turning
+                # cache affinity into a placement signal instead of an
+                # upload on every cross-replica bounce
+                "adapters_resident": self.adapters_resident(),
             }
         ).encode()
+
+    def adapters_resident(self) -> List[str]:
+        """Adapter ids currently uploaded to this replica's device
+        bank (MRU-last); [] when multi-adapter serving is off or the
+        engine predates it (test doubles)."""
+        res = getattr(
+            self.scheduler.engine, "adapter_residency", None
+        )
+        if res is None:
+            return []
+        try:
+            return list(res())
+        # graftlint: allow(EXC-001) reason=residency is a routing hint only; a raising engine is caught by the health probe, not here
+        except Exception:  # noqa: BLE001
+            return []
 
     # ---- health ----------------------------------------------------------
 
@@ -326,6 +347,7 @@ class ReplicaPool:
         prompt: Sequence[int],
         max_new: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        adapter_id: Optional[str] = None,
     ) -> ServeRequest:
         """Least-loaded routing with failover: try healthy replicas in
         load order until one admits. Phase-aware: new requests start
@@ -333,7 +355,11 @@ class ReplicaPool:
         (decode-role replicas only receive work through the handoff
         coordinator); with no prefill replica in the pool, colocated
         ones serve as always, and decode-role replicas are the last
-        resort (they CAN serve end-to-end — better than a 503)."""
+        resort (they CAN serve end-to-end — better than a 503).
+        Adapter-aware: within each phase tier, replicas whose device
+        bank already holds `adapter_id` are tried first — residency
+        beats raw load because a hit skips the host->device upload and
+        spares a possible eviction on the colder replica."""
         ranked = sorted(
             self.healthy_replicas(), key=lambda r: r.load()
         )
@@ -342,17 +368,24 @@ class ReplicaPool:
             or [r for r in ranked if r.role == "colocated"]
             or ranked
         )
+        if adapter_id is not None and len(candidates) > 1:
+            candidates = sorted(
+                candidates,
+                key=lambda r: adapter_id not in r.adapters_resident(),
+            )  # stable: load order preserved within each half
         if not candidates:
             # nothing can serve: record a scale-up hint (force bypasses
             # the cooldown — an empty pool is exactly the emergency the
             # rate limit must not suppress) before failing the request
             self.scale_hint(force=True)
             raise NoHealthyReplicasError("no healthy replicas")
+        kw = {} if adapter_id is None else {"adapter_id": adapter_id}
         last_err: Optional[AdmissionError] = None
         for rep in candidates:
             try:
                 return rep.scheduler.submit(
-                    prompt, max_new=max_new, deadline_s=deadline_s
+                    prompt, max_new=max_new, deadline_s=deadline_s,
+                    **kw,
                 )
             except AdmissionError as e:
                 last_err = e

@@ -36,6 +36,7 @@ import numpy as np
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving import handoff as handoff_mod
+from dlrover_tpu.serving.adapters import AdapterCacheFull
 from dlrover_tpu.serving.chaos import ChipLost
 from dlrover_tpu.serving.engine import ContinuousBatcher
 from dlrover_tpu.serving.failover import RequestJournal, ResumeTicket
@@ -70,6 +71,11 @@ class SloConfig:
     # queue-pressure thresholds driving replica scale hints
     pressure_high: float = 0.75
     pressure_low: float = 0.25
+    # per-tenant admission quota: live (waiting + running) requests
+    # one adapter id may hold before a 429 (0 = unlimited). Keeps a
+    # single chatty tenant from pinning every engine slot while other
+    # adapters starve in the queue.
+    max_active_per_adapter: int = 0
 
 
 class ServeRequest:
@@ -83,12 +89,17 @@ class ServeRequest:
         max_new: int,
         deadline: float,
         submit_ts: float,
+        adapter_id: Optional[str] = None,
     ):
         self.id = req_id
         self.prompt = prompt
         self.max_new = max_new
         self.deadline = deadline
         self.submit_ts = submit_ts
+        # LoRA adapter this request decodes through (None = base
+        # model). Carried across failover/readmit: replay must hit the
+        # same adapter weights to stay byte-identical.
+        self.adapter_id = adapter_id
         self.state = RequestState.QUEUED
         self.tokens: List[int] = []
         self.first_token_ts: Optional[float] = None
@@ -173,6 +184,7 @@ class RequestScheduler:
             "_running",
             "_seq",
             "_next_id",
+            "_adapter_rank",
             "crashed",
             "journal",
         }
@@ -200,17 +212,26 @@ class RequestScheduler:
         self._clock = clock
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
-        # EDF heap of (deadline, prompt_len, seq, request). First
-        # tiebreak is shortest-prompt-first: among equal deadlines a
-        # long prefill must not convoy short ones behind it (the
-        # prefill-phase analog of SJF). Final tiebreak is a
-        # scheduler-local sequence, NOT req.id: a failover-readmitted
-        # request carries its id from ANOTHER scheduler, and a
-        # collision would fall through to comparing ServeRequests.
+        # EDF heap of (deadline, prompt_len, adapter_rank, seq,
+        # request). First tiebreak is shortest-prompt-first: among
+        # equal deadlines a long prefill must not convoy short ones
+        # behind it (the prefill-phase analog of SJF). Second is the
+        # adapter's first-seen ordinal — see _adapter_rank_of. Final
+        # tiebreak is a scheduler-local sequence, NOT req.id: a
+        # failover-readmitted request carries its id from ANOTHER
+        # scheduler, and a collision would fall through to comparing
+        # ServeRequests.
         self._waiting: List[Any] = []
         self._seq = 0
         self._running: Dict[int, ServeRequest] = {}  # engine idx -> req
         self._next_id = 0
+        # adapter-aware EDF tiebreak: a stable first-seen ordinal per
+        # adapter id (base traffic = 0) slotted between prompt_len and
+        # seq, so among equal deadlines same-adapter requests admit
+        # adjacently — they share bank slots and cache pins, and
+        # co-scheduling them keeps the device adapter cache from
+        # ping-ponging under oversubscription.
+        self._adapter_rank: Dict[str, int] = {}
         # crash handling: the journal holds per-request resume keys;
         # `on_failure(scheduler, tickets, exc)` — wired to the pool's
         # FailoverManager — re-homes in-flight work when the engine
@@ -230,11 +251,39 @@ class RequestScheduler:
 
     # ---- admission -------------------------------------------------------
 
+    def _adapter_rank_of_locked(self, adapter_id: Optional[str]) -> int:
+        """First-seen ordinal for the EDF tiebreak (caller holds the
+        lock). Base traffic sorts first (0) so adapterless requests
+        never wait behind adapter-bank churn."""
+        if adapter_id is None:
+            return 0
+        return self._adapter_rank.setdefault(
+            adapter_id, len(self._adapter_rank) + 1
+        )
+
+    def _adapter_load_locked(self, adapter_id: str) -> int:
+        """Live (queued + running) requests held by one adapter id.
+        Caller holds the lock."""
+        n = sum(
+            1
+            for _, _, _, _, r in self._waiting
+            if (
+                r.state is RequestState.QUEUED
+                and r.adapter_id == adapter_id
+            )
+        )
+        return n + sum(
+            1
+            for r in self._running.values()
+            if r.adapter_id == adapter_id
+        )
+
     def submit(
         self,
         prompt: Sequence[int],
         max_new: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        adapter_id: Optional[str] = None,
     ) -> ServeRequest:
         """Admit one request or raise AdmissionError. Returns the
         handle whose `stream` yields token chunks as they decode."""
@@ -272,6 +321,23 @@ class RequestScheduler:
                     f"prompt length {arr.size} leaves no room to "
                     f"generate (max_len {self.engine.max_len})"
                 )
+            if adapter_id is not None:
+                reg = getattr(self.engine, "adapter_registry", None)
+                if reg is None or adapter_id not in reg:
+                    self.metrics.request_rejected()
+                    raise AdmissionError(
+                        f"unknown adapter {adapter_id!r}"
+                    )
+                quota = slo.max_active_per_adapter
+                if (
+                    quota > 0
+                    and self._adapter_load_locked(adapter_id) >= quota
+                ):
+                    self.metrics.request_rejected()
+                    raise AdmissionError(
+                        f"adapter {adapter_id!r} at its per-tenant "
+                        f"quota ({quota} active)"
+                    )
             now = self._clock()
             req = ServeRequest(
                 req_id=self._next_id,
@@ -279,12 +345,19 @@ class RequestScheduler:
                 max_new=want,
                 deadline=now + (deadline_s or slo.default_deadline_s),
                 submit_ts=now,
+                adapter_id=adapter_id,
             )
             self._next_id += 1
             req.scheduler = self
             heapq.heappush(
                 self._waiting,
-                (req.deadline, int(arr.size), self._seq, req),
+                (
+                    req.deadline,
+                    int(arr.size),
+                    self._adapter_rank_of_locked(adapter_id),
+                    self._seq,
+                    req,
+                ),
             )
             self._seq += 1
             self.metrics.request_submitted()
@@ -320,7 +393,7 @@ class RequestScheduler:
         or at admission (lazy removal) — just drop them. Caller holds
         self._cond (the _locked convention)."""
         while self._waiting:
-            deadline, _, _, req = self._waiting[0]
+            deadline, _, _, _, req = self._waiting[0]
             if req.state is not RequestState.QUEUED:
                 heapq.heappop(self._waiting)
                 continue
@@ -377,7 +450,7 @@ class RequestScheduler:
                         )
                     ):
                         break
-                    _, _, _, req = heapq.heappop(self._waiting)
+                    _, _, _, _, req = heapq.heappop(self._waiting)
                     if req.state is not RequestState.QUEUED:
                         continue  # cancelled while waiting
                     pkg, req.handoff_pkg = req.handoff_pkg, None
@@ -389,11 +462,43 @@ class RequestScheduler:
                         idx = self.engine.submit_adopted(pkg)
                     else:
                         prompt, remaining = req.engine_spec()
-                        idx = self.engine.submit(
-                            prompt,
-                            max_new=remaining,
-                            prng_key=req.prng_key,
-                        )
+                        kw = {}
+                        if req.adapter_id is not None:
+                            kw["adapter_id"] = req.adapter_id
+                        try:
+                            idx = self.engine.submit(
+                                prompt,
+                                max_new=remaining,
+                                prng_key=req.prng_key,
+                                **kw,
+                            )
+                        except AdapterCacheFull:
+                            # every bank slot is pinned by requests
+                            # already decoding: put the request back
+                            # and stop admitting — a retire this chunk
+                            # releases a pin and the next pump retries
+                            heapq.heappush(
+                                self._waiting,
+                                (
+                                    req.deadline,
+                                    int(prompt.size),
+                                    self._adapter_rank_of_locked(
+                                        req.adapter_id
+                                    ),
+                                    self._seq,
+                                    req,
+                                ),
+                            )
+                            self._seq += 1
+                            break
+                        except KeyError:
+                            # unregistered between admission and
+                            # dispatch: fail this request, keep the
+                            # replica alive
+                            req._end(RequestState.FAILED, now)
+                            self.metrics.request_failed()
+                            self.journal.close(req)
+                            continue
                     req.state = RequestState.RUNNING
                     self._running[idx] = req
                     self.journal.open(req)
@@ -523,6 +628,11 @@ class RequestScheduler:
             es = getattr(self.engine, "elastic_stats", None)
             if es is not None:
                 self.metrics.update_elastic(es())
+            astats = getattr(self.engine, "adapter_stats", None)
+            if astats is not None:
+                a = astats()
+                if a:
+                    self.metrics.update_adapters(a)
             busy = bool(self._waiting) or bool(self._running)
         for req, ticket, pkg in migrations:
             self._dispatch_handoff(req, ticket, pkg)
@@ -625,7 +735,7 @@ class RequestScheduler:
             tickets.append(self.journal.snapshot(req))
         self._running.clear()
         while self._waiting:
-            _, _, _, req = heapq.heappop(self._waiting)
+            _, _, _, _, req = heapq.heappop(self._waiting)
             if req.state is RequestState.QUEUED:
                 tickets.append(self.journal.snapshot(req))
         self.journal = RequestJournal()
@@ -676,6 +786,7 @@ class RequestScheduler:
                 (
                     req.deadline,
                     int(len(req.prompt) + len(req.tokens)),
+                    self._adapter_rank_of_locked(req.adapter_id),
                     self._seq,
                     req,
                 ),
@@ -718,6 +829,7 @@ class RequestScheduler:
                 (
                     req.deadline,
                     int(len(req.prompt)),
+                    self._adapter_rank_of_locked(req.adapter_id),
                     self._seq,
                     req,
                 ),

@@ -239,6 +239,17 @@ def test_serve_bench_smoke_emits_driver_contract():
         "disagg_handoffs",
         "disagg_pages_adopted",
         "n_disagg_requests",
+        # adapter phase: the multi-tenant LoRA evidence axes
+        "adapter_mix_tpot_ms_p50",
+        "adapter_single_tpot_ms_p50",
+        "adapter_tpot_ratio",
+        "adapter_parity_ok",
+        "adapter_cache_hit_rate",
+        "adapter_cache_evictions",
+        "adapter_uploads",
+        "n_adapters",
+        "adapter_cache_slots",
+        "n_adapter_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -368,3 +379,20 @@ def test_serve_bench_smoke_emits_driver_contract():
     assert detail["elastic_refresh_ok"] is True
     assert detail["elastic_metrics_ok"] is True
     assert detail["n_elastic_requests"] > 0
+    # the adapter acceptance floor: a tenant mix batched through ONE
+    # base forward must price in under the per-tenant-replica
+    # alternative — TPOT p50 within 25% of the single-model baseline
+    # (paired median, same discipline as paged_tpot_ratio) — with
+    # every request byte-identical to its dedicated merged-weight
+    # engine, and the oversubscribed device bank (more tenants than
+    # slots) showing real LRU reuse: hits > 0 AND at least one
+    # pinned-aware eviction, with every tenant uploaded at least once
+    assert 0.0 < detail["adapter_tpot_ratio"] <= 1.25
+    assert detail["adapter_mix_tpot_ms_p50"] > 0
+    assert detail["adapter_single_tpot_ms_p50"] > 0
+    assert detail["adapter_parity_ok"] is True
+    assert detail["adapter_cache_hit_rate"] > 0.0
+    assert detail["adapter_cache_evictions"] >= 1
+    assert detail["adapter_uploads"] >= detail["n_adapters"]
+    assert detail["n_adapters"] > detail["adapter_cache_slots"]
+    assert detail["n_adapter_requests"] > 0

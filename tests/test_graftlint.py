@@ -32,6 +32,7 @@ from dlrover_tpu.analysis import (
 )
 from dlrover_tpu.analysis.rules import (
     REGISTRY,
+    AdapterBankRule,
     BroadExceptRule,
     ClockDisciplineRule,
     DeviceAllocRule,
@@ -618,6 +619,98 @@ def test_elastic_rule_ignores_outside_serving(tmp_path):
         rel="dlrover_tpu/parallel/mesh.py",
     )
     assert not hits(ElasticReshardRule(), src)
+
+
+# ---------------------------------------------------------------------------
+# ADAPTER-001: adapter-bank allocation/eviction only in adapters.py
+
+
+def test_adapter_rule_flags_adhoc_bank_mutation(tmp_path):
+    # an engine method minting a fresh bank, scattering a slot
+    # directly, and poking the cache's LRU/pin internals — each a
+    # way to re-point a decoding slot at the wrong tenant's weights
+    src = probe(
+        tmp_path,
+        """
+        class Engine:
+            def _admit(self, req):
+                bank = init_adapter_bank(self.cfg, 8, 8, None)
+                bank = _bank_slot_write(bank, req.update, 3)
+                self._adapter_cache._resident.clear()
+                self._adapter_cache._pins[req.adapter_id] = 0
+                return bank
+        """,
+        rel=ENGINE_REL,
+    )
+    found = hits(AdapterBankRule(), src)
+    assert len(found) == 4
+    assert all("adapters.py" in f.message for f in found)
+
+
+def test_adapter_rule_allows_cache_api(tmp_path):
+    # the sanctioned surface: acquire/release/rebuild and reading
+    # .bank — none of it is a finding
+    src = probe(
+        tmp_path,
+        """
+        class Engine:
+            def submit(self, adapter_id):
+                slot = self._adapter_cache.acquire(adapter_id)
+                return self._adapter_cache.bank, slot
+
+            def retire(self, req):
+                self._adapter_cache.release(req.adapter_id)
+        """,
+        rel=ENGINE_REL,
+    )
+    assert not hits(AdapterBankRule(), src)
+
+
+def test_adapter_rule_ignores_self_private_fields(tmp_path):
+    # the cache's own methods touch _resident/_pins through self —
+    # that IS the eviction path, not a bypass
+    src = probe(
+        tmp_path,
+        """
+        def _take_slot(self):
+            for victim, slot in self._resident.items():
+                if self._pins.get(victim, 0) == 0:
+                    del self._resident[victim]
+                    return slot
+            raise RuntimeError
+        """,
+        rel="dlrover_tpu/serving/adapters.py",
+    )
+    assert not hits(AdapterBankRule(), src)
+
+
+def test_adapter_rule_vacuous_on_adapters_module(tmp_path):
+    # same offender code impersonating adapters.py: exempt there
+    # (it IS the bank owner), flagged anywhere else in serving
+    code = """
+    def rebuild(cache, cfg):
+        cache.bank = init_adapter_bank(cfg, 8, 8, None)
+        return cache._upload(0, cache._take_slot())
+    """
+    src = probe(
+        tmp_path, code, rel="dlrover_tpu/serving/adapters.py"
+    )
+    assert not hits(AdapterBankRule(), src)
+    src = probe(tmp_path, code, rel=SERVING_REL)
+    assert len(hits(AdapterBankRule(), src)) == 3
+
+
+def test_adapter_rule_ignores_outside_serving(tmp_path):
+    # models/tests build banks by design — serving-layer invariant
+    src = probe(
+        tmp_path,
+        """
+        def setup(cfg):
+            return init_adapter_bank(cfg, 8, 8, None)
+        """,
+        rel="dlrover_tpu/models/lora.py",
+    )
+    assert not hits(AdapterBankRule(), src)
 
 
 # ---------------------------------------------------------------------------

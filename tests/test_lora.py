@@ -112,6 +112,25 @@ class TestMerge:
             rtol=1e-5,
         )
 
+    def test_merge_rejects_stray_adapter_leaf(self):
+        """A typo'd target renamed by hand must fail loudly — merge
+        would otherwise silently discard the delta."""
+        cfg, p = self._adapted()
+        layers = dict(p["layers"])
+        layers["w_q_lora_a"] = layers.pop("wq_lora_a")
+        layers["w_q_lora_b"] = layers.pop("wq_lora_b")
+        with pytest.raises(KeyError, match="no base weight"):
+            lora.merge(cfg, {**p, "layers": layers})
+
+    def test_merge_rejects_half_pair(self):
+        """Half an A/B pair (e.g. dropped by a bad checkpoint filter)
+        must not merge as if the adapter were whole."""
+        cfg, p = self._adapted()
+        layers = dict(p["layers"])
+        del layers["wv_lora_b"]
+        with pytest.raises(KeyError, match="missing its pair"):
+            lora.merge(cfg, {**p, "layers": layers})
+
     def test_merged_export_matches_hf(self):
         """merge → to_hf_state_dict → transformers forward == ours
         (the merge-to-full export the reference gets from peft's
