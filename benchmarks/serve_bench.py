@@ -1347,6 +1347,226 @@ def main():
         if [amix_out[i] for i in rows] != want:
             adapter_parity_ok = False
 
+    # ---- phase 12: fleet front door (affinity routing + forecast) -----
+    # Three replicas behind ONE pool.submit front door, a multi-tenant
+    # shared-system-prompt workload (each tenant = its own system
+    # prompt, every request that tenant's prompt + a short tail).
+    # Routing is the only variable: the SAME rotated submission order
+    # runs once with prefix-affinity routing ON and once OFF (pure
+    # least-loaded), plus once through a single unrouted engine — the
+    # hit-rate ceiling AND the byte oracle. The rotation is
+    # adversarial for load-only routing on purpose: position k of
+    # every round drains to replica k (ties re-rank from insertion
+    # order), so tenants sweep the fleet and re-prefill their system
+    # prompt on every replica, while affinity pins each tenant to the
+    # replica already advertising its prefix. Locks: fleet hit rate
+    # within noise of the single-replica ceiling and strictly above
+    # least-loaded, the warm-TTFT tail (p90) and mean strictly below
+    # least-loaded, and byte parity across all three passes (routing
+    # changes WHERE a request runs, never WHAT it emits). The
+    # forecast leg replays a seeded diurnal pressure trace through
+    # predictive_scale: the advisor must receive a chip-denominated
+    # scale-up BEFORE the trace's pressure peak.
+    fleet_replicas, fleet_tenants, fleet_rounds = 3, 3, 6
+    frng = np.random.default_rng(12)  # phase-local workload rng
+    f_sys = [
+        frng.integers(
+            1, min(500, pcfg.vocab_size), size=sys_len
+        ).tolist()
+        for _ in range(fleet_tenants)
+    ]
+    # tails SHORTER than the digest block (the radix cache's 16): the
+    # block-aligned published prefix of every request is then exactly
+    # the tenant's system prompt, so all of a tenant's requests share
+    # one advertised digest (a tail at/over the block would publish
+    # per-request digests nothing ever re-matches)
+    f_prompts = [
+        s
+        + frng.integers(
+            1, min(500, pcfg.vocab_size), size=8
+        ).tolist()
+        for s in f_sys
+    ]
+    f_warm_sys = frng.integers(
+        1, min(500, pcfg.vocab_size), size=sys_len
+    ).tolist()
+    f_slo = SloConfig(
+        max_queue_depth=fleet_tenants * fleet_rounds + 2,
+        max_new_tokens=p_max_new,
+        default_deadline_s=600.0,
+    )
+
+    def _fleet_warm(fsched):
+        # same two-step warm-up as the prefix phase — bare system
+        # prompt (cold-path compile, publishes depth exactly
+        # sys_len), then a tailed request (warm-path compile) — on a
+        # THROWAWAY prefix so the timed workload starts cold
+        fsched.submit(f_warm_sys, max_new=p_max_new)
+        fsched.run_to_completion()
+        fsched.submit(
+            f_warm_sys + f_prompts[0][-8:], max_new=p_max_new
+        )
+        fsched.run_to_completion()
+
+    def _fleet_cache_totals(freps):
+        th = tm = 0
+        for frep in freps:
+            st = frep.scheduler.engine.prefix_cache.stats()
+            th += int(st["hits"])
+            tm += int(st["misses"])
+        return th, tm
+
+    def _fleet_pass(affinity):
+        """One routed pass: returns (rows, hit_rate, warm ttfts,
+        pool, metrics) where rows = (tenant, round, request)."""
+        fmetrics = ServingMetrics()
+        fpool = ReplicaPool(
+            metrics=fmetrics, affinity_routing=affinity
+        )
+        freps = []
+        for i in range(fleet_replicas):
+            feng = ContinuousBatcher(
+                pcfg, pparams, n_slots=p_slots, max_len=p_max_len,
+                max_new_tokens=p_max_new, chunk=p_chunk, pad_id=-1,
+                prefix_cache_rows=8,
+            )
+            fsched = RequestScheduler(feng, f_slo, metrics=fmetrics)
+            frep = InferenceReplica(f"fleet-{i}", fsched)
+            fpool.add(frep)
+            freps.append(frep)
+        for frep in freps:
+            _fleet_warm(frep.scheduler)
+        fpool.check_replicas()
+        base_h, base_m = _fleet_cache_totals(freps)
+        rows = []
+        for rnd in range(fleet_rounds):
+            for pos in range(fleet_tenants):
+                t = (pos + rnd) % fleet_tenants
+                r = fpool.submit(f_prompts[t], max_new=p_max_new)
+                rows.append((t, rnd, r))
+                # heartbeat between arrivals: publishes fresh digests
+                # and re-ranks on live load — what the background
+                # pool loop does between requests
+                fpool.check_replicas()
+            _drain(freps)
+            fpool.check_replicas()
+        th, tm = _fleet_cache_totals(freps)
+        lookups = (th - base_h) + (tm - base_m)
+        hit_rate = (th - base_h) / max(lookups, 1)
+        # round 0 is the cold sweep in BOTH passes; warm TTFT is
+        # rounds >= 1, where only routing decides cold vs warm
+        ttfts = sorted(
+            (r.first_token_ts - r.submit_ts) * 1000.0
+            for t, rnd, r in rows
+            if rnd >= 1 and r.first_token_ts is not None
+        )
+        return rows, hit_rate, ttfts, fpool, fmetrics
+
+    fleet_rows, fleet_hit_rate, fleet_ttfts, fleet_pool, _fm = (
+        _fleet_pass(affinity=True)
+    )
+    lb_rows, fleet_lb_hit_rate, fleet_lb_ttfts, _lbp, _lbm = (
+        _fleet_pass(affinity=False)
+    )
+
+    # single unrouted engine: the hit-rate ceiling (every request
+    # lands where its prefix lives, by construction) and the byte
+    # oracle the routed passes must match token-for-token
+    s_eng = ContinuousBatcher(
+        pcfg, pparams, n_slots=p_slots, max_len=p_max_len,
+        max_new_tokens=p_max_new, chunk=p_chunk, pad_id=-1,
+        prefix_cache_rows=8,
+    )
+    s_sched = RequestScheduler(
+        s_eng, f_slo, metrics=ServingMetrics()
+    )
+    _fleet_warm(s_sched)
+    s_st = s_eng.prefix_cache.stats()
+    s_base_h, s_base_m = int(s_st["hits"]), int(s_st["misses"])
+    single_tokens = {}
+    for rnd in range(fleet_rounds):
+        for pos in range(fleet_tenants):
+            t = (pos + rnd) % fleet_tenants
+            r = s_sched.submit(f_prompts[t], max_new=p_max_new)
+            s_sched.run_to_completion()
+            single_tokens.setdefault(t, list(r.tokens))
+    s_st = s_eng.prefix_cache.stats()
+    s_lookups = (int(s_st["hits"]) - s_base_h) + (
+        int(s_st["misses"]) - s_base_m
+    )
+    fleet_single_hit_rate = (
+        int(s_st["hits"]) - s_base_h
+    ) / max(s_lookups, 1)
+    fleet_parity_ok = all(
+        list(r.tokens) == single_tokens[t]
+        for t, _rnd, r in fleet_rows + lb_rows
+    )
+
+    # forecast leg: a seeded diurnal pressure trace (night flat,
+    # morning ramp, midday peak, decline) replayed into the brain
+    # store with EXPLICIT 10s-apart timestamps — the fitted slope
+    # must come from the trace's clock, not the bench's wall clock —
+    # and predictive_scale run after every sample. The lock is lead
+    # time: the first chip-denominated up-hint reaches the advisor
+    # strictly before the trace's pressure/queue peak.
+    from dlrover_tpu.brain.datastore import (
+        JobMetricsStore,
+        RuntimeSample,
+    )
+    from dlrover_tpu.master.auto_scaler import ServingScaleAdvisor
+
+    fadvisor = ServingScaleAdvisor(max_replicas=8)
+    fleet_pool.advisor = fadvisor.on_hint
+    # prove the live telemetry wiring once — real fleet stats (queue
+    # depth, pressure, hit rate, chips) flow into a store
+    fleet_pool.brain_store = JobMetricsStore()
+    tele_sample = fleet_pool.publish_telemetry()
+    forecast_telemetry_ok = (
+        tele_sample is not None and tele_sample.role == "serving"
+    )
+    fstore = JobMetricsStore()
+    fleet_pool.brain_store = fstore
+    f_trace = []
+    for i in range(30):
+        if i < 8:
+            pr = 0.30
+        elif i <= 20:
+            pr = min(1.0, 0.30 + 0.06 * (i - 8))
+        else:
+            pr = max(0.2, 1.0 - 0.08 * (i - 20))
+        f_trace.append((10.0 * i, pr, int(round(pr * 20))))
+    forecast_peak_idx = max(
+        range(len(f_trace)), key=lambda i: f_trace[i][2]
+    )
+    forecast_first_up_idx = -1
+    forecast_chip_delta = 0
+    for i, (ts_s, pr, qd) in enumerate(f_trace):
+        fstore.add_sample(
+            RuntimeSample(
+                job_uuid=fleet_pool.job_uuid,
+                role="serving",
+                num_nodes=fleet_replicas,
+                cpu_percent=pr * 100.0,
+                ts=ts_s,
+                queue_depth=qd,
+            )
+        )
+        f_hint = fleet_pool.predictive_scale()
+        if (
+            f_hint is not None
+            and f_hint["direction"] == "up"
+            and forecast_first_up_idx < 0
+        ):
+            forecast_first_up_idx = i
+            forecast_chip_delta = (
+                f_hint["chips"] - f_hint["current_chips"]
+            )
+    forecast_lead_samples = (
+        forecast_peak_idx - forecast_first_up_idx
+        if forecast_first_up_idx >= 0
+        else -1
+    )
+
     print(
         json.dumps(
             {
@@ -1555,6 +1775,54 @@ def main():
                     "n_adapters": n_adapters,
                     "adapter_cache_slots": adapter_cache_slots,
                     "n_adapter_requests": len(amix_out),
+                    # fleet phase: prefix-affinity routing +
+                    # predictive autoscaling evidence axes
+                    "fleet_hit_rate": round(fleet_hit_rate, 3),
+                    "fleet_lb_hit_rate": round(
+                        fleet_lb_hit_rate, 3
+                    ),
+                    "fleet_single_hit_rate": round(
+                        fleet_single_hit_rate, 3
+                    ),
+                    "fleet_ttft_ms_p50": round(
+                        pct(fleet_ttfts, 0.5), 2
+                    ),
+                    "fleet_ttft_ms_p90": round(
+                        pct(fleet_ttfts, 0.9), 2
+                    ),
+                    "fleet_ttft_ms_mean": round(
+                        sum(fleet_ttfts) / len(fleet_ttfts), 2
+                    )
+                    if fleet_ttfts
+                    else 0.0,
+                    "fleet_lb_ttft_ms_p50": round(
+                        pct(fleet_lb_ttfts, 0.5), 2
+                    ),
+                    "fleet_lb_ttft_ms_p90": round(
+                        pct(fleet_lb_ttfts, 0.9), 2
+                    ),
+                    "fleet_lb_ttft_ms_mean": round(
+                        sum(fleet_lb_ttfts) / len(fleet_lb_ttfts),
+                        2,
+                    )
+                    if fleet_lb_ttfts
+                    else 0.0,
+                    "fleet_parity_ok": fleet_parity_ok,
+                    "fleet_affinity_matched": int(
+                        _fm.affinity_matched
+                    ),
+                    "fleet_digests": int(
+                        fleet_pool.routing_stats()["digests"]
+                    ),
+                    "fleet_replicas": fleet_replicas,
+                    "fleet_tenants": fleet_tenants,
+                    "n_fleet_requests": len(fleet_rows),
+                    "forecast_first_up_idx": forecast_first_up_idx,
+                    "forecast_peak_idx": forecast_peak_idx,
+                    "forecast_lead_samples": forecast_lead_samples,
+                    "forecast_chip_delta": forecast_chip_delta,
+                    "forecast_plans": int(fadvisor.forecast_plans),
+                    "forecast_telemetry_ok": forecast_telemetry_ok,
                 },
             }
         ),

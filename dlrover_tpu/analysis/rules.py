@@ -1186,6 +1186,97 @@ class AdapterBankRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# ROUTE-001: fleet routing decisions only in replica.py + affinity.py
+
+
+AFFINITY_FILE = SERVING_PREFIX + "affinity.py"
+REPLICA_FILE = SERVING_PREFIX + "replica.py"
+_ROUTING_EXEMPT = (REPLICA_FILE, AFFINITY_FILE)
+
+# the routing-decision API owned by serving/affinity.py: digest-map
+# reads, candidate ranking, and digest-chain construction. Everything
+# else observes routing through stats()/routing_stats() — it never
+# ranks candidates or reads the map itself.
+_ROUTING_CALLS = frozenset(
+    {
+        "match_depths",
+        "affinity_order",
+        "prefix_digest_chain",
+        "cache_digests",
+    }
+)
+
+# FleetDigestMap internals no other serving file may reach into —
+# mutating either index directly desyncs digest→replica from
+# replica→digest and mints routes update()/drop() can't retract
+_DIGEST_MAP_PRIVATE = frozenset({"_by_digest", "_by_replica"})
+
+
+def routing_sites(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, what) for every routing-decision call (bare name or
+    any attribute spelling) and every non-self access to a private
+    digest-map field."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _ROUTING_CALLS:
+                out.append((node.lineno, f"{f.id}(...)"))
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr in _ROUTING_CALLS
+            ):
+                out.append((node.lineno, f"{ast.unparse(f)}(...)"))
+        elif (
+            isinstance(node, ast.Attribute)
+            and node.attr in _DIGEST_MAP_PRIVATE
+            and not (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            )
+        ):
+            out.append((node.lineno, ast.unparse(node)))
+    return out
+
+
+class FleetRoutingRule(Rule):
+    id = "ROUTE-001"
+    severity = CRITICAL
+    title = (
+        "fleet routing decisions only in replica.py + affinity.py"
+    )
+    rationale = (
+        "DEVIATIONS §17: prefix-affinity placement is one policy "
+        "with one precedence (phase > affinity > adapter residency "
+        "> load), enforced where the pool admits requests. A digest-"
+        "map read or an ad-hoc candidate ranking anywhere else "
+        "forks the policy — two components can then route the same "
+        "prompt to different replicas, which silently halves the "
+        "fleet hit rate the digest map exists to protect, and a "
+        "poke at the map's _by_digest/_by_replica mints stale "
+        "routes the drop-on-death path can never retract."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src) and not any(
+            _matches_file(src.rel, key) for key in _ROUTING_EXEMPT
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{what} — routing decisions belong to "
+                "serving/replica.py + serving/affinity.py only; "
+                "submit through the pool and observe through "
+                "routing_stats()",
+            )
+            for lineno, what in routing_sites(src.tree)
+        ]
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -1204,6 +1295,7 @@ REGISTRY: List[Rule] = [
     HandoffAdoptionRule(),
     ElasticReshardRule(),
     AdapterBankRule(),
+    FleetRoutingRule(),
 ]
 
 

@@ -27,6 +27,16 @@ COLD_WORKER_DEFAULT_COUNT = 2
 UTIL_LOW_THRESHOLD = 0.3          # sustained low util → shrink
 DEGRADE_THRESHOLD = 0.85
 
+# serving forecast (role="serving", fed by the replica pool's
+# publish_telemetry): pressure is queue load normalized per replica
+# capacity in [0, 1+] — scale ahead of the spike the trend predicts
+SERVING_PRESSURE_HIGH = 0.8       # forecast above this → scale up
+SERVING_PRESSURE_LOW = 0.15       # forecast below this → scale down
+SERVING_PRESSURE_TARGET = 0.5     # size the move to land here
+SERVING_FORECAST_HORIZON_S = 30.0  # how far ahead the trend is read
+SERVING_EWMA_ALPHA = 0.4          # smoothing weight for the level
+SERVING_MIN_WINDOW = 3            # samples before forecasting at all
+
 
 @dataclass
 class ResourceDelta:
@@ -37,6 +47,9 @@ class ResourceDelta:
     cpu: Optional[float] = None
     memory_mb: Optional[int] = None
     reason: str = ""
+    # chip denomination (serving forecast): count × chips_per_replica
+    # — what a chip-budgeted operator reads; None for training roles
+    chips: Optional[int] = None
 
     @property
     def empty(self) -> bool:
@@ -274,3 +287,93 @@ def worker_running(ctx: OptimizeContext) -> ResourceDelta:
             reason="linear scaling so far; probe one more host",
         )
     return ResourceDelta(role="worker")
+
+
+# ---- serving (inference replica) algorithms -------------------------------
+
+
+def _ewma(values: List[float], alpha: float) -> float:
+    """Exponentially-weighted level over values in time order."""
+    level = values[0]
+    for v in values[1:]:
+        level = alpha * v + (1.0 - alpha) * level
+    return level
+
+
+def _slope(ts: List[float], values: List[float]) -> float:
+    """Least-squares slope of values over ts (units per second);
+    0 when the window is degenerate (single instant)."""
+    n = len(ts)
+    mean_t = sum(ts) / n
+    mean_v = sum(values) / n
+    var_t = sum((t - mean_t) ** 2 for t in ts)
+    if var_t <= 0.0:
+        return 0.0
+    cov = sum(
+        (t - mean_t) * (v - mean_v) for t, v in zip(ts, values)
+    )
+    return cov / var_t
+
+
+@register("optimize_serving_replica_resource")
+def serving_forecast(ctx: OptimizeContext) -> ResourceDelta:
+    """Short-horizon demand forecast for the serving replica fleet:
+    EWMA level + least-squares slope over the pool's telemetry
+    window, extrapolated SERVING_FORECAST_HORIZON_S ahead, emitted as
+    a chip-denominated delta — the predictive half of the fleet front
+    door (the reactive half is the pool's queue-pressure hint). The
+    point is to move BEFORE the spike: a rising trend that will cross
+    SERVING_PRESSURE_HIGH at the horizon scales up while the current
+    pressure still looks fine, and the scale-down leg is deliberately
+    conservative (sustained LOW forecast, never on slope alone) so
+    the forecast cannot flap against elastic shrink/grow — the
+    advisor's hysteresis is the second gate."""
+    ss = ctx.store.samples(ctx.job_uuid, role="serving", limit=64)
+    if len(ss) < SERVING_MIN_WINDOW:
+        return ResourceDelta(role="serving")
+    ss = list(reversed(ss))  # store returns newest-first
+    ts = [s.ts for s in ss]
+    pressure = [s.cpu_percent / 100.0 for s in ss]
+    level = _ewma(pressure, SERVING_EWMA_ALPHA)
+    trend = _slope(ts, pressure)
+    forecast = level + trend * SERVING_FORECAST_HORIZON_S
+    cur = ctx.current.get("serving", {})
+    n = max(int(cur.get("count", 1)), 1)
+    cpr = max(int(cur.get("chips_per_replica", 1)), 1)
+    if forecast > SERVING_PRESSURE_HIGH:
+        # size the move so forecast demand lands at the target:
+        # demand scales ~1/replicas at fixed arrival rate
+        target = max(
+            n + 1,
+            -(-int(n * forecast * 1000)
+              // int(SERVING_PRESSURE_TARGET * 1000)),
+        )
+        return ResourceDelta(
+            role="serving",
+            count=target,
+            chips=target * cpr,
+            reason=(
+                f"forecast pressure {forecast:.2f} > "
+                f"{SERVING_PRESSURE_HIGH} at +"
+                f"{SERVING_FORECAST_HORIZON_S:.0f}s "
+                f"(level {level:.2f}, slope {trend:+.4f}/s)"
+            ),
+        )
+    if (
+        n > 1
+        and forecast < SERVING_PRESSURE_LOW
+        and level < SERVING_PRESSURE_LOW
+        and trend <= 0.0
+    ):
+        target = max(1, n - 1)
+        return ResourceDelta(
+            role="serving",
+            count=target,
+            chips=target * cpr,
+            reason=(
+                f"sustained low forecast {forecast:.2f} < "
+                f"{SERVING_PRESSURE_LOW} (level {level:.2f}, "
+                f"slope {trend:+.4f}/s)"
+            ),
+        )
+    return ResourceDelta(role="serving")

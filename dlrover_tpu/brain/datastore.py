@@ -27,16 +27,26 @@ class JobMeta:
 
 @dataclass
 class RuntimeSample:
-    """One observation of a role group at a moment in time."""
+    """One observation of a role group at a moment in time.
+
+    Serving telemetry (role="serving", written by the replica pool's
+    publish_telemetry) reuses the shared fields — num_nodes carries
+    the fleet's healthy CHIP count (the denomination the forecast
+    scales in), cpu_percent carries aggregate queue pressure ×100,
+    samples_per_sec carries tokens/sec — and adds the three
+    serving-only columns below (zero for training roles)."""
 
     job_uuid: str
-    role: str  # worker | ps (embedding host)
+    role: str  # worker | ps (embedding host) | serving
     num_nodes: int = 0
     cpu_percent: float = 0.0
     memory_mb: float = 0.0
     samples_per_sec: float = 0.0
     global_step: int = 0
     ts: float = field(default_factory=time.time)
+    queue_depth: int = 0       # fleet-total waiting requests
+    ttft_ms: float = 0.0       # warm TTFT p50 over the window
+    cache_hit_rate: float = 0.0  # fleet prefix-cache hit rate [0,1]
 
 
 class JobMetricsStore:
@@ -57,9 +67,28 @@ class JobMetricsStore:
             """CREATE TABLE IF NOT EXISTS runtime_samples (
                 job_uuid TEXT, role TEXT, num_nodes INTEGER,
                 cpu_percent REAL, memory_mb REAL,
-                samples_per_sec REAL, global_step INTEGER, ts REAL
+                samples_per_sec REAL, global_step INTEGER, ts REAL,
+                queue_depth INTEGER DEFAULT 0,
+                ttft_ms REAL DEFAULT 0,
+                cache_hit_rate REAL DEFAULT 0
             )"""
         )
+        # serving-telemetry columns, added for the fleet forecast:
+        # CREATE IF NOT EXISTS never migrates a pre-existing file, so
+        # widen it in place (ALTER is a no-op error when the column
+        # is already there — including the fresh-table path above)
+        for col, decl in (
+            ("queue_depth", "INTEGER DEFAULT 0"),
+            ("ttft_ms", "REAL DEFAULT 0"),
+            ("cache_hit_rate", "REAL DEFAULT 0"),
+        ):
+            try:
+                self._conn.execute(
+                    f"ALTER TABLE runtime_samples "
+                    f"ADD COLUMN {col} {decl}"
+                )
+            except sqlite3.OperationalError:
+                pass  # column exists
         self._conn.commit()
 
     # ---- job meta --------------------------------------------------------
@@ -137,7 +166,11 @@ class JobMetricsStore:
     def add_sample(self, s: RuntimeSample):
         with self._lock:
             self._conn.execute(
-                "INSERT INTO runtime_samples VALUES (?,?,?,?,?,?,?,?)",
+                "INSERT INTO runtime_samples "
+                "(job_uuid, role, num_nodes, cpu_percent, memory_mb, "
+                "samples_per_sec, global_step, ts, queue_depth, "
+                "ttft_ms, cache_hit_rate) "
+                "VALUES (?,?,?,?,?,?,?,?,?,?,?)",
                 (
                     s.job_uuid,
                     s.role,
@@ -147,6 +180,9 @@ class JobMetricsStore:
                     s.samples_per_sec,
                     s.global_step,
                     s.ts,
+                    s.queue_depth,
+                    s.ttft_ms,
+                    s.cache_hit_rate,
                 ),
             )
             self._conn.commit()
@@ -156,7 +192,8 @@ class JobMetricsStore:
     ) -> List[RuntimeSample]:
         q = (
             "SELECT job_uuid, role, num_nodes, cpu_percent, memory_mb, "
-            "samples_per_sec, global_step, ts FROM runtime_samples "
+            "samples_per_sec, global_step, ts, queue_depth, ttft_ms, "
+            "cache_hit_rate FROM runtime_samples "
             "WHERE job_uuid=?"
         )
         args: tuple = (job_uuid,)

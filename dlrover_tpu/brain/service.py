@@ -55,6 +55,14 @@ class PersistRuntimeSample(BaseRequest):
     memory_mb: float = 0.0
     samples_per_sec: float = 0.0
     global_step: int = 0
+    # serving telemetry (role="serving"; zero for training roles)
+    queue_depth: int = 0
+    ttft_ms: float = 0.0
+    cache_hit_rate: float = 0.0
+    # explicit observation time (0 = stamp at receipt). The serving
+    # forecast fits a slope over ts, so replayed/bench telemetry must
+    # be able to carry its own clock instead of the ingest clock.
+    ts: float = 0.0
 
 
 @dataclass
@@ -71,6 +79,7 @@ class OptimizeResponse:
     cpu: float = -1.0
     memory_mb: int = -1
     reason: str = ""
+    chips: int = -1         # chip denomination (serving forecast)
 
     @property
     def empty(self) -> bool:
@@ -120,6 +129,10 @@ class BrainServicer(MasterServicerBase):
                     memory_mb=req.memory_mb,
                     samples_per_sec=req.samples_per_sec,
                     global_step=req.global_step,
+                    queue_depth=req.queue_depth,
+                    ttft_ms=req.ttft_ms,
+                    cache_hit_rate=req.cache_hit_rate,
+                    **({"ts": req.ts} if req.ts else {}),
                 )
             )
             return ReplyEnvelope()
@@ -158,6 +171,7 @@ def _delta_to_resp(d: ResourceDelta) -> OptimizeResponse:
         cpu=d.cpu if d.cpu is not None else -1.0,
         memory_mb=d.memory_mb if d.memory_mb is not None else -1,
         reason=d.reason,
+        chips=d.chips if d.chips is not None else -1,
     )
 
 
@@ -258,6 +272,7 @@ class BrainResourceOptimizer:
         ("worker", "create"): "optimize_job_worker_create_resource",
         ("worker", "oom"): "optimize_job_worker_create_oom_resource",
         ("worker", "running"): "optimize_job_worker_resource",
+        ("serving", "running"): "optimize_serving_replica_resource",
     }
 
     def __init__(self, client: BrainClient, job_uuid: str):

@@ -143,15 +143,26 @@ class JobAutoScaler:
 
 
 class ServingScaleAdvisor:
-    """Inference-replica scaling from serving queue pressure.
+    """Inference-replica scaling from serving queue pressure AND the
+    brain's demand forecast.
 
     The replica pool (serving/replica.py) folds its replicas' queue
     pressure into a hint it writes at `serving/scale_hint` in the
     master KV store (and can call `on_hint` directly when it lives in
-    the master process). The advisor turns an up/down hint into a
-    ScalePlan for the replica node group, bounded by [min_replicas,
-    max_replicas], and executes it through the job's Scaler — the same
-    plan → scaler path training scaling takes.
+    the master process); its predictive_scale step sends FORECAST
+    hints (source="forecast", sized by the brain's EWMA+slope
+    algorithm) through the same path. The advisor turns an up/down
+    hint into a ScalePlan for the replica node group, bounded by
+    [min_replicas, max_replicas], and executes it through the job's
+    Scaler — the same plan → scaler path training scaling takes.
+
+    Hysteresis: a direction FLIP within `hysteresis_s` of the last
+    executed move is suppressed. That is the anti-flap gate between
+    the two hint sources and elastic shrink/grow — a forecast
+    scale-up followed seconds later by a reactive scale-down (or a
+    degraded replica growing back) must not thrash the node group.
+    Same-direction moves pass freely: a spike that keeps growing may
+    keep scaling.
     """
 
     HINT_KEY = "serving/scale_hint"
@@ -163,14 +174,22 @@ class ServingScaleAdvisor:
         node_type: str = "inference",
         min_replicas: int = 1,
         max_replicas: int = 8,
+        hysteresis_s: float = 30.0,
+        clock=time.monotonic,
     ):
         self._kv = kv_store
         self._scaler = scaler
         self.node_type = node_type
         self.min_replicas = min_replicas
         self.max_replicas = max_replicas
+        self.hysteresis_s = hysteresis_s
+        self._clock = clock
         self.executed_plans = 0
+        self.forecast_plans = 0
+        self.suppressed_flips = 0
         self._last_hint_ts = 0.0
+        self._last_direction = "hold"
+        self._last_move_ts: Optional[float] = None
         # chips implied by the last acted-on hint (replicas × slice
         # size) — the capacity number a chip-budgeted operator reads
         self.last_chip_demand = 0
@@ -200,6 +219,23 @@ class ServingScaleAdvisor:
         direction = hint.get("direction")
         if direction not in ("up", "down"):
             return plan
+        # anti-flap hysteresis: suppress a direction FLIP that lands
+        # within hysteresis_s of the last executed move (forecast vs
+        # reactive vs elastic-regrow must not thrash the group)
+        now = self._clock()
+        if (
+            self._last_move_ts is not None
+            and direction != self._last_direction
+            and now - self._last_move_ts < self.hysteresis_s
+        ):
+            self.suppressed_flips += 1
+            logger.info(
+                "serving scale hint %s suppressed: flips %s only "
+                "%.1fs after it (hysteresis %.1fs)",
+                direction, self._last_direction,
+                now - self._last_move_ts, self.hysteresis_s,
+            )
+            return plan
         # chip-denominated: a replica is a mesh slice of
         # `chips_per_replica` devices, so the demand the pool reports
         # (and the plan the scaler executes) is chips, converted to
@@ -218,12 +254,17 @@ class ServingScaleAdvisor:
         plan.node_group_resources[self.node_type] = NodeGroupResource(
             count=target
         )
+        source = hint.get("source", "pressure")
         logger.info(
-            "serving scale hint %s: replica group -> %d "
+            "serving scale hint %s (%s): replica group -> %d "
             "(%d chips at %d/replica, pressure %.2f)",
-            direction, target, target * cpr, cpr,
+            direction, source, target, target * cpr, cpr,
             hint.get("pressure", -1.0),
         )
+        self._last_direction = direction
+        self._last_move_ts = now
+        if source == "forecast":
+            self.forecast_plans += 1
         if self._scaler is not None:
             self.executed_plans += 1
             self._scaler.scale(plan)

@@ -7,9 +7,10 @@ it additionally serves as the host-level coordination store used before
 `jax.distributed.init` (the gloo-equivalent control path, SURVEY.md §2.7).
 """
 
+import json
 import threading
 import time
-from typing import Dict, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set
 
 
 class KVStoreService:
@@ -105,6 +106,73 @@ class RetryingKV:
 
     def get(self, key: str) -> bytes:
         return self._call("kv_get", "get", key)
+
+
+class PrefixDirectory:
+    """Fleet prefix→replica digest directory over any KV store.
+
+    The replica pool's affinity router (serving/replica.py +
+    serving/affinity.py) keeps an in-process digest map for the hot
+    path; this directory is the SHARED view — one aggregated JSON
+    document under `serving/prefix_map` that every gateway process
+    pointed at the same master reads identically, the same duck-typed
+    set/get (or MasterClient kv_set/kv_get) surface the heartbeat
+    path already speaks. Only blake2b digests are stored: token data
+    never reaches the master (serving/affinity.py's contract).
+
+    Writes are read-modify-write per replica entry. That is safe in
+    practice because exactly one pool owns a given replica id's
+    entry (the pool that health-checks it) — concurrent pools touch
+    disjoint keys of the document, and the pool serializes its own
+    publishes on its background thread."""
+
+    KEY = "serving/prefix_map"
+
+    def __init__(self, kv):
+        self._kv = kv
+
+    def _read(self) -> Dict[str, List[str]]:
+        if hasattr(self._kv, "kv_get"):
+            raw = self._kv.kv_get(self.KEY)
+        else:
+            raw = self._kv.get(self.KEY)
+        if not raw:
+            return {}
+        try:
+            doc = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return {}
+        return doc if isinstance(doc, dict) else {}
+
+    def _write(self, doc: Dict[str, List[str]]) -> None:
+        raw = json.dumps(doc, sort_keys=True).encode()
+        if hasattr(self._kv, "kv_set"):
+            self._kv.kv_set(self.KEY, raw)
+        else:
+            self._kv.set(self.KEY, raw)
+
+    def publish(
+        self, replica_id: str, digests: Iterable[str]
+    ) -> None:
+        """Replace `replica_id`'s advertised digest list (heartbeat
+        refresh). An empty list removes the entry — same replace
+        semantics as FleetDigestMap.update."""
+        doc = self._read()
+        ds = sorted(set(digests))
+        if ds:
+            doc[replica_id] = ds
+        else:
+            doc.pop(replica_id, None)
+        self._write(doc)
+
+    def drop(self, replica_id: str) -> None:
+        """Remove a dead/ejected replica's entries so no gateway can
+        route at a corpse (no stale routes — the chaos invariant)."""
+        self.publish(replica_id, ())
+
+    def snapshot(self) -> Dict[str, List[str]]:
+        """replica id → advertised digests, fleet-wide."""
+        return self._read()
 
 
 class SyncService:

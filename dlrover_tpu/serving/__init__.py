@@ -27,8 +27,18 @@ inference:
   adapters.py  — multi-adapter LoRA serving: host registry + LRU
                  device adapter bank feeding the engine's batched
                  per-slot delta path (one base forward, many adapters)
+  affinity.py  — fleet prefix affinity: block-aligned digest chains
+                 over prompt prefixes + the digest→replica map the
+                 pool routes with (cache-hot placement, no token
+                 data off-replica)
 """
 
+from dlrover_tpu.serving.affinity import (
+    FleetDigestMap,
+    affinity_order,
+    cache_digests,
+    prefix_digest_chain,
+)
 from dlrover_tpu.serving.adapters import (
     AdapterCacheFull,
     AdapterRegistry,
@@ -74,6 +84,7 @@ __all__ = [
     "DeviceAdapterCache",
     "FailoverManager",
     "FaultInjector",
+    "FleetDigestMap",
     "GenerationEngine",
     "InferenceReplica",
     "NgramDrafter",
@@ -91,4 +102,7 @@ __all__ = [
     "SloConfig",
     "SpecController",
     "SpeculativeDecoder",
+    "affinity_order",
+    "cache_digests",
+    "prefix_digest_chain",
 ]

@@ -1,0 +1,203 @@
+"""Fleet prefix-affinity: digest chains over block-aligned prompt
+prefixes, and the digest→replica map the pool routes with.
+
+A replica's radix prefix cache (serving/prefix_cache.py) makes warm
+TTFT ~3x faster than cold, but the win evaporates in a fleet when
+least-loaded routing scatters a tenant's shared-system-prompt traffic
+across replicas. This module turns the cache's contents into a
+placement signal WITHOUT shipping token data through the control
+plane:
+
+- `prefix_digest_chain(tokens, block)` hashes each block-aligned
+  prefix of a prompt into a chained blake2b digest — digest i covers
+  tokens [0, (i+1)*block), so two prompts share digest i iff they
+  share that exact aligned prefix. The chain uses the SAME alignment
+  rule as `RadixPrefixCache.aligned_len` (floor to `block`), so a
+  digest the map holds is a prefix the replica's cache can actually
+  install from.
+- `cache_digests(cache)` enumerates the digests of every PUBLISHED
+  prefix in a replica's radix cache (nodes holding a pool row) — the
+  set a replica advertises in its heartbeat. Only digests leave the
+  replica; the master-side map never sees a token id.
+- `FleetDigestMap` is the pool/gateway-side view: digest → replica
+  ids, replaced wholesale per heartbeat (`update`) and dropped on
+  death/ejection (`drop`) so a crashed replica can never attract a
+  stale route.
+- `affinity_order` is the candidate-ranking policy `ReplicaPool.submit`
+  applies: longest digest match first, tiebroken by the incoming load
+  order, and bounded by an imbalance cap so a hot prefix cannot starve
+  the fleet — an affine replica already `max_imbalance` load ahead of
+  the coolest candidate loses its preference.
+
+Routing-decision code (digest-map reads, candidate ranking) is
+confined to this module and serving/replica.py — graftlint ROUTE-001.
+"""
+
+import hashlib
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+DIGEST_BYTES = 8  # 64-bit hex digests: tiny heartbeats, ~no collisions
+# heartbeat payload cap: a replica advertises at most this many
+# published prefixes (the LRU-newest ones win — see cache_digests)
+MAX_PUBLISHED_DIGESTS = 256
+
+
+def _block_digest(
+    prev_hex: str, block_tokens: Sequence[int]
+) -> str:
+    h = hashlib.blake2b(digest_size=DIGEST_BYTES)
+    h.update(prev_hex.encode())
+    for t in block_tokens:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.hexdigest()
+
+
+def prefix_digest_chain(
+    tokens: Sequence[int], block: int
+) -> List[str]:
+    """Chained digests of every block-aligned prefix of `tokens`:
+    element i covers tokens [0, (i+1)*block). Same floor-to-block
+    alignment as RadixPrefixCache.aligned_len, so chain length is
+    aligned_len(len(tokens)) // block."""
+    if block < 1:
+        raise ValueError(f"block must be >= 1, got {block}")
+    n = (len(tokens) // block) * block
+    chain: List[str] = []
+    prev = ""
+    for i in range(0, n, block):
+        prev = _block_digest(prev, tokens[i : i + block])
+        chain.append(prev)
+    return chain
+
+
+def cache_digests(
+    cache, limit: int = MAX_PUBLISHED_DIGESTS
+) -> List[str]:
+    """Digests of the PUBLISHED prefixes in a RadixPrefixCache —
+    one digest per node holding a pool row, computed by chaining the
+    block edges from the root (published_blocks yields each row's
+    edge path). Capped at `limit`, newest-touched rows first, so a
+    churning cache advertises the prefixes most likely to still be
+    resident when a routed request arrives."""
+    out: List[str] = []
+    for path in cache.published_blocks():
+        prev = ""
+        for edge in path:
+            prev = _block_digest(prev, edge)
+        out.append(prev)
+        if len(out) >= limit:
+            break
+    return out
+
+
+class FleetDigestMap:
+    """digest → replica-id index over every replica's advertised
+    prefixes. Heartbeat-refreshed (replace semantics per replica) and
+    eagerly dropped on death so routing can never chase a stale
+    entry. Thread-safe: heartbeats land on the pool thread while
+    submit() reads on request threads."""
+
+    # both indexes mutate together under _lock (graftlint LOCK-001)
+    GUARDED_FIELDS = frozenset({"_by_digest", "_by_replica"})
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # digest -> set of replica ids advertising it
+        self._by_digest: Dict[str, set] = {}
+        # replica id -> the digests it currently advertises
+        self._by_replica: Dict[str, frozenset] = {}
+
+    def update(
+        self, replica_id: str, digests: Iterable[str]
+    ) -> None:
+        """Replace `replica_id`'s advertised set (heartbeat refresh).
+        Digests the replica no longer publishes (evicted rows) drop
+        out — the map mirrors the cache, it never accretes."""
+        new = frozenset(digests)
+        with self._lock:
+            old = self._by_replica.get(replica_id, frozenset())
+            for d in old - new:
+                members = self._by_digest.get(d)
+                if members is not None:
+                    members.discard(replica_id)
+                    if not members:
+                        del self._by_digest[d]
+            for d in new - old:
+                self._by_digest.setdefault(d, set()).add(replica_id)
+            if new:
+                self._by_replica[replica_id] = new
+            else:
+                self._by_replica.pop(replica_id, None)
+
+    def drop(self, replica_id: str) -> None:
+        """Remove every entry for a dead/ejected replica — called the
+        moment the pool stops routing to it, so no request can be
+        steered at a corpse by a digest published before it died."""
+        self.update(replica_id, ())
+
+    def match_depths(
+        self, chain: Sequence[str]
+    ) -> Dict[str, int]:
+        """replica id → longest matched prefix depth, in BLOCKS
+        (chain index + 1). A replica advertising chain[i] holds the
+        aligned prefix of (i+1)*block tokens. Replicas matching
+        nothing are absent."""
+        depths: Dict[str, int] = {}
+        with self._lock:
+            for i, digest in enumerate(chain):
+                for rid in self._by_digest.get(digest, ()):
+                    depths[rid] = i + 1
+        return depths
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_replica)
+
+    def size(self) -> int:
+        """Distinct digests currently mapped (gauge)."""
+        with self._lock:
+            return len(self._by_digest)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "digests": len(self._by_digest),
+                "replicas": len(self._by_replica),
+            }
+
+
+def affinity_order(
+    candidates: List,
+    depths: Dict[str, int],
+    load_of: Callable[[object], float],
+    max_imbalance: float,
+    capped: Optional[List] = None,
+) -> List:
+    """Re-rank `candidates` (already in load order) by prefix
+    affinity: longest digest match first, load order within equal
+    depth, bounded by the imbalance cap — a matched replica whose
+    load exceeds min(load) + `max_imbalance` is treated as unmatched,
+    so a hot prefix spills to the coolest replicas instead of
+    starving the fleet behind one cache-warm peer. Stable: replicas
+    without a match keep their incoming (load) order, which is what
+    makes the full-fleet fallback exactly least-loaded routing.
+
+    `capped`, when given, collects the replicas whose match was
+    voided by the imbalance cap (telemetry for the affinity-capped
+    counter)."""
+    if not depths or len(candidates) <= 1:
+        return candidates
+    floor = min(load_of(r) for r in candidates)
+    cutoff = floor + max_imbalance
+
+    def effective_depth(rep) -> int:
+        d = depths.get(rep.id, 0)
+        if d > 0 and load_of(rep) > cutoff:
+            if capped is not None:
+                capped.append(rep)
+            return 0
+        return d
+
+    # stable sort: equal effective depths preserve load order
+    return sorted(candidates, key=lambda r: -effective_depth(r))

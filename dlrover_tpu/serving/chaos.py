@@ -237,6 +237,14 @@ class FaultInjector:
         with self._lock:
             return tag in self._crashed
 
+    def crashed_tags(self) -> List[str]:
+        """Tags currently crashed (probes failing). Chaos tests use
+        this to assert the routing layer holds no stale state for a
+        corpse — e.g. the fleet digest map must advertise no crashed
+        tag's prefixes."""
+        with self._lock:
+            return sorted(self._crashed)
+
     # ---- hooks (called by serving components) ---------------------------
 
     def on_engine_step(self, tag: str, step: int) -> None:

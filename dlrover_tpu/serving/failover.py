@@ -215,6 +215,14 @@ class FailoverManager:
                 breaker = self.pool.breakers.get(rep.id)
                 if breaker is not None:
                     breaker.trip()
+                # the corpse's advertised prefixes leave the fleet
+                # digest map NOW — ejection-by-engine-failure must
+                # not leave a stale affinity route the way only the
+                # breaker-open probe path used to
+                drop = getattr(self.pool, "_drop_affinity", None)
+                if drop is not None:
+                    drop(rep.id)
+                    self.pool.mark_rank_dirty()
                 if metrics is not None:
                     metrics.replica_ejected()
                 logger.warning(

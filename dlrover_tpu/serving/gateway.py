@@ -341,6 +341,12 @@ class ServingGateway:
                 active = getattr(engine, "adapter_active", None)
                 if callable(active):
                     out["adapters"]["active"] = active()
+        # fleet front door: digest-map occupancy + affinity knobs
+        # (pool backends only — a single scheduler has no fleet;
+        # same duck-typing as the blocks above)
+        rstats = getattr(self.backend, "routing_stats", None)
+        if callable(rstats):
+            out["fleet_routing"] = rstats()
         return out
 
     def _prefix_cache(self):

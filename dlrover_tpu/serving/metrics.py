@@ -113,6 +113,12 @@ class ServingMetrics:
             "_adapter_pinned",
             "_adapter_slots",
             "_adapter_active",
+            "_affinity_matched",
+            "_affinity_unmatched",
+            "_affinity_capped",
+            "_digest_map_digests",
+            "_forecast_events",
+            "_forecast_chip_demand",
         }
     )
 
@@ -214,6 +220,18 @@ class ServingMetrics:
         self._adapter_pinned = 0
         self._adapter_slots = 0
         self._adapter_active = 0
+        # fleet prefix-affinity routing: per-request placement
+        # outcomes (fed by ReplicaPool.submit) and the digest-map
+        # occupancy gauge (fed on heartbeat refresh). "capped" =
+        # the digest matched but the imbalance cap voided it.
+        self._affinity_matched = 0
+        self._affinity_unmatched = 0
+        self._affinity_capped = 0
+        self._digest_map_digests = 0
+        # predictive autoscaling: forecast hints emitted by direction
+        # (fixed label set) and the latest chip-denominated demand
+        self._forecast_events = {"up": 0, "down": 0}
+        self._forecast_chip_demand = 0
 
     # ---- ingestion -------------------------------------------------------
 
@@ -443,6 +461,39 @@ class ServingMetrics:
             self._adapter_active = int(
                 stats.get("active_requests", 0)
             )
+
+    def affinity_routed(self, matched: bool, capped: bool = False):
+        """One routed request's placement outcome: `matched` means it
+        landed on a replica advertising a digest of its prefix;
+        `capped` means a match existed but the imbalance cap spilled
+        the request to a cooler replica."""
+        with self._lock:
+            if capped:
+                self._affinity_capped += 1
+            elif matched:
+                self._affinity_matched += 1
+            else:
+                self._affinity_unmatched += 1
+
+    def set_digest_map_size(self, n: int):
+        """Distinct digests in the fleet digest map (gauge)."""
+        with self._lock:
+            self._digest_map_digests = int(n)
+
+    def forecast_emitted(self, direction: str, chips: int):
+        """One predictive scale hint left the pool: count it by
+        direction and remember the chip-denominated demand (gauge)."""
+        if direction not in ("up", "down"):
+            return
+        with self._lock:
+            self._forecast_events[direction] += 1
+            self._forecast_chip_demand = int(chips)
+
+    def ttft_quantiles(self) -> Dict[float, float]:
+        """TTFT quantiles over the sliding window — the pool's
+        telemetry publisher reads p50 from here."""
+        with self._lock:
+            return self._ttft_ms.quantiles()
 
     def update_kernel_path(self, path: str, steps: int):
         """Refresh the per-attention-body decode-step counter from the
@@ -676,6 +727,36 @@ class ServingMetrics:
         with self._lock:
             looked = self._adapter_hits + self._adapter_misses
             return self._adapter_hits / looked if looked else 0.0
+
+    @property
+    def affinity_matched(self) -> int:
+        with self._lock:
+            return self._affinity_matched
+
+    @property
+    def affinity_unmatched(self) -> int:
+        with self._lock:
+            return self._affinity_unmatched
+
+    @property
+    def affinity_capped(self) -> int:
+        with self._lock:
+            return self._affinity_capped
+
+    @property
+    def digest_map_digests(self) -> int:
+        with self._lock:
+            return self._digest_map_digests
+
+    @property
+    def forecast_events(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._forecast_events)
+
+    @property
+    def forecast_chip_demand(self) -> int:
+        with self._lock:
+            return self._forecast_chip_demand
 
     def tokens_per_sec(self, horizon_s: float = 10.0) -> float:
         """Emission rate over the trailing `horizon_s` seconds."""
@@ -1047,6 +1128,49 @@ class ServingMetrics:
                 "serving_adapter_uploads_total",
                 "Host-to-device adapter weight uploads.",
                 self._adapter_uploads,
+            )
+            counter(
+                "serving_affinity_matched_total",
+                "Requests routed to a replica advertising a digest "
+                "of their prompt prefix.",
+                self._affinity_matched,
+            )
+            counter(
+                "serving_affinity_unmatched_total",
+                "Requests routed with no usable digest match "
+                "(least-loaded fallback).",
+                self._affinity_unmatched,
+            )
+            counter(
+                "serving_affinity_capped_total",
+                "Digest matches voided by the imbalance cap (spilled "
+                "to a cooler replica).",
+                self._affinity_capped,
+            )
+            gauge(
+                "serving_fleet_digest_map_digests",
+                "Distinct prefix digests in the fleet digest map.",
+                self._digest_map_digests,
+            )
+            lines.append(
+                "# HELP serving_forecast_events_total Predictive "
+                "scale hints emitted by the demand forecast, by "
+                "direction."
+            )
+            lines.append(
+                "# TYPE serving_forecast_events_total counter"
+            )
+            for direction in ("up", "down"):
+                lines.append(
+                    f'serving_forecast_events_total'
+                    f'{{direction="{direction}"}} '
+                    f"{self._forecast_events[direction]}"
+                )
+            gauge(
+                "serving_forecast_chip_demand",
+                "Chip-denominated demand of the latest forecast "
+                "hint.",
+                self._forecast_chip_demand,
             )
         # rate gauge takes the lock itself — outside the block above
         tps = self.tokens_per_sec()

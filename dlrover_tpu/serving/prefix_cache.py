@@ -230,6 +230,30 @@ class RadixPrefixCache:
             del parent.children[node.edge]
             node = parent
 
+    # ---- enumeration -----------------------------------------------------
+
+    def published_blocks(self):
+        """Yield the block-edge path (root→node, one block tuple per
+        edge) of every PUBLISHED prefix, newest-touched first — the
+        fleet-affinity layer (serving/affinity.py) hashes these into
+        the digests a replica's heartbeat advertises. Newest-first
+        matters because the advertisement is capped: under churn the
+        digests most likely to survive until a routed request lands
+        are the ones that go out. Token data itself never leaves this
+        host-side walk; callers publish digests only."""
+        # snapshot the LRU order first: the heartbeat thread walks
+        # this while the scheduler thread publishes/evicts
+        for row in reversed(list(self._lru)):
+            node = self._row_node.get(row)
+            if node is None:  # torn iteration under churn: skip
+                continue
+            path: List[Tuple[int, ...]] = []
+            while node.parent is not None:
+                path.append(node.edge)
+                node = node.parent
+            path.reverse()
+            yield path
+
     # ---- accounting ------------------------------------------------------
 
     def record_admission(self, reused_tokens: int) -> None:

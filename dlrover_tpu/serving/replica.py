@@ -25,7 +25,13 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from dlrover_tpu.common.log import default_logger as logger
-from dlrover_tpu.master.kv_store import RetryingKV
+from dlrover_tpu.master.kv_store import PrefixDirectory, RetryingKV
+from dlrover_tpu.serving.affinity import (
+    FleetDigestMap,
+    affinity_order,
+    cache_digests,
+    prefix_digest_chain,
+)
 from dlrover_tpu.serving.failover import (
     OPEN,
     CircuitBreaker,
@@ -164,8 +170,27 @@ class InferenceReplica:
                 # cache affinity into a placement signal instead of an
                 # upload on every cross-replica bounce
                 "adapters_resident": self.adapters_resident(),
+                # blake2b digests of the block-aligned prefixes this
+                # replica's radix cache has published — the fleet
+                # router's affinity signal. Digests only: no token
+                # data leaves the replica through the control plane.
+                "prefix_digests": self.prefix_digests(),
             }
         ).encode()
+
+    def prefix_digests(self) -> List[str]:
+        """Digests of the prompt prefixes currently published in this
+        replica's radix cache (newest-touched first, capped by
+        affinity.MAX_PUBLISHED_DIGESTS); [] when the prefix cache is
+        off or the engine predates it (test doubles)."""
+        cache = getattr(self.scheduler.engine, "prefix_cache", None)
+        if cache is None or not hasattr(cache, "published_blocks"):
+            return []
+        try:
+            return cache_digests(cache)
+        # graftlint: allow(EXC-001) reason=digest advertisement is a routing hint only; a raising engine is caught by the health probe, not here
+        except Exception:  # noqa: BLE001
+            return []
 
     def adapters_resident(self) -> List[str]:
         """Adapter ids currently uploaded to this replica's device
@@ -253,7 +278,8 @@ class ReplicaPool:
     # routing through submit(force-hint path), and FailoverManager —
     # access only under self._lock (graftlint LOCK-001)
     GUARDED_FIELDS = frozenset(
-        {"_replicas", "breakers", "_last_hint_ts"}
+        {"_replicas", "breakers", "_last_hint_ts", "_ranked",
+         "_rank_dirty"}
     )
 
     def __init__(
@@ -269,6 +295,15 @@ class ReplicaPool:
         breaker_backoff_base_s: float = 0.5,
         breaker_backoff_max_s: float = 30.0,
         elastic_resize: bool = True,
+        affinity_routing: bool = True,
+        affinity_max_imbalance: float = 0.5,
+        prefix_block: Optional[int] = None,
+        directory: Optional[PrefixDirectory] = None,
+        brain_store=None,
+        job_uuid: str = "serving-fleet",
+        forecast_algorithm: str = (
+            "optimize_serving_replica_resource"
+        ),
     ):
         self.kv = kv
         # degraded-replica handling: shrink a chip-lossy replica live
@@ -297,9 +332,32 @@ class ReplicaPool:
         # prefills to this coordinator, which places them on decode
         # targets (wired as each prefill scheduler's on_handoff)
         self.handoff = HandoffCoordinator(self)
+        # fleet prefix affinity: the in-process digest→replica map
+        # submit() routes with (heartbeat-refreshed, dropped eagerly
+        # on death), plus the shared KV-backed directory other
+        # gateways pointed at the same master read
+        self.affinity_routing = affinity_routing
+        self.affinity_max_imbalance = affinity_max_imbalance
+        self.prefix_block = prefix_block
+        self.digest_map = FleetDigestMap()
+        self.directory = directory or (
+            PrefixDirectory(kv) if kv is not None else None
+        )
+        # predictive scaling: serving telemetry flows into the brain
+        # datastore each pump; the registered forecast algorithm
+        # turns the sample window into a chip-denominated hint that
+        # reaches the advisor BEFORE reactive pressure does
+        self.brain_store = brain_store
+        self.job_uuid = job_uuid
+        self.forecast_algorithm = forecast_algorithm
         self._lock = threading.Lock()
         self._replicas: Dict[str, InferenceReplica] = {}
         self._last_hint_ts = 0.0
+        # incrementally-maintained load order: submit() reads this
+        # cached ranking in O(candidates); heartbeats, membership
+        # changes, and ejections mark it dirty for re-rank
+        self._ranked: List[InferenceReplica] = []
+        self._rank_dirty = True
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -327,9 +385,12 @@ class ReplicaPool:
             and getattr(sched, "on_handoff", None) is None
         ):
             sched.on_handoff = self.handoff.on_prefill_done
+        self.mark_rank_dirty()
         replica.register()
 
     def remove(self, replica_id: str) -> Optional[InferenceReplica]:
+        self._drop_affinity(replica_id)
+        self.mark_rank_dirty()
         with self._lock:
             return self._replicas.pop(replica_id, None)
 
@@ -342,6 +403,95 @@ class ReplicaPool:
 
     # ---- routing ---------------------------------------------------------
 
+    def mark_rank_dirty(self) -> None:
+        """Invalidate the cached load ranking. Called on membership
+        changes and every heartbeat/ejection/readmission pass — the
+        events that actually move relative load — so the submit hot
+        path never pays an O(n log n) sort per request."""
+        with self._lock:
+            self._rank_dirty = True
+
+    def ranked_replicas(self) -> List[InferenceReplica]:
+        """Healthy replicas in cached ascending-load order. Re-ranks
+        lazily when the dirty flag is set; between re-ranks the order
+        may lag live load by at most one heartbeat interval, which is
+        exactly the staleness the imbalance cap and the try-each-
+        candidate admission loop already absorb. The sort itself runs
+        OUTSIDE the pool lock (load() takes scheduler locks)."""
+        with self._lock:
+            if not self._rank_dirty:
+                return [r for r in self._ranked if r.healthy]
+            live = [
+                r for r in self._replicas.values() if r.healthy
+            ]
+            self._rank_dirty = False
+        live.sort(key=lambda r: r.load())
+        with self._lock:
+            self._ranked = live
+        return list(live)
+
+    def _refresh_affinity(self, rep: InferenceReplica) -> None:
+        """Heartbeat-path digest refresh: mirror the replica's
+        published prefixes into the in-process map and the shared KV
+        directory. Directory blips are logged, never raised — the
+        fleet falls back to in-process routing."""
+        if not self.affinity_routing:
+            return
+        digests = rep.prefix_digests()
+        self.digest_map.update(rep.id, digests)
+        if self.directory is not None:
+            try:
+                self.directory.publish(rep.id, digests)
+            except Exception:  # noqa: BLE001 — master blip ≠ outage
+                logger.warning(
+                    "prefix directory publish failed for %s",
+                    rep.id, exc_info=True,
+                )
+        if self.metrics is not None:
+            setter = getattr(
+                self.metrics, "set_digest_map_size", None
+            )
+            if setter is not None:
+                setter(self.digest_map.size())
+
+    def _drop_affinity(self, replica_id: str) -> None:
+        """Eager digest eviction for a dead/removed replica: the map
+        must never hold a route to a corpse (chaos invariant — no
+        stale routes after a crash)."""
+        self.digest_map.drop(replica_id)
+        if self.directory is not None:
+            try:
+                self.directory.drop(replica_id)
+            except Exception:  # noqa: BLE001 — master blip ≠ outage
+                logger.warning(
+                    "prefix directory drop failed for %s",
+                    replica_id, exc_info=True,
+                )
+
+    def _prefix_block(self) -> int:
+        """Digest block size: the pool knob when set, else the first
+        engine's radix-cache block (all replicas share the model's
+        bucketing), else the cache default."""
+        if self.prefix_block:
+            return self.prefix_block
+        for r in self.replicas():
+            cache = getattr(
+                r.scheduler.engine, "prefix_cache", None
+            )
+            block = getattr(cache, "block", None)
+            if block:
+                self.prefix_block = int(block)
+                return self.prefix_block
+        return 16
+
+    def routing_stats(self) -> dict:
+        """Fleet-routing health block (gateway /healthz): digest-map
+        occupancy plus the routing knobs in force."""
+        out = dict(self.digest_map.stats())
+        out["affinity_routing"] = self.affinity_routing
+        out["max_imbalance"] = self.affinity_max_imbalance
+        return out
+
     def submit(
         self,
         prompt: Sequence[int],
@@ -349,20 +499,38 @@ class ReplicaPool:
         deadline_s: Optional[float] = None,
         adapter_id: Optional[str] = None,
     ) -> ServeRequest:
-        """Least-loaded routing with failover: try healthy replicas in
-        load order until one admits. Phase-aware: new requests start
-        with a prefill, so prefill-role replicas take them first
-        (decode-role replicas only receive work through the handoff
-        coordinator); with no prefill replica in the pool, colocated
-        ones serve as always, and decode-role replicas are the last
-        resort (they CAN serve end-to-end — better than a 503).
-        Adapter-aware: within each phase tier, replicas whose device
-        bank already holds `adapter_id` are tried first — residency
-        beats raw load because a hit skips the host->device upload and
-        spares a possible eviction on the colder replica."""
-        ranked = sorted(
-            self.healthy_replicas(), key=lambda r: r.load()
-        )
+        """Affinity-aware routing with failover: try healthy replicas
+        in preference order until one admits. Documented precedence,
+        outermost first:
+
+        1. PHASE tier — new requests start with a prefill, so
+           prefill-role replicas take them first (decode-role
+           replicas only receive work through the handoff
+           coordinator); with no prefill replica, colocated ones
+           serve as always, and decode-role replicas are the last
+           resort (they CAN serve end-to-end — better than a 503).
+        2. PREFIX AFFINITY — within the tier, the replica whose
+           advertised digest map holds the longest block-aligned
+           prefix of this prompt wins (a hit skips the prefill of
+           the matched tokens entirely), UNLESS its load exceeds the
+           coolest candidate's by more than `affinity_max_imbalance`
+           — the cap that keeps a hot prefix from starving the
+           fleet.
+        3. ADAPTER residency — within equal affinity depth, replicas
+           whose device bank already holds `adapter_id` are tried
+           first (residency skips the host→device upload).
+        4. LOAD — final tiebreak, from the incrementally-maintained
+           ranking (mark_rank_dirty/ranked_replicas), so the hot
+           path is O(candidates), not O(n log n) per request.
+
+        With no digest match anywhere (or affinity off) the order
+        degrades to exactly the old adapter-then-least-loaded
+        routing, and a full fleet still falls back to least-loaded
+        through the try-each-candidate loop. Routing changes WHERE a
+        request runs, never WHAT it emits — the engines are
+        deterministic, so tokens are byte-identical to an unrouted
+        oracle."""
+        ranked = self.ranked_replicas()
         candidates = (
             [r for r in ranked if r.role == "prefill"]
             or [r for r in ranked if r.role == "colocated"]
@@ -373,6 +541,24 @@ class ReplicaPool:
                 candidates,
                 key=lambda r: adapter_id not in r.adapters_resident(),
             )  # stable: load order preserved within each half
+        depths: Dict[str, int] = {}
+        capped: List[InferenceReplica] = []
+        if self.affinity_routing and len(candidates) > 1:
+            chain = prefix_digest_chain(
+                prompt, self._prefix_block()
+            )
+            if chain:
+                depths = self.digest_map.match_depths(chain)
+            if depths:
+                # stable over the adapter+load order, so affinity
+                # dominates and the earlier keys break its ties
+                candidates = affinity_order(
+                    candidates,
+                    depths,
+                    lambda r: r.load(),
+                    self.affinity_max_imbalance,
+                    capped,
+                )
         if not candidates:
             # nothing can serve: record a scale-up hint (force bypasses
             # the cooldown — an empty pool is exactly the emergency the
@@ -383,12 +569,24 @@ class ReplicaPool:
         last_err: Optional[AdmissionError] = None
         for rep in candidates:
             try:
-                return rep.scheduler.submit(
+                req = rep.scheduler.submit(
                     prompt, max_new=max_new, deadline_s=deadline_s,
                     **kw,
                 )
             except AdmissionError as e:
                 last_err = e
+                continue
+            if self.metrics is not None and self.affinity_routing:
+                routed = getattr(
+                    self.metrics, "affinity_routed", None
+                )
+                if routed is not None:
+                    routed(
+                        matched=depths.get(rep.id, 0) > 0
+                        and rep not in capped,
+                        capped=rep in capped,
+                    )
+            return req
         raise last_err
 
     # ---- health + scaling ------------------------------------------------
@@ -446,6 +644,11 @@ class ReplicaPool:
                 if self.metrics is not None:
                     self.metrics.replica_readmitted()
             rep.heartbeat()
+            # heartbeat moment = the load/digest refresh moment: the
+            # cached ranking re-sorts lazily and the affinity map
+            # mirrors the cache's current published set
+            self._refresh_affinity(rep)
+            self.mark_rank_dirty()
         else:
             breaker.record_failure()
             rep.strikes = breaker.strikes
@@ -453,6 +656,11 @@ class ReplicaPool:
                 rep.healthy = False
                 if self.metrics is not None:
                     self.metrics.replica_ejected()
+                # a dead replica's digests leave the map NOW, not at
+                # the next heartbeat — no request may be steered at
+                # a corpse by its pre-crash advertisement
+                self._drop_affinity(rep.id)
+                self.mark_rank_dirty()
                 logger.warning(
                     "replica %s ejected (breaker open, retry in "
                     "%.2fs)", rep.id, breaker.retry_in_s,
@@ -584,6 +792,157 @@ class ReplicaPool:
                 logger.exception("scale advisor failed on %s", hint)
         return hint
 
+    # ---- predictive scaling (L4 -> L7 -> L6) -----------------------------
+
+    def _chips_per_replica(self) -> int:
+        """Widest healthy mesh slice (same rule as scale_hint):
+        over-asking by a partial slice beats under-provisioning a
+        replica that cannot be placed."""
+        return max(
+            (
+                int(getattr(r.scheduler.engine, "n_chips", 1))
+                for r in self.healthy_replicas()
+            ),
+            default=1,
+        )
+
+    def publish_telemetry(self):
+        """One fleet-level RuntimeSample into the brain datastore:
+        total queue depth, aggregate pressure, warm-TTFT p50, prefix
+        hit rate, and the chip denomination (num_nodes = healthy
+        chips). The forecast algorithm reads this series newest-first
+        — the L4→L7 leg of the paper's telemetry loop. No-op without
+        a configured store."""
+        if self.brain_store is None:
+            return None
+        # local import: serving stays importable (and the routing hot
+        # path stays brain-free) when the brain layer isn't deployed
+        from dlrover_tpu.brain.datastore import RuntimeSample
+
+        reps = self.healthy_replicas()
+        queue_depth = 0
+        chips = 0
+        hits = 0
+        misses = 0
+        for r in reps:
+            tele_fn = getattr(r.scheduler, "telemetry", None)
+            if callable(tele_fn):
+                tele = tele_fn()
+            else:  # test doubles predating telemetry()
+                tele = {"queue_depth": r.scheduler.queue_depth()}
+            queue_depth += int(tele.get("queue_depth", 0))
+            chips += int(tele.get("n_chips", 1))
+            hits += int(tele.get("prefix_hits", 0))
+            misses += int(tele.get("prefix_misses", 0))
+        hit_rate = hits / (hits + misses) if hits + misses else 0.0
+        ttft_p50 = 0.0
+        tokens_per_sec = 0.0
+        m = self.metrics
+        if m is not None:
+            quant = getattr(m, "ttft_quantiles", None)
+            if quant is not None:
+                ttft_p50 = float(quant().get(0.5, 0.0))
+            tokens_per_sec = m.tokens_per_sec()
+        sample = RuntimeSample(
+            job_uuid=self.job_uuid,
+            role="serving",
+            num_nodes=chips,
+            cpu_percent=round(self.aggregate_pressure() * 100, 2),
+            samples_per_sec=tokens_per_sec,
+            queue_depth=queue_depth,
+            ttft_ms=ttft_p50,
+            cache_hit_rate=round(hit_rate, 4),
+        )
+        try:
+            self.brain_store.add_sample(sample)
+        except Exception:  # noqa: BLE001 — telemetry blip ≠ outage
+            logger.warning(
+                "brain telemetry write failed", exc_info=True
+            )
+            return None
+        return sample
+
+    def predictive_scale(self) -> Optional[dict]:
+        """Run the registered demand forecast over the serving sample
+        window and, when it disagrees with current capacity, emit a
+        chip-denominated FORECAST hint through the same KV + advisor
+        path the reactive hint takes (the advisor's hysteresis keeps
+        the two sources from flapping against each other and against
+        elastic shrink/grow). Returns the hint, or None when the
+        forecast holds. No-op without a brain store."""
+        if self.brain_store is None:
+            return None
+        from dlrover_tpu.brain.algorithms import (
+            OptimizeContext,
+            run_algorithm,
+        )
+
+        n = len(self.healthy_replicas())
+        cpr = self._chips_per_replica()
+        ctx = OptimizeContext(
+            job_uuid=self.job_uuid,
+            store=self.brain_store,
+            current={
+                "serving": {
+                    "count": n,
+                    "chips_per_replica": cpr,
+                }
+            },
+        )
+        try:
+            delta = run_algorithm(self.forecast_algorithm, ctx)
+        except Exception:  # noqa: BLE001 — forecast blip ≠ outage
+            logger.exception(
+                "serving forecast %s failed", self.forecast_algorithm
+            )
+            return None
+        if delta.empty or delta.count is None or delta.count == n:
+            return None
+        target = int(delta.count)
+        chips = (
+            int(delta.chips)
+            if getattr(delta, "chips", None)
+            else target * cpr
+        )
+        hint = {
+            "direction": "up" if target > n else "down",
+            "replicas": target,
+            "current": n,
+            "pressure": round(self.aggregate_pressure(), 4),
+            # graftlint: allow(CLOCK-001) reason=wall-clock hint ts compared across hosts by the auto-scaler's staleness check
+            "ts": time.time(),
+            "chips_per_replica": cpr,
+            "chips": chips,
+            "current_chips": n * cpr,
+            "source": "forecast",
+            "reason": delta.reason,
+        }
+        if self.kv is not None:
+            try:
+                _kv_set(
+                    self.kv, SCALE_HINT_KEY,
+                    json.dumps(hint).encode(),
+                )
+            except Exception:  # noqa: BLE001 — master blip ≠ outage
+                logger.warning(
+                    "forecast hint write failed "
+                    "(master unreachable?)", exc_info=True,
+                )
+        if self.metrics is not None:
+            emitted = getattr(
+                self.metrics, "forecast_emitted", None
+            )
+            if emitted is not None:
+                emitted(hint["direction"], chips)
+        if self.advisor is not None:
+            try:
+                self.advisor(hint)
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "scale advisor failed on forecast %s", hint
+                )
+        return hint
+
     # ---- background loop -------------------------------------------------
 
     def start(self, interval: float = 5.0):
@@ -597,6 +956,8 @@ class ReplicaPool:
                 try:
                     self.check_replicas()
                     self.scale_hint()
+                    self.publish_telemetry()
+                    self.predictive_scale()
                 except Exception:  # noqa: BLE001 — keep the pool alive
                     logger.exception("replica pool iteration failed")
 

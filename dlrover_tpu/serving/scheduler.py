@@ -380,6 +380,26 @@ class RequestScheduler:
         with self._lock:
             return len(self._waiting) / max(1, self.slo.max_queue_depth)
 
+    def telemetry(self) -> Dict[str, float]:
+        """One replica-level observation for the fleet telemetry
+        publisher (ReplicaPool.publish_telemetry): waiting/active
+        load plus the engine's prefix-cache traffic read from the
+        radix cache itself — summable across replicas, unlike the
+        shared exposition's max()-guarded copies. Zeros when the
+        cache is off."""
+        cache = getattr(self.engine, "prefix_cache", None)
+        with self._lock:
+            waiting = len(self._waiting)
+            running = len(self._running)
+        return {
+            "queue_depth": waiting,
+            "active": running,
+            "pressure": waiting / max(1, self.slo.max_queue_depth),
+            "prefix_hits": int(getattr(cache, "hits", 0)),
+            "prefix_misses": int(getattr(cache, "misses", 0)),
+            "n_chips": int(getattr(self.engine, "n_chips", 1)),
+        }
+
     def has_work(self) -> bool:
         with self._lock:
             return bool(self._waiting) or bool(self._running)

@@ -240,3 +240,97 @@ class TestDiagnosis:
         results = dm.diagnose()
         failed = [r for r in results if r.state == "failed"]
         assert failed and failed[0].evidence["node_id"] == 3
+
+
+class TestServingAdvisorHysteresis:
+    """ServingScaleAdvisor anti-flap gate: a direction FLIP within
+    hysteresis_s of the last executed move is suppressed (forecast vs
+    reactive vs elastic-regrow must not thrash the replica group);
+    same-direction moves pass freely."""
+
+    @staticmethod
+    def _advisor(clock, **kw):
+        from dlrover_tpu.master.auto_scaler import ServingScaleAdvisor
+
+        kw.setdefault("max_replicas", 8)
+        kw.setdefault("hysteresis_s", 30.0)
+        return ServingScaleAdvisor(clock=clock, **kw)
+
+    @staticmethod
+    def _hint(direction, current, target, **kw):
+        return {
+            "direction": direction,
+            "replicas": target,
+            "current": current,
+            "chips_per_replica": 2,
+            "chips": target * 2,
+            **kw,
+        }
+
+    def test_flip_within_window_is_suppressed(self):
+        t = [0.0]
+        adv = self._advisor(lambda: t[0])
+        up = adv.on_hint(self._hint("up", 2, 3))
+        assert up.node_group_resources["inference"].count == 3
+        t[0] += 5.0  # reactive down lands 5s after the forecast up
+        down = adv.on_hint(self._hint("down", 3, 2))
+        assert not down.node_group_resources
+        assert adv.suppressed_flips == 1
+        # past the window the flip is legitimate load decay
+        t[0] += 30.0
+        down = adv.on_hint(self._hint("down", 3, 2))
+        assert down.node_group_resources["inference"].count == 2
+
+    def test_same_direction_passes_freely(self):
+        t = [0.0]
+        adv = self._advisor(lambda: t[0])
+        adv.on_hint(self._hint("up", 2, 3))
+        t[0] += 1.0  # a spike that keeps growing may keep scaling
+        plan = adv.on_hint(self._hint("up", 3, 4))
+        assert plan.node_group_resources["inference"].count == 4
+        assert adv.suppressed_flips == 0
+
+    def test_clamped_no_move_does_not_arm_the_gate(self):
+        # a hint the bounds clamp away executed nothing — the next
+        # opposite-direction hint must not be treated as a flip
+        t = [0.0]
+        adv = self._advisor(lambda: t[0], max_replicas=2)
+        up = adv.on_hint(self._hint("up", 2, 5))  # clamped to 2
+        assert not up.node_group_resources
+        t[0] += 1.0
+        down = adv.on_hint(self._hint("down", 2, 1))
+        assert down.node_group_resources["inference"].count == 1
+        assert adv.suppressed_flips == 0
+
+    def test_forecast_plans_are_counted_by_source(self):
+        t = [0.0]
+        adv = self._advisor(lambda: t[0])
+        adv.on_hint(self._hint("up", 2, 3, source="forecast"))
+        t[0] += 60.0
+        adv.on_hint(self._hint("down", 3, 2))  # reactive
+        assert adv.forecast_plans == 1
+
+    def test_forecast_hint_flows_through_kv_poll(self):
+        # the pool writes forecast hints at the same KV key as the
+        # reactive path; poll_once must act on them identically
+        import json as _json
+
+        from dlrover_tpu.master.auto_scaler import ServingScaleAdvisor
+        from dlrover_tpu.master.kv_store import KVStoreService
+
+        kv = KVStoreService()
+        adv = ServingScaleAdvisor(kv_store=kv, max_replicas=8)
+        kv.set(
+            ServingScaleAdvisor.HINT_KEY,
+            _json.dumps(
+                self._hint(
+                    "up", 2, 4, source="forecast", ts=123.0
+                )
+            ).encode(),
+        )
+        plan = adv.poll_once()
+        assert plan.node_group_resources["inference"].count == 4
+        assert adv.forecast_plans == 1
+        assert adv.last_chip_demand == 8
+        # a stale (same-ts) hint is not re-acted on
+        assert adv.poll_once() is None

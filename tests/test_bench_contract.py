@@ -250,6 +250,29 @@ def test_serve_bench_smoke_emits_driver_contract():
         "n_adapters",
         "adapter_cache_slots",
         "n_adapter_requests",
+        # fleet phase: prefix-affinity routing + predictive
+        # autoscaling evidence axes
+        "fleet_hit_rate",
+        "fleet_lb_hit_rate",
+        "fleet_single_hit_rate",
+        "fleet_ttft_ms_p50",
+        "fleet_ttft_ms_p90",
+        "fleet_ttft_ms_mean",
+        "fleet_lb_ttft_ms_p50",
+        "fleet_lb_ttft_ms_p90",
+        "fleet_lb_ttft_ms_mean",
+        "fleet_parity_ok",
+        "fleet_affinity_matched",
+        "fleet_digests",
+        "fleet_replicas",
+        "fleet_tenants",
+        "n_fleet_requests",
+        "forecast_first_up_idx",
+        "forecast_peak_idx",
+        "forecast_lead_samples",
+        "forecast_chip_delta",
+        "forecast_plans",
+        "forecast_telemetry_ok",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -396,3 +419,46 @@ def test_serve_bench_smoke_emits_driver_contract():
     assert detail["adapter_uploads"] >= detail["n_adapters"]
     assert detail["n_adapters"] > detail["adapter_cache_slots"]
     assert detail["n_adapter_requests"] > 0
+    # the fleet acceptance floor: on the rotated multi-tenant
+    # shared-prefix workload, prefix-affinity routing must land
+    # within noise of the single-replica hit-rate ceiling and
+    # strictly above the least-loaded baseline (which re-prefills
+    # every tenant's system prompt on every replica it sweeps), the
+    # warm-TTFT tail and mean must beat least-loaded (cold
+    # re-prefills live in the tail), and routing must never change a
+    # byte (all passes token-identical to the unrouted oracle). The
+    # forecast leg's lock is LEAD: the advisor receives its first
+    # chip-denominated scale-up strictly before the seeded diurnal
+    # trace peaks, with real chips asked for and the plan counted
+    # under source="forecast"
+    assert (
+        detail["fleet_hit_rate"]
+        >= detail["fleet_single_hit_rate"] - 0.02
+    )
+    assert (
+        detail["fleet_hit_rate"]
+        > detail["fleet_lb_hit_rate"] + 0.1
+    )
+    assert (
+        detail["fleet_ttft_ms_p50"] < detail["fleet_lb_ttft_ms_p50"]
+    )
+    assert (
+        detail["fleet_ttft_ms_p90"] < detail["fleet_lb_ttft_ms_p90"]
+    )
+    assert (
+        detail["fleet_ttft_ms_mean"]
+        < detail["fleet_lb_ttft_ms_mean"]
+    )
+    assert detail["fleet_parity_ok"] is True
+    assert detail["fleet_affinity_matched"] >= 10
+    assert detail["fleet_digests"] >= detail["fleet_tenants"]
+    assert detail["fleet_replicas"] >= 3
+    assert detail["n_fleet_requests"] > 0
+    assert detail["forecast_lead_samples"] >= 1
+    assert (
+        detail["forecast_first_up_idx"]
+        < detail["forecast_peak_idx"]
+    )
+    assert detail["forecast_chip_delta"] >= 1
+    assert detail["forecast_plans"] >= 1
+    assert detail["forecast_telemetry_ok"] is True

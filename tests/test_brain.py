@@ -1,4 +1,4 @@
-"""Brain service: datastore, the nine optimize algorithms, gRPC
+"""Brain service: datastore, the ten optimize algorithms, gRPC
 round-trips, and the master-side adapter.
 
 Mirrors the Go brain's table-driven optalgorithm tests
@@ -39,9 +39,10 @@ def _seed_history(store, name="train-job-1", n_jobs=3):
 
 
 class TestAlgorithms:
-    def test_all_nine_registered(self):
-        assert len(ALGORITHMS) == 9
+    def test_all_ten_registered(self):
+        assert len(ALGORITHMS) == 10
         assert "optimize_job_hot_ps_resource" in ALGORITHMS
+        assert "optimize_serving_replica_resource" in ALGORITHMS
 
     def test_ps_create_uses_history(self):
         store = JobMetricsStore()
@@ -223,3 +224,168 @@ class TestPersistence:
         finally:
             client2.close()
             svc2.stop()
+
+
+class TestServingForecast:
+    """optimize_serving_replica_resource: the EWMA+slope demand
+    forecast behind the fleet's predictive autoscaling (the replica
+    pool feeds the sample window via publish_telemetry)."""
+
+    @staticmethod
+    def _seed(store, pressures, uuid="fleet", chips=4):
+        store.upsert_job(JobMeta(job_uuid=uuid, job_name="serve"))
+        for i, pr in enumerate(pressures):
+            store.add_sample(RuntimeSample(
+                job_uuid=uuid, role="serving", num_nodes=chips,
+                cpu_percent=pr * 100.0, ts=float(10 * i),
+                queue_depth=int(pr * 10), cache_hit_rate=0.5,
+            ))
+
+    @staticmethod
+    def _ctx(store, n=2, cpr=2, uuid="fleet"):
+        return OptimizeContext(
+            job_uuid=uuid, store=store,
+            current={"serving": {"count": n,
+                                 "chips_per_replica": cpr}},
+        )
+
+    def test_scales_up_before_the_spike_crosses(self):
+        # rising trend: current pressure still BELOW the 0.8
+        # threshold, but the 30s extrapolation crosses it — the
+        # whole point is to move before the reactive hint would
+        store = JobMetricsStore()
+        self._seed(store, [0.4, 0.55, 0.7])
+        d = run_algorithm(
+            "optimize_serving_replica_resource", self._ctx(store)
+        )
+        assert d.count is not None and d.count >= 3
+        assert d.chips == d.count * 2  # chip-denominated
+        assert "forecast" in d.reason
+        store.close()
+
+    def test_flat_window_holds(self):
+        store = JobMetricsStore()
+        self._seed(store, [0.5, 0.5, 0.5, 0.5])
+        d = run_algorithm(
+            "optimize_serving_replica_resource", self._ctx(store)
+        )
+        assert d.empty
+        store.close()
+
+    def test_min_window_gate(self):
+        store = JobMetricsStore()
+        self._seed(store, [0.99, 0.99])  # hot, but too few samples
+        d = run_algorithm(
+            "optimize_serving_replica_resource", self._ctx(store)
+        )
+        assert d.empty
+        store.close()
+
+    def test_scale_down_is_conservative(self):
+        # sustained low + non-rising slope → one replica down
+        store = JobMetricsStore()
+        self._seed(store, [0.1, 0.08, 0.05])
+        d = run_algorithm(
+            "optimize_serving_replica_resource",
+            self._ctx(store, n=3),
+        )
+        assert d.count == 2 and d.chips == 4
+        store.close()
+
+    def test_low_but_rising_never_scales_down(self):
+        store = JobMetricsStore()
+        self._seed(store, [0.02, 0.05, 0.09])
+        d = run_algorithm(
+            "optimize_serving_replica_resource",
+            self._ctx(store, n=3),
+        )
+        assert d.empty
+        store.close()
+
+    def test_single_replica_never_scales_down(self):
+        store = JobMetricsStore()
+        self._seed(store, [0.05, 0.03, 0.01])
+        d = run_algorithm(
+            "optimize_serving_replica_resource",
+            self._ctx(store, n=1),
+        )
+        assert d.empty
+        store.close()
+
+
+class TestServingTelemetryColumns:
+    """The three serving-only RuntimeSample columns: round-trip,
+    ALTER-migration of a pre-existing file, and the gRPC surface."""
+
+    def test_columns_round_trip(self):
+        store = JobMetricsStore()
+        store.add_sample(RuntimeSample(
+            job_uuid="j", role="serving", num_nodes=8,
+            cpu_percent=42.0, queue_depth=7, ttft_ms=12.5,
+            cache_hit_rate=0.75,
+        ))
+        s = store.samples("j", role="serving")[0]
+        assert s.queue_depth == 7
+        assert s.ttft_ms == 12.5
+        assert s.cache_hit_rate == 0.75
+        store.close()
+
+    def test_pre_serving_file_is_migrated(self, tmp_path):
+        # a db written by the pre-fleet schema (no serving columns)
+        # must open cleanly and accept the new fields
+        import sqlite3
+
+        db = str(tmp_path / "old.db")
+        conn = sqlite3.connect(db)
+        conn.execute(
+            """CREATE TABLE runtime_samples (
+                job_uuid TEXT, role TEXT, num_nodes INTEGER,
+                cpu_percent REAL, memory_mb REAL,
+                samples_per_sec REAL, global_step INTEGER, ts REAL
+            )"""
+        )
+        conn.execute(
+            "INSERT INTO runtime_samples VALUES "
+            "('old', 'worker', 2, 50.0, 1024.0, 10.0, 3, 1.0)"
+        )
+        conn.commit()
+        conn.close()
+
+        store = JobMetricsStore(db)
+        old = store.samples("old", role="worker")[0]
+        assert old.queue_depth == 0 and old.cache_hit_rate == 0.0
+        store.add_sample(RuntimeSample(
+            job_uuid="new", role="serving", queue_depth=3,
+            ttft_ms=9.0, cache_hit_rate=0.9,
+        ))
+        assert store.samples("new")[0].queue_depth == 3
+        store.close()
+
+    def test_grpc_surface_carries_serving_fields(self):
+        svc = BrainService()
+        svc.start()
+        client = BrainClient(svc.addr)
+        try:
+            client.persist_job("fleet", job_name="serve")
+            # ts is explicit (the forecast fits a slope over it);
+            # ts=0 means "stamp at receipt", so start at 1.0
+            for i, pr in enumerate((0.4, 0.55, 0.7)):
+                client.persist_sample(
+                    "fleet", "serving", num_nodes=4,
+                    cpu_percent=pr * 100.0, ts=1.0 + 10 * i,
+                    queue_depth=int(pr * 10), ttft_ms=5.0,
+                    cache_hit_rate=0.6,
+                )
+            samples = client.get_job_metrics("fleet", role="serving")
+            assert samples[0]["queue_depth"] in (4, 5, 7)
+            assert samples[0]["cache_hit_rate"] == 0.6
+            resp = client.optimize(
+                "fleet", "optimize_serving_replica_resource",
+                current={"serving": {"count": 2,
+                                     "chips_per_replica": 2}},
+            )
+            assert resp is not None and resp.count >= 3
+            assert resp.chips == resp.count * 2
+        finally:
+            client.close()
+            svc.stop()

@@ -38,6 +38,7 @@ from dlrover_tpu.analysis.rules import (
     DeviceAllocRule,
     EagerJnpImportRule,
     ElasticReshardRule,
+    FleetRoutingRule,
     HandoffAdoptionRule,
     HostCopyRule,
     JitSelfCaptureRule,
@@ -711,6 +712,101 @@ def test_adapter_rule_ignores_outside_serving(tmp_path):
         rel="dlrover_tpu/models/lora.py",
     )
     assert not hits(AdapterBankRule(), src)
+
+
+# ---------------------------------------------------------------------------
+# ROUTE-001: fleet routing decisions only in replica.py + affinity.py
+
+
+def test_route_rule_flags_adhoc_routing(tmp_path):
+    # a gateway picking its own replica from the digest map — the
+    # forked-policy footgun: two components routing the same prompt
+    # differently halves the fleet hit rate, and the private-index
+    # poke mints a route drop() can never retract
+    src = probe(
+        tmp_path,
+        """
+        def pick(pool, prompt):
+            chain = prefix_digest_chain(prompt, 16)
+            depths = pool.digest_map.match_depths(chain)
+            order = affinity_order(pool.replicas(), depths, len, 0.5)
+            pool.digest_map._by_digest["d"] = {"r1"}
+            return order[0]
+        """,
+        rel="dlrover_tpu/serving/gateway.py",
+    )
+    found = hits(FleetRoutingRule(), src)
+    assert len(found) == 4
+    assert all("replica.py" in f.message for f in found)
+
+
+def test_route_rule_allows_observation_surface(tmp_path):
+    # the sanctioned read-only surface: routing_stats()/stats() and
+    # submitting through the pool — none of it is a finding
+    src = probe(
+        tmp_path,
+        """
+        def health(pool):
+            return pool.routing_stats(), pool.digest_map.stats()
+
+        def serve(pool, prompt):
+            return pool.submit(prompt)
+        """,
+        rel="dlrover_tpu/serving/gateway.py",
+    )
+    assert not hits(FleetRoutingRule(), src)
+
+
+def test_route_rule_ignores_self_private_fields(tmp_path):
+    # FleetDigestMap's own methods touch _by_digest/_by_replica
+    # through self — that IS the map, not a bypass (mirrors the
+    # real exemption: affinity.py is an exempt file anyway, so probe
+    # the self-access case on an unlisted serving file)
+    src = probe(
+        tmp_path,
+        """
+        class Map:
+            def update(self, rid, ds):
+                self._by_replica[rid] = frozenset(ds)
+                self._by_digest.setdefault("d", set()).add(rid)
+        """,
+        rel="dlrover_tpu/serving/gateway.py",
+    )
+    assert not hits(FleetRoutingRule(), src)
+
+
+def test_route_rule_vacuous_on_owning_modules(tmp_path):
+    # the same offender impersonating the two designated owners is
+    # exempt there, flagged anywhere else in serving (vacuity guard
+    # on the exemption)
+    code = """
+    def route(pool, prompt):
+        chain = prefix_digest_chain(prompt, 16)
+        return pool.digest_map.match_depths(chain)
+    """
+    for owner in (
+        "dlrover_tpu/serving/replica.py",
+        "dlrover_tpu/serving/affinity.py",
+    ):
+        src = probe(tmp_path, code, rel=owner)
+        assert not hits(FleetRoutingRule(), src)
+    src = probe(tmp_path, code, rel=SERVING_REL)
+    assert len(hits(FleetRoutingRule(), src)) == 2
+
+
+def test_route_rule_ignores_outside_serving(tmp_path):
+    # tests/benches drive the affinity API directly by design —
+    # the rule is a serving-layer invariant only
+    src = probe(
+        tmp_path,
+        """
+        def bench(pool, prompt):
+            chain = prefix_digest_chain(prompt, 16)
+            return affinity_order(pool.replicas(), {}, len, 0.5)
+        """,
+        rel="dlrover_tpu/master/kv_store.py",
+    )
+    assert not hits(FleetRoutingRule(), src)
 
 
 # ---------------------------------------------------------------------------

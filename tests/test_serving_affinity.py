@@ -1,0 +1,817 @@
+"""Fleet front door (serving/affinity.py + ReplicaPool routing +
+master/kv_store.PrefixDirectory): digest-chain/alignment contracts,
+the digest→replica map, affinity_order's imbalance cap, the
+incrementally-maintained load ranking (parity vs a sorted oracle), a
+fuzzed routing matrix over role × adapter × prefix × load asserting
+the documented precedence, the shared KV directory, byte parity of
+routed vs unrouted tokens, and the kill-the-cache-hot-replica chaos
+invariant (no stale routes, success 1.0)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from _serve_oracle import lockstep_oracle
+from dlrover_tpu.master.kv_store import KVStoreService, PrefixDirectory
+from dlrover_tpu.serving.affinity import (
+    FleetDigestMap,
+    affinity_order,
+    cache_digests,
+    prefix_digest_chain,
+)
+from dlrover_tpu.serving.chaos import FaultInjector
+from dlrover_tpu.serving.engine import ContinuousBatcher
+from dlrover_tpu.serving.metrics import ServingMetrics
+from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
+from dlrover_tpu.serving.replica import InferenceReplica, ReplicaPool
+from dlrover_tpu.serving.scheduler import (
+    RequestScheduler,
+    RequestState,
+)
+
+from dlrover_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(), dtype=jnp.float32
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# digest chains (pure host logic)
+# ---------------------------------------------------------------------------
+
+
+class TestDigestChain:
+    def test_chain_length_floors_to_block(self):
+        toks = list(range(40))
+        assert len(prefix_digest_chain(toks, 16)) == 2  # 40 // 16
+        assert len(prefix_digest_chain(toks, 8)) == 5
+        assert prefix_digest_chain(toks[:7], 8) == []
+        assert prefix_digest_chain([], 4) == []
+
+    def test_alignment_matches_radix_cache_rule(self):
+        cache = RadixPrefixCache(4, block=16)
+        for n in (0, 7, 16, 31, 40, 64):
+            toks = list(range(n))
+            assert (
+                len(prefix_digest_chain(toks, 16)) * 16
+                == cache.aligned_len(n)
+            )
+
+    def test_shared_prefix_shares_digests_then_diverges(self):
+        rng = np.random.default_rng(0)
+        shared = rng.integers(1, 250, size=16).tolist()
+        a = prefix_digest_chain(shared + [1, 2, 3, 4], 4)
+        b = prefix_digest_chain(shared + [9, 9, 9, 9], 4)
+        assert a[:4] == b[:4]  # the shared 16 tokens, 4 blocks
+        assert a[4] != b[4]    # first divergent block
+
+    def test_chain_is_deterministic_and_hex(self):
+        toks = list(range(32))
+        c1 = prefix_digest_chain(toks, 16)
+        c2 = prefix_digest_chain(toks, 16)
+        assert c1 == c2
+        for d in c1:
+            assert len(d) == 16  # 8-byte blake2b, hex
+            int(d, 16)
+
+    def test_chaining_binds_position(self):
+        # same block content at a different position hashes
+        # differently — a chain digest names the WHOLE prefix
+        blk = [5, 6, 7, 8]
+        a = prefix_digest_chain(blk + blk, 4)
+        assert a[0] != a[1]
+
+    def test_block_below_one_raises(self):
+        with pytest.raises(ValueError):
+            prefix_digest_chain([1, 2, 3], 0)
+
+
+class TestCacheDigests:
+    def test_digests_match_prompt_chain(self):
+        cache = RadixPrefixCache(4, block=4)
+        prompt = list(range(12))
+        row, is_new = cache.insert(prompt)
+        assert is_new
+        ds = cache_digests(cache)
+        # the published 12-token prefix hashes to the LAST element of
+        # the prompt's own chain — what submit() will look up
+        assert ds == [prefix_digest_chain(prompt, 4)[-1]]
+
+    def test_newest_touched_first_and_capped(self):
+        cache = RadixPrefixCache(8, block=2)
+        pa, pb = [1, 2], [3, 4]
+        cache.insert(pa)
+        cache.insert(pb)
+        # touch pa: it becomes newest and must lead the advertisement
+        cache.match(pa)
+        ds = cache_digests(cache)
+        assert ds[0] == prefix_digest_chain(pa, 2)[-1]
+        assert len(ds) == 2
+        assert len(cache_digests(cache, limit=1)) == 1
+
+    def test_eviction_leaves_the_advertisement(self):
+        cache = RadixPrefixCache(1, block=2)
+        cache.insert([1, 2])
+        assert len(cache_digests(cache)) == 1
+        cache.insert([3, 4])  # evicts [1, 2] (single row)
+        ds = cache_digests(cache)
+        assert ds == [prefix_digest_chain([3, 4], 2)[-1]]
+
+
+# ---------------------------------------------------------------------------
+# the fleet digest map
+# ---------------------------------------------------------------------------
+
+
+class TestFleetDigestMap:
+    def test_update_replace_semantics(self):
+        m = FleetDigestMap()
+        m.update("r1", ["a", "b"])
+        m.update("r1", ["b", "c"])  # heartbeat refresh drops "a"
+        assert m.match_depths(["a"]) == {}
+        assert m.match_depths(["c"]) == {"r1": 1}
+        assert m.stats() == {"digests": 2, "replicas": 1}
+
+    def test_longest_match_wins(self):
+        m = FleetDigestMap()
+        m.update("shallow", ["d0"])
+        m.update("deep", ["d0", "d1", "d2"])
+        depths = m.match_depths(["d0", "d1", "d2"])
+        assert depths == {"shallow": 1, "deep": 3}
+
+    def test_drop_removes_every_entry(self):
+        m = FleetDigestMap()
+        m.update("r1", ["a", "b"])
+        m.update("r2", ["b"])
+        m.drop("r1")
+        assert m.replicas() == ["r2"]
+        assert m.match_depths(["a", "b"]) == {"r2": 2}
+        m.drop("r2")
+        assert m.size() == 0 and m.replicas() == []
+
+    def test_empty_update_is_drop(self):
+        m = FleetDigestMap()
+        m.update("r1", ["a"])
+        m.update("r1", [])
+        assert m.size() == 0 and m.replicas() == []
+
+
+class _Cand:
+    def __init__(self, rid, load):
+        self.id = rid
+        self._load = load
+
+    def load(self):
+        return self._load
+
+
+class TestAffinityOrder:
+    def test_no_match_preserves_load_order(self):
+        cands = [_Cand("a", 0.1), _Cand("b", 0.2), _Cand("c", 0.3)]
+        assert affinity_order(
+            cands, {}, lambda r: r.load(), 0.5
+        ) == cands
+
+    def test_deeper_match_first_load_breaks_ties(self):
+        a, b, c = _Cand("a", 0.1), _Cand("b", 0.2), _Cand("c", 0.3)
+        out = affinity_order(
+            [a, b, c], {"b": 1, "c": 2}, lambda r: r.load(), 9.0
+        )
+        assert [r.id for r in out] == ["c", "b", "a"]
+        # equal depth: incoming (load) order is preserved
+        out = affinity_order(
+            [a, b, c], {"b": 2, "c": 2}, lambda r: r.load(), 9.0
+        )
+        assert [r.id for r in out] == ["b", "c", "a"]
+
+    def test_imbalance_cap_voids_hot_match(self):
+        a, b = _Cand("cool", 0.1), _Cand("hot", 0.9)
+        capped = []
+        out = affinity_order(
+            [a, b], {"hot": 3}, lambda r: r.load(), 0.5, capped
+        )
+        # hot's match exceeds min-load + 0.5 → treated as unmatched,
+        # the cool replica keeps the request (anti-starvation)
+        assert [r.id for r in out] == ["cool", "hot"]
+        assert capped == [b]
+        # widen the cap: the match stands
+        out = affinity_order(
+            [a, b], {"hot": 3}, lambda r: r.load(), 1.0, []
+        )
+        assert [r.id for r in out] == ["hot", "cool"]
+
+
+# ---------------------------------------------------------------------------
+# the shared KV directory
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixDirectory:
+    def test_publish_snapshot_drop_roundtrip(self):
+        kv = KVStoreService()
+        d = PrefixDirectory(kv)
+        d.publish("r1", ["b", "a"])
+        d.publish("r2", ["c"])
+        assert d.snapshot() == {"r1": ["a", "b"], "r2": ["c"]}
+        d.publish("r1", ["z"])  # heartbeat refresh replaces
+        assert d.snapshot()["r1"] == ["z"]
+        d.drop("r1")
+        assert d.snapshot() == {"r2": ["c"]}
+        d.publish("r2", [])  # empty publish == drop
+        assert d.snapshot() == {}
+
+    def test_two_gateways_share_one_view(self):
+        kv = KVStoreService()
+        writer, reader = PrefixDirectory(kv), PrefixDirectory(kv)
+        writer.publish("r1", ["a"])
+        assert reader.snapshot() == {"r1": ["a"]}
+
+    def test_malformed_document_reads_empty(self):
+        kv = KVStoreService()
+        kv.set(PrefixDirectory.KEY, b"not json{")
+        d = PrefixDirectory(kv)
+        assert d.snapshot() == {}
+        d.publish("r1", ["a"])  # and publishing over it heals it
+        assert d.snapshot() == {"r1": ["a"]}
+
+
+# ---------------------------------------------------------------------------
+# pool routing over fake schedulers (deterministic, no engine)
+# ---------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    def __init__(self, role="colocated", resident=(), n_chips=1):
+        self.n_slots = 4
+        self.n_chips = n_chips
+        self.replica_role = role
+        self._resident = list(resident)
+
+    def adapter_residency(self):
+        return list(self._resident)
+
+
+class _FakeSlo:
+    max_queue_depth = 16
+    pressure_high = 0.8
+    pressure_low = 0.1
+
+
+class _FakeScheduler:
+    """Just enough scheduler for routing tests: settable pressure
+    (== replica load, active_count stays 0) and a submission log."""
+
+    def __init__(self, engine=None, pressure=0.0):
+        self.engine = engine or _FakeEngine()
+        self.load_value = pressure
+        self.crashed = False
+        self.on_failure = None
+        self.on_handoff = None
+        self.slo = _FakeSlo()
+        self._thread = None
+        self.submitted = []
+
+    def submit(
+        self, prompt, max_new=None, deadline_s=None, adapter_id=None
+    ):
+        self.submitted.append((list(prompt), adapter_id))
+        return ("req", len(self.submitted))
+
+    def queue_depth(self):
+        return 0
+
+    def active_count(self):
+        return 0
+
+    def pressure(self):
+        return self.load_value
+
+
+def _fake_pool(specs, block=4, **pool_kw):
+    """specs: list of (replica_id, load, role, resident_adapters)."""
+    pool_kw.setdefault("prefix_block", block)
+    pool = ReplicaPool(failover=False, **pool_kw)
+    reps = {}
+    for rid, load, role, resident in specs:
+        sched = _FakeScheduler(
+            _FakeEngine(role=role, resident=resident), pressure=load
+        )
+        rep = InferenceReplica(rid, sched)
+        pool.add(rep)
+        reps[rid] = rep
+    return pool, reps
+
+
+def _routed_to(pool, reps, prompt, adapter_id=None):
+    before = {
+        rid: len(r.scheduler.submitted) for rid, r in reps.items()
+    }
+    pool.submit(prompt, adapter_id=adapter_id)
+    hit = [
+        rid
+        for rid, r in reps.items()
+        if len(r.scheduler.submitted) > before[rid]
+    ]
+    assert len(hit) == 1
+    return hit[0]
+
+
+class TestRankedReplicas:
+    def test_parity_with_sorted_oracle(self):
+        rng = np.random.default_rng(3)
+        specs = [
+            (f"r{i}", float(rng.uniform(0, 2)), "colocated", ())
+            for i in range(6)
+        ]
+        pool, reps = _fake_pool(specs)
+        ranked = pool.ranked_replicas()
+        oracle = sorted(reps.values(), key=lambda r: r.load())
+        assert [r.id for r in ranked] == [r.id for r in oracle]
+
+    def test_rank_is_cached_until_dirty(self):
+        pool, reps = _fake_pool(
+            [("a", 0.1, "colocated", ()), ("b", 0.5, "colocated", ())]
+        )
+        assert [r.id for r in pool.ranked_replicas()] == ["a", "b"]
+        # load moved but no rank-moving event fired: cached order
+        reps["a"].scheduler.load_value = 2.0
+        assert [r.id for r in pool.ranked_replicas()] == ["a", "b"]
+        # the heartbeat/membership path marks dirty → re-rank
+        pool.mark_rank_dirty()
+        assert [r.id for r in pool.ranked_replicas()] == ["b", "a"]
+
+    def test_rank_refreshes_on_health_round(self):
+        pool, reps = _fake_pool(
+            [("a", 0.1, "colocated", ()), ("b", 0.5, "colocated", ())]
+        )
+        pool.ranked_replicas()
+        reps["a"].scheduler.load_value = 2.0
+        pool.check_replicas()  # heartbeat pass marks dirty
+        assert [r.id for r in pool.ranked_replicas()] == ["b", "a"]
+
+    def test_unhealthy_filtered_from_cached_rank(self):
+        pool, reps = _fake_pool(
+            [("a", 0.1, "colocated", ()), ("b", 0.5, "colocated", ())]
+        )
+        pool.ranked_replicas()
+        reps["a"].healthy = False  # between dirty marks
+        assert [r.id for r in pool.ranked_replicas()] == ["b"]
+
+
+class TestRoutingPrecedence:
+    def test_least_loaded_without_any_signal(self):
+        pool, reps = _fake_pool(
+            [
+                ("hot", 1.0, "colocated", ()),
+                ("cool", 0.1, "colocated", ()),
+            ]
+        )
+        assert _routed_to(pool, reps, list(range(8))) == "cool"
+
+    def test_affinity_beats_load_within_cap(self):
+        pool, reps = _fake_pool(
+            [
+                ("warm", 0.3, "colocated", ()),
+                ("cool", 0.1, "colocated", ()),
+            ],
+            affinity_max_imbalance=0.5,
+        )
+        prompt = list(range(8))
+        pool.digest_map.update(
+            "warm", [prefix_digest_chain(prompt, 4)[-1]]
+        )
+        assert _routed_to(pool, reps, prompt) == "warm"
+
+    def test_imbalance_cap_spills_to_coolest(self):
+        pool, reps = _fake_pool(
+            [
+                ("warm", 0.9, "colocated", ()),
+                ("cool", 0.1, "colocated", ()),
+            ],
+            affinity_max_imbalance=0.5,
+        )
+        prompt = list(range(8))
+        pool.digest_map.update(
+            "warm", [prefix_digest_chain(prompt, 4)[-1]]
+        )
+        assert _routed_to(pool, reps, prompt) == "cool"
+
+    def test_affinity_beats_adapter_residency(self):
+        pool, reps = _fake_pool(
+            [
+                ("cached", 0.2, "colocated", ()),
+                ("resident", 0.1, "colocated", ("lora-a",)),
+            ]
+        )
+        prompt = list(range(8))
+        pool.digest_map.update(
+            "cached", [prefix_digest_chain(prompt, 4)[-1]]
+        )
+        assert (
+            _routed_to(pool, reps, prompt, adapter_id="lora-a")
+            == "cached"
+        )
+
+    def test_adapter_breaks_equal_depth_ties(self):
+        pool, reps = _fake_pool(
+            [
+                ("plain", 0.1, "colocated", ()),
+                ("resident", 0.2, "colocated", ("lora-a",)),
+            ]
+        )
+        d = prefix_digest_chain(list(range(8)), 4)[-1]
+        pool.digest_map.update("plain", [d])
+        pool.digest_map.update("resident", [d])
+        assert (
+            _routed_to(
+                pool, reps, list(range(8)), adapter_id="lora-a"
+            )
+            == "resident"
+        )
+
+    def test_phase_tier_beats_affinity(self):
+        # a colocated replica's digest match cannot pull a new
+        # request away from the prefill tier
+        pool, reps = _fake_pool(
+            [
+                ("pf", 0.5, "prefill", ()),
+                ("co", 0.0, "colocated", ()),
+            ]
+        )
+        prompt = list(range(8))
+        pool.digest_map.update(
+            "co", [prefix_digest_chain(prompt, 4)[-1]]
+        )
+        assert _routed_to(pool, reps, prompt) == "pf"
+
+    def test_short_prompt_routes_least_loaded(self):
+        # below one block there is no chain: pure load routing
+        pool, reps = _fake_pool(
+            [
+                ("a", 0.5, "colocated", ()),
+                ("b", 0.1, "colocated", ()),
+            ]
+        )
+        pool.digest_map.update("a", ["whatever"])
+        assert _routed_to(pool, reps, [1, 2]) == "b"
+
+    def test_affinity_off_knob(self):
+        pool, reps = _fake_pool(
+            [
+                ("warm", 0.3, "colocated", ()),
+                ("cool", 0.1, "colocated", ()),
+            ],
+            affinity_routing=False,
+        )
+        prompt = list(range(8))
+        pool.digest_map.update(
+            "warm", [prefix_digest_chain(prompt, 4)[-1]]
+        )
+        assert _routed_to(pool, reps, prompt) == "cool"
+
+    def test_metrics_counters(self):
+        m = ServingMetrics()
+        pool, reps = _fake_pool(
+            [
+                ("warm", 0.3, "colocated", ()),
+                ("cool", 0.1, "colocated", ()),
+            ],
+            metrics=m,
+        )
+        prompt = list(range(8))
+        pool.digest_map.update(
+            "warm", [prefix_digest_chain(prompt, 4)[-1]]
+        )
+        pool.submit(prompt)          # matched
+        pool.submit([99] * 8)        # unmatched
+        assert m.affinity_matched == 1
+        assert m.affinity_unmatched == 1
+        text = m.render()
+        assert "serving_affinity_matched_total 1" in text
+        assert "serving_affinity_unmatched_total 1" in text
+
+    def test_routing_stats_surface(self):
+        pool, reps = _fake_pool([("a", 0.1, "colocated", ())])
+        pool.digest_map.update("a", ["d0", "d1"])
+        stats = pool.routing_stats()
+        assert stats["digests"] == 2 and stats["replicas"] == 1
+        assert stats["affinity_routing"] is True
+
+
+class TestFuzzedRoutingMatrix:
+    """role × adapter × prefix × load fuzz: every draw must obey the
+    documented precedence (phase > affinity-within-cap > adapter >
+    load), checked against an independent restatement of the rules."""
+
+    def _oracle(self, pool, reps, prompt, adapter_id):
+        live = sorted(
+            [r for r in reps.values() if r.healthy],
+            key=lambda r: r.load(),
+        )
+        cands = (
+            [r for r in live if r.role == "prefill"]
+            or [r for r in live if r.role == "colocated"]
+            or live
+        )
+        if adapter_id is not None and len(cands) > 1:
+            cands = sorted(
+                cands,
+                key=lambda r: adapter_id
+                not in r.adapters_resident(),
+            )
+        chain = prefix_digest_chain(prompt, 4)
+        depths = (
+            pool.digest_map.match_depths(chain) if chain else {}
+        )
+        if depths and len(cands) > 1:
+            floor = min(r.load() for r in cands)
+            cutoff = floor + pool.affinity_max_imbalance
+
+            def eff(r):
+                d = depths.get(r.id, 0)
+                return 0 if d and r.load() > cutoff else d
+
+            cands = sorted(cands, key=lambda r: -eff(r))
+        return cands[0].id
+
+    def test_fuzz_against_precedence_oracle(self):
+        rng = np.random.default_rng(42)
+        shared = rng.integers(1, 250, size=12).tolist()
+        for trial in range(60):
+            n = int(rng.integers(2, 5))
+            roles = rng.choice(
+                ["colocated", "prefill"], size=n,
+                p=[0.8, 0.2],
+            )
+            specs = []
+            for i in range(n):
+                resident = (
+                    ("lora-a",) if rng.random() < 0.4 else ()
+                )
+                # distinct loads: ties would make the winner depend
+                # on dict order, which the oracle can't restate
+                load = round(0.1 * i + float(rng.random()) / 20, 4)
+                specs.append(
+                    (f"r{i}", load, str(roles[i]), resident)
+                )
+            pool, reps = _fake_pool(
+                specs,
+                affinity_max_imbalance=float(
+                    rng.choice([0.1, 0.5, 2.0])
+                ),
+            )
+            # warm a random subset of replicas at random depths
+            for rid in reps:
+                if rng.random() < 0.5:
+                    depth = int(rng.integers(1, 4))
+                    pool.digest_map.update(
+                        rid,
+                        [
+                            prefix_digest_chain(shared, 4)[
+                                depth - 1
+                            ]
+                        ],
+                    )
+            tail = rng.integers(1, 250, size=4).tolist()
+            prompt = (
+                shared + tail
+                if rng.random() < 0.7
+                else rng.integers(1, 250, size=6).tolist()
+            )
+            adapter = "lora-a" if rng.random() < 0.5 else None
+            want = self._oracle(pool, reps, prompt, adapter)
+            got = _routed_to(pool, reps, prompt, adapter)
+            assert got == want, (
+                f"trial {trial}: routed {got}, precedence says "
+                f"{want} (specs={specs})"
+            )
+
+    def test_full_fleet_fallback_is_least_loaded(self):
+        # saturate the preferred replica: the admission loop must
+        # walk the rest of the fleet in load order
+        pool, reps = _fake_pool(
+            [
+                ("warm", 0.2, "colocated", ()),
+                ("next", 0.3, "colocated", ()),
+                ("last", 0.5, "colocated", ()),
+            ]
+        )
+        prompt = list(range(8))
+        pool.digest_map.update(
+            "warm", [prefix_digest_chain(prompt, 4)[-1]]
+        )
+
+        from dlrover_tpu.serving.scheduler import AdmissionError
+
+        def full(*a, **kw):
+            raise AdmissionError("full")
+
+        reps["warm"].scheduler.submit = full
+        assert _routed_to(pool, reps, prompt) == "next"
+
+
+# ---------------------------------------------------------------------------
+# heartbeat → digest-map flow (fake caches, real pool plumbing)
+# ---------------------------------------------------------------------------
+
+
+class TestHeartbeatDigestFlow:
+    def test_health_round_publishes_and_ejection_drops(self):
+        kv = KVStoreService()
+        pool, reps = _fake_pool(
+            [
+                ("warm", 0.1, "colocated", ()),
+                ("cold", 0.2, "colocated", ()),
+            ],
+            kv=kv,
+            max_strikes=1,
+        )
+        cache = RadixPrefixCache(4, block=4)
+        cache.insert(list(range(8)))
+        reps["warm"].scheduler.engine.prefix_cache = cache
+        pool.check_replicas()
+        d = prefix_digest_chain(list(range(8)), 4)[-1]
+        assert pool.digest_map.match_depths([d]) == {"warm": 1}
+        # the shared directory mirrors the advertisement
+        assert PrefixDirectory(kv).snapshot()["warm"] == [d]
+        # ejection drops both views eagerly
+        reps["warm"].scheduler.queue_depth = _raise
+        pool.check_replicas()
+        assert not reps["warm"].healthy
+        assert pool.digest_map.match_depths([d]) == {}
+        assert "warm" not in PrefixDirectory(kv).snapshot()
+
+    def test_remove_drops_digests(self):
+        pool, reps = _fake_pool(
+            [("a", 0.1, "colocated", ())]
+        )
+        pool.digest_map.update("a", ["d"])
+        pool.remove("a")
+        assert pool.digest_map.size() == 0
+
+
+def _raise():
+    raise RuntimeError("probe down")
+
+
+# ---------------------------------------------------------------------------
+# engine-level: byte parity + chaos (tiny model)
+# ---------------------------------------------------------------------------
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("pad_id", -1)
+    kw.setdefault("prefix_cache_rows", 4)
+    kw.setdefault("prefix_block", 4)
+    return ContinuousBatcher(cfg, params, **kw)
+
+
+def _drive(reps, max_iters=400):
+    for _ in range(max_iters):
+        busy = False
+        for r in reps:
+            busy = r.scheduler.pump() or busy
+        if not busy:
+            return
+    raise AssertionError("pool did not drain")
+
+
+def _make_pool(cfg, params, n=2, fi=None, **pool_kw):
+    metrics = ServingMetrics()
+    pool = ReplicaPool(metrics=metrics, **pool_kw)
+    reps = []
+    for i in range(n):
+        tag = f"replica-{i}"
+        ekw = {}
+        if fi is not None:
+            ekw = {"chaos": fi, "chaos_tag": tag}
+        eng = _engine(cfg, params, **ekw)
+        sched = RequestScheduler(eng, metrics=metrics)
+        rep = InferenceReplica(tag, sched, chaos=fi)
+        pool.add(rep)
+        reps.append(rep)
+    return pool, reps, metrics
+
+
+def _tenant_prompts(seed=0, n_tenants=2, per_tenant=3):
+    """Multi-tenant shape: each tenant shares a 12-token system
+    prompt; tails stay SHORTER than the digest block (4) so the
+    block-aligned published prefix is exactly the shared prompt —
+    the same alignment trick test_serving_prefix_cache uses."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for t in range(n_tenants):
+        shared = rng.integers(1, 250, size=12).tolist()
+        for _ in range(per_tenant):
+            out.append(shared + rng.integers(1, 250, size=2).tolist())
+    return out
+
+
+class TestRoutedByteParity:
+    def test_routing_never_changes_tokens(self, model):
+        # routing changes WHERE a request runs, never WHAT it emits:
+        # every routed continuation must match the unrouted lockstep
+        # oracle byte for byte
+        cfg, params = model
+        pool, reps, _ = _make_pool(cfg, params, n=2)
+        prompts = _tenant_prompts(seed=5)
+        reqs = []
+        for p in prompts:
+            reqs.append((p, pool.submit(p, max_new=6)))
+            pool.check_replicas()  # heartbeat → digests → affinity
+        _drive(reps)
+        for p, r in reqs:
+            assert r.state is RequestState.DONE
+            assert r.tokens == lockstep_oracle(
+                cfg, params, p, 6, max_len=64
+            )
+        pool.stop()
+
+    def test_affinity_concentrates_a_tenant(self, model):
+        # after the first wave heartbeats, a tenant's repeat traffic
+        # lands on the replica that cached its system prompt — the
+        # fleet-level hit the digest map exists to create
+        cfg, params = model
+        pool, reps, _ = _make_pool(cfg, params, n=2)
+        shared = _tenant_prompts(seed=7, n_tenants=1, per_tenant=1)[
+            0
+        ][:12]
+        first = pool.submit(shared + [1, 2], max_new=4)
+        _drive(reps)
+        assert first.state is RequestState.DONE
+        pool.check_replicas()  # advertise the published prefix
+        owner = [
+            r for r in reps if r.scheduler.engine.prefix_cache.misses
+        ][0]
+        hits_before = owner.scheduler.engine.prefix_cache.hits
+        second = pool.submit(shared + [9, 9], max_new=4)
+        _drive(reps)
+        assert second.state is RequestState.DONE
+        assert (
+            owner.scheduler.engine.prefix_cache.hits > hits_before
+        ), "repeat tenant traffic missed the cache-warm replica"
+        pool.stop()
+
+
+class TestChaosKillCacheHotReplica:
+    def test_no_stale_routes_and_success_one(self, model):
+        # kill the cache-hot replica mid-workload: the digest map
+        # must drop its entries the moment the breaker opens (no
+        # request may chase a pre-crash advertisement) and every
+        # in-flight + subsequent request still completes (failover
+        # re-admits on the survivor) — success rate 1.0
+        cfg, params = model
+        fi = FaultInjector(seed=11)
+        pool, reps, _ = _make_pool(
+            cfg, params, n=2, fi=fi, max_strikes=1
+        )
+        shared = _tenant_prompts(
+            seed=13, n_tenants=1, per_tenant=1
+        )[0][:12]
+        warm = pool.submit(shared + [1, 2], max_new=4)
+        _drive(reps)
+        pool.check_replicas()
+        hot = [
+            r
+            for r in reps
+            if r.scheduler.engine.prefix_cache.misses > 0
+        ][0]
+        assert hot.id in pool.digest_map.replicas()
+        fi.crash_replica(hot.chaos_tag, at_step=1)
+        wave = [
+            pool.submit(shared + [t, t], max_new=4)
+            for t in (5, 6, 7)
+        ]
+        _drive(reps)
+        pool.check_replicas()  # probes fail → breaker opens
+        assert not hot.healthy
+        assert fi.crashed_tags() == [hot.chaos_tag]
+        # the chaos invariant: no stale routes to the corpse
+        assert hot.id not in pool.digest_map.replicas()
+        chain = prefix_digest_chain(shared, 4)
+        assert hot.id not in pool.digest_map.match_depths(chain)
+        # post-crash traffic routes and completes on the survivor
+        late = pool.submit(shared + [8, 8], max_new=4)
+        _drive(reps)
+        done = [warm, *wave, late]
+        assert all(r.state is RequestState.DONE for r in done), [
+            r.state for r in done
+        ]
+        for r in done:
+            assert r.tokens == lockstep_oracle(
+                cfg, params, list(map(int, r.prompt)), 4, max_len=64
+            )
+        pool.stop()
