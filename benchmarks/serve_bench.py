@@ -1567,6 +1567,272 @@ def main():
         else -1
     )
 
+    # ---- phase 13: priority tiers + preemption, trace-driven ----------
+    # Two legs. (a) Preempt showcase: batch-tier work fills every slot
+    # of a one-replica scheduler, then a latency-tier arrival lands —
+    # admission preemption MUST fire (deterministically, not
+    # trace-luck), and the evicted victim must finish byte-identical
+    # to an undisturbed run (resume-by-replay). (b) Trace replay: a
+    # seeded diurnal multi-turn workload (serving/workload.py) drives
+    # a 3-replica pool three ways — the tiered mixed replay, a
+    # latency-only solo replay (whole sessions, so prompt chains stay
+    # intact: the interference-free TTFT baseline), and an untiered
+    # oracle replay (the byte oracle: tier labels change WHEN a
+    # request decodes, never WHAT it emits). Locks: >=1 preemption
+    # with byte parity, mixed-vs-solo latency p99 TTFT within a
+    # bounded multiple, success rate 1.0 (nothing shed, nothing
+    # failed), and the trace's own arrival-count series pushed
+    # through predictive_scale must produce a chip-denominated
+    # up-hint BEFORE the arrival peak — the generator feeding the
+    # PR 13 forecast loop end-to-end.
+    from dlrover_tpu.serving.workload import (
+        SessionBook,
+        WorkloadConfig,
+        generate_trace,
+    )
+
+    trng = np.random.default_rng(13)
+    tp_prompts = [
+        trng.integers(
+            1, min(500, pcfg.vocab_size), size=n
+        ).tolist()
+        for n in (12, 9, 7)
+    ]
+    tp_oracle_eng = ContinuousBatcher(
+        pcfg, pparams, n_slots=3, max_len=p_max_len,
+        max_new_tokens=p_max_new, chunk=p_chunk, pad_id=-1,
+    )
+    tp_want = [
+        list(map(int, o))
+        for o in tp_oracle_eng.generate_all(tp_prompts)
+    ]
+    tp_metrics = ServingMetrics()
+    tp_sched = RequestScheduler(
+        ContinuousBatcher(
+            pcfg, pparams, n_slots=2, max_len=p_max_len,
+            max_new_tokens=p_max_new, chunk=p_chunk, pad_id=-1,
+        ),
+        SloConfig(
+            max_queue_depth=8,
+            max_new_tokens=p_max_new,
+            default_deadline_s=600.0,
+        ),
+        metrics=tp_metrics,
+    )
+    tp_batch = [
+        tp_sched.submit(
+            p, max_new=p_max_new, deadline_s=600.0, tier="batch"
+        )
+        for p in tp_prompts[:2]
+    ]
+    tp_sched.pump()  # both batch requests now occupy the two slots
+    tp_lat = tp_sched.submit(
+        tp_prompts[2], max_new=p_max_new, deadline_s=600.0,
+        tier="latency",
+    )
+    tp_sched.run_to_completion()
+    tier_showcase_preemptions = tp_metrics.tier_preempted_total[
+        "batch"
+    ]
+    tier_preempt_parity_ok = (
+        tier_showcase_preemptions >= 1
+        and sum(r.preemptions for r in tp_batch) >= 1
+        and [r.tokens for r in tp_batch] == tp_want[:2]
+        and tp_lat.tokens == tp_want[2]
+        and all(r.state.value == "done" for r in tp_batch)
+    )
+
+    tier_cfg = WorkloadConfig(
+        seed=13,
+        horizon_s=40.0,
+        base_rate=0.5,
+        burst_amplitude=0.9,
+        period_s=40.0,
+        turns_lo=1,
+        turns_hi=3,
+        think_time_s=3.0,
+        user_tokens_lo=4,
+        user_tokens_hi=10,
+        max_new_lo=4,
+        max_new_hi=p_max_new,
+        long_context_prob=0.1,
+        long_context_tokens=64,
+        system_prompt_tokens=8,
+        vocab=min(500, pcfg.vocab_size),
+        max_prompt_tokens=min(256, p_max_len - p_max_new - 1),
+        latency_frac=0.5,
+        batch_frac=0.25,
+        # deadlines are NOT the phase's subject (wall-clock deadlines
+        # on a CPU smoke would measure the host, not the policy):
+        # generous bounds, and the success-rate lock proves nothing
+        # shed anyway
+        latency_deadline_s=600.0,
+        standard_deadline_s=600.0,
+        batch_deadline_s=600.0,
+    )
+    tier_trace = generate_trace(tier_cfg)
+    tier_slo = SloConfig(
+        max_queue_depth=len(tier_trace.events) + 4,
+        max_new_tokens=p_max_new,
+        default_deadline_s=600.0,
+    )
+
+    def _tier_replay(tiered, sessions=None):
+        """Replay the trace through a 3-replica pool: submit every
+        event whose session context is ready (SessionBook defers
+        turn k+1 until turn k's reply lands — a chat client cannot
+        type ahead of the stream), pump all replicas, fold replies
+        back. `sessions` filters WHOLE sessions (latency-solo leg);
+        `tiered=False` strips the labels (the untiered oracle).
+        Returns ((session, turn) -> request, metrics, pool)."""
+        rmetrics = ServingMetrics()
+        rpool = ReplicaPool(metrics=rmetrics)
+        rreps = []
+        for i in range(3):
+            rsched = RequestScheduler(
+                ContinuousBatcher(
+                    pcfg, pparams, n_slots=p_slots,
+                    max_len=p_max_len, max_new_tokens=p_max_new,
+                    chunk=p_chunk, pad_id=-1,
+                ),
+                tier_slo,
+                metrics=rmetrics,
+            )
+            rrep = InferenceReplica(f"tier-{i}", rsched)
+            rpool.add(rrep)
+            rreps.append(rrep)
+        book = SessionBook(tier_trace)
+        todo = [
+            ev
+            for ev in tier_trace.events
+            if sessions is None or ev.session in sessions
+        ]
+        live, out = {}, {}
+        for _ in range(100_000):
+            if not todo and not live:
+                return out, rmetrics, rpool
+            for ev in list(todo):
+                if book.ready(ev):
+                    r = rpool.submit(
+                        book.prompt_for(ev).tolist(),
+                        max_new=ev.max_new,
+                        deadline_s=ev.deadline_s,
+                        tier=ev.tier if tiered else None,
+                    )
+                    live[id(r)] = (ev, r)
+                    out[(ev.session, ev.turn)] = r
+                    todo.remove(ev)
+            for rrep in rreps:
+                rrep.scheduler.pump()
+            for key, (ev, r) in list(live.items()):
+                if r.state.value in ("done", "shed", "failed"):
+                    if r.state.value == "done":
+                        book.record_reply(ev, list(r.tokens))
+                    else:
+                        # a dead turn orphans the rest of its
+                        # session's chain — drop those events
+                        todo = [
+                            e
+                            for e in todo
+                            if e.session != ev.session
+                        ]
+                    del live[key]
+        raise AssertionError("tier replay did not drain")
+
+    tier_lat_sessions = {
+        ev.session
+        for ev in tier_trace.events
+        if ev.tier == "latency"
+    }
+    tier_mixed, tier_mixed_metrics, tier_pool = _tier_replay(
+        tiered=True
+    )
+    tier_solo, _solo_m, _solo_p = _tier_replay(
+        tiered=True, sessions=tier_lat_sessions
+    )
+    tier_oracle, _orc_m, _orc_p = _tier_replay(tiered=False)
+
+    tier_parity_ok = all(
+        list(r.tokens) == list(tier_oracle[key].tokens)
+        for key, r in tier_mixed.items()
+    ) and all(
+        list(r.tokens) == list(tier_mixed[key].tokens)
+        for key, r in tier_solo.items()
+    )
+    tier_reqs = list(tier_mixed.values())
+    tier_success_rate = sum(
+        1 for r in tier_reqs if r.state.value == "done"
+    ) / max(len(tier_reqs), 1)
+
+    def _tier_ttfts(out):
+        byturn = {
+            (ev.session, ev.turn): ev for ev in tier_trace.events
+        }
+        return sorted(
+            (r.first_token_ts - r.submit_ts) * 1000.0
+            for key, r in out.items()
+            if byturn[key].tier == "latency"
+            and r.first_token_ts is not None
+        )
+
+    tier_mixed_ttfts = _tier_ttfts(tier_mixed)
+    tier_solo_ttfts = _tier_ttfts(tier_solo)
+    tier_ttft_ratio = pct(tier_mixed_ttfts, 0.99) / max(
+        pct(tier_solo_ttfts, 0.99), 1e-9
+    )
+    tier_preemptions_total = tier_showcase_preemptions + int(
+        tier_mixed_metrics.tier_preempted_total["batch"]
+    )
+    tier_event_counts = {
+        t: sum(1 for ev in tier_trace.events if ev.tier == t)
+        for t in ("latency", "standard", "batch")
+    }
+
+    # forecast leg: the generator's OWN arrival-count series (the
+    # diurnal sinusoid it promises) replayed into the brain store with
+    # explicit virtual timestamps; predictive_scale must hint UP
+    # strictly before the arrival peak — lead time, not hindsight.
+    # The replay trace above is miniaturized for CPU runtime and too
+    # sparse for a slope fit, so the telemetry leg reads a
+    # production-scale day from the SAME config: longer horizon, more
+    # sessions, identical diurnal shape.
+    import dataclasses as _dc
+
+    tier_ftrace = generate_trace(
+        _dc.replace(
+            tier_cfg, horizon_s=240.0, period_s=240.0, base_rate=2.0
+        )
+    )
+    tier_counts = tier_ftrace.arrival_counts(24)
+    t_maxc = max(tier_counts)
+    tier_peak_idx = max(
+        range(len(tier_counts)), key=lambda i: tier_counts[i]
+    )
+    tadvisor = ServingScaleAdvisor(max_replicas=8)
+    tier_pool.advisor = tadvisor.on_hint
+    tstore = JobMetricsStore()
+    tier_pool.brain_store = tstore
+    tier_first_up_idx = -1
+    for i, c in enumerate(tier_counts):
+        t_pr = c / max(t_maxc, 1)
+        tstore.add_sample(
+            RuntimeSample(
+                job_uuid=tier_pool.job_uuid,
+                role="serving",
+                num_nodes=3,
+                cpu_percent=t_pr * 100.0,
+                ts=10.0 * i,
+                queue_depth=int(c),
+            )
+        )
+        t_hint = tier_pool.predictive_scale()
+        if (
+            t_hint is not None
+            and t_hint["direction"] == "up"
+            and tier_first_up_idx < 0
+        ):
+            tier_first_up_idx = i
+
     print(
         json.dumps(
             {
@@ -1823,6 +2089,65 @@ def main():
                     "forecast_chip_delta": forecast_chip_delta,
                     "forecast_plans": int(fadvisor.forecast_plans),
                     "forecast_telemetry_ok": forecast_telemetry_ok,
+                    # tier phase: priority tiers + preemption under
+                    # the trace-driven workload evidence axes
+                    "tier_preemptions": int(tier_preemptions_total),
+                    "tier_showcase_preemptions": int(
+                        tier_showcase_preemptions
+                    ),
+                    "tier_preempt_parity_ok": tier_preempt_parity_ok,
+                    "tier_parity_ok": tier_parity_ok,
+                    "tier_success_rate": round(
+                        tier_success_rate, 3
+                    ),
+                    "tier_latency_solo_ttft_p99_ms": round(
+                        pct(tier_solo_ttfts, 0.99), 2
+                    ),
+                    "tier_latency_mixed_ttft_p99_ms": round(
+                        pct(tier_mixed_ttfts, 0.99), 2
+                    ),
+                    "tier_latency_ttft_p99_ratio": round(
+                        tier_ttft_ratio, 3
+                    ),
+                    "tier_shed_total": int(
+                        tier_mixed_metrics.shed_total
+                    ),
+                    "tier_escalations": int(
+                        sum(
+                            tier_mixed_metrics
+                            .tier_escalated_total.values()
+                        )
+                    ),
+                    "n_tier_latency": tier_event_counts["latency"],
+                    "n_tier_standard": tier_event_counts[
+                        "standard"
+                    ],
+                    "n_tier_batch": tier_event_counts["batch"],
+                    "trace_events": len(tier_trace.events),
+                    "trace_sessions": tier_trace.n_sessions,
+                    "trace_multi_turn_sessions": len(
+                        {
+                            ev.session
+                            for ev in tier_trace.events
+                            if ev.n_turns > 1
+                        }
+                    ),
+                    "trace_long_context_sessions": len(
+                        {
+                            ev.session
+                            for ev in tier_trace.events
+                            if ev.long_context
+                        }
+                    ),
+                    "trace_forecast_first_up_idx": (
+                        tier_first_up_idx
+                    ),
+                    "trace_forecast_peak_idx": tier_peak_idx,
+                    "trace_forecast_lead_buckets": (
+                        tier_peak_idx - tier_first_up_idx
+                        if tier_first_up_idx >= 0
+                        else -1
+                    ),
                 },
             }
         ),

@@ -1277,6 +1277,82 @@ class FleetRoutingRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# TIER-001: admission preemption only in scheduler.py + paged_kv.py
+
+
+SCHEDULER_FILE = SERVING_PREFIX + "scheduler.py"
+_PREEMPT_EXEMPT = (SCHEDULER_FILE, PAGED_KV_FILE)
+
+# the admission-preemption API owned by serving/scheduler.py: the
+# decision to evict a running request so a latency-tier arrival can
+# admit. Distinct from the engine's memory-pressure preempt-and-swap
+# (_preempt_slot — a page-pool survival move, not a policy): tier
+# policy lives in the scheduler, and only the scheduler may trade one
+# request's slot for another's admission.
+_PREEMPT_CALLS = frozenset(
+    {
+        "_preempt_for_admission_locked",
+        "preempt_for_admission",
+    }
+)
+
+
+def preemption_sites(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, what) for every admission-preemption call (bare name
+    or any attribute spelling, e.g. sched._preempt_for_admission_locked)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _PREEMPT_CALLS:
+            out.append((node.lineno, f"{f.id}(...)"))
+        elif (
+            isinstance(f, ast.Attribute) and f.attr in _PREEMPT_CALLS
+        ):
+            out.append((node.lineno, f"{ast.unparse(f)}(...)"))
+    return out
+
+
+class TierPreemptionRule(Rule):
+    id = "TIER-001"
+    severity = CRITICAL
+    title = (
+        "admission preemption only in scheduler.py + paged_kv.py"
+    )
+    rationale = (
+        "DEVIATIONS §18: evicting a running request to admit a "
+        "latency-tier arrival is a scheduler policy decision — it "
+        "must snapshot the victim's resume ticket (journaled PRNG "
+        "key + emitted tokens) BEFORE cancelling the slot, or the "
+        "byte-parity resume guarantee breaks. The engine and pool "
+        "never preempt for admission on their own: an engine-level "
+        "eviction bypasses the journal, and a pool-level one forks "
+        "tier policy across layers. The engine's memory-pressure "
+        "preempt-and-swap and the page pool's reclaim remain the "
+        "separate, legal survival paths."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src) and not any(
+            _matches_file(src.rel, key) for key in _PREEMPT_EXEMPT
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{what} — admission preemption belongs to "
+                "serving/scheduler.py (+ the page machinery in "
+                "paged_kv.py) only; submit with a tier and let the "
+                "scheduler's pump evict",
+            )
+            for lineno, what in preemption_sites(src.tree)
+        ]
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -1296,6 +1372,7 @@ REGISTRY: List[Rule] = [
     ElasticReshardRule(),
     AdapterBankRule(),
     FleetRoutingRule(),
+    TierPreemptionRule(),
 ]
 
 

@@ -31,6 +31,10 @@ inference:
                  over prompt prefixes + the digest→replica map the
                  pool routes with (cache-hot placement, no token
                  data off-replica)
+  workload.py  — seed-driven production-trace generator: diurnal
+                 burst arrivals, multi-turn chat sessions with
+                 chained prompts, long-context outliers, per-request
+                 SLO tier labels — replayable by bench and tests
 """
 
 from dlrover_tpu.serving.affinity import (
@@ -55,11 +59,19 @@ from dlrover_tpu.serving.failover import (
 from dlrover_tpu.serving.metrics import ServingMetrics
 from dlrover_tpu.serving.prefix_cache import RadixPrefixCache
 from dlrover_tpu.serving.scheduler import (
+    TIERS,
     AdmissionError,
     RequestScheduler,
     RequestState,
     ServeRequest,
     SloConfig,
+)
+from dlrover_tpu.serving.workload import (
+    SessionBook,
+    Trace,
+    TraceEvent,
+    WorkloadConfig,
+    generate_trace,
 )
 from dlrover_tpu.serving.speculative import (
     NgramDrafter,
@@ -99,10 +111,16 @@ __all__ = [
     "ServeRequest",
     "ServingGateway",
     "ServingMetrics",
+    "SessionBook",
     "SloConfig",
     "SpecController",
     "SpeculativeDecoder",
+    "TIERS",
+    "Trace",
+    "TraceEvent",
+    "WorkloadConfig",
     "affinity_order",
     "cache_digests",
+    "generate_trace",
     "prefix_digest_chain",
 ]

@@ -2537,6 +2537,19 @@ class ContinuousBatcher:
         if req.adapter_id is not None:
             self._adapter_cache.release(req.adapter_id)
 
+    def request_progress(self, idx: int) -> Optional[int]:
+        """Resident KV footprint (cells written) of a live request,
+        from the host mirrors — the scheduler's coldest-victim choice
+        for admission preemption reads this so its notion of "least
+        progress" is the engine's own (same quantity
+        _pick_preempt_slot orders by). None when the request is not
+        occupying a slot (still engine-queued: zero footprint)."""
+        for slot in range(self.n_slots):
+            req = self.slot_req[slot]
+            if req is not None and not self.done[slot] and req.idx == idx:
+                return int(self.pos[slot])
+        return None
+
     def live_request_keys(self) -> Dict[int, np.ndarray]:
         """idx -> current per-slot PRNG key for every live request —
         the scheduler journals these after each pump so a failover

@@ -32,6 +32,11 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
 
+# SLO-class rank for evacuation order — literal (not imported from
+# scheduler.py, which imports this module) and defaulted for tickets
+# whose requests predate the tier field.
+_TIER_RANK = {"latency": 0, "standard": 1, "batch": 2}
+
 
 class CircuitBreaker:
     """Consecutive-failure ejection -> exponential-backoff probation.
@@ -231,8 +236,18 @@ class FailoverManager:
                     exc,
                 )
                 break
+        # evacuate the most urgent work first: latency-tier tickets
+        # land on the (finite-capacity) survivors before batch ones,
+        # EDF within a tier — the same precedence the schedulers
+        # themselves dispatch with
         for ticket in sorted(
-            tickets, key=lambda t: t.req.deadline
+            tickets,
+            key=lambda t: (
+                _TIER_RANK.get(
+                    getattr(t.req, "effective_tier", "standard"), 1
+                ),
+                t.req.deadline,
+            ),
         ):
             req = ticket.req
             if ticket.remaining_new <= 0:

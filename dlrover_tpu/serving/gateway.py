@@ -8,7 +8,9 @@ no-new-deps rule holds for serving like everywhere else.
 Endpoints:
 
   POST /v1/generate   {"tokens": [...], "max_new"?: n,
-                       "deadline_s"?: s, "stream"?: bool}
+                       "deadline_s"?: s, "stream"?: bool,
+                       "adapter_id"?: str,
+                       "tier"?: "latency"|"standard"|"batch"}
     stream=true (default): application/x-ndjson — one
       {"tokens": [...]} line per decoded chunk as it lands, then a
       {"done": true, ...} trailer. TTFT for the client is one engine
@@ -36,12 +38,13 @@ from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.serving.metrics import ServingMetrics
 from dlrover_tpu.serving.replica import NoHealthyReplicasError
 from dlrover_tpu.serving.scheduler import (
+    TIERS,
     AdmissionError,
     RequestState,
 )
 
 _GENERATE_FIELDS = frozenset(
-    {"tokens", "max_new", "deadline_s", "stream", "adapter_id"}
+    {"tokens", "max_new", "deadline_s", "stream", "adapter_id", "tier"}
 )
 
 
@@ -84,6 +87,11 @@ def _validate_generate(payload) -> Optional[str]:
         not isinstance(adapter_id, str) or not adapter_id
     ):
         return "'adapter_id' must be a non-empty string"
+    tier = payload.get("tier")
+    if tier is not None and (
+        not isinstance(tier, str) or tier not in TIERS
+    ):
+        return f"'tier' must be one of {sorted(TIERS)}"
     return None
 
 
@@ -180,6 +188,9 @@ class ServingGateway:
                     if adapter_id is None
                     else {"adapter_id": adapter_id}
                 )
+                tier = payload.get("tier")
+                if tier is not None:
+                    kw["tier"] = tier
                 try:
                     req = gw.backend.submit(
                         payload["tokens"],
@@ -347,6 +358,16 @@ class ServingGateway:
         rstats = getattr(self.backend, "routing_stats", None)
         if callable(rstats):
             out["fleet_routing"] = rstats()
+        # priority tiers: per-class admission/preemption/escalation/
+        # shed counters (same duck-typing — test doubles without the
+        # tier counters skip the block)
+        if getattr(m, "tier_admitted_total", None) is not None:
+            out["tiers"] = {
+                "admitted": m.tier_admitted_total,
+                "preempted": m.tier_preempted_total,
+                "escalated": m.tier_escalated_total,
+                "shed": m.tier_shed_total,
+            }
         return out
 
     def _prefix_cache(self):

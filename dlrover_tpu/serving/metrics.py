@@ -119,8 +119,19 @@ class ServingMetrics:
             "_digest_map_digests",
             "_forecast_events",
             "_forecast_chip_demand",
+            "_tier_admitted",
+            "_tier_preempted",
+            "_tier_escalated",
+            "_tier_shed",
+            "_tier_ttft",
+            "_tier_tpot",
         }
     )
+
+    # SLO classes — fixed label set so every tier always renders
+    # (zero until taken). Mirrors scheduler.TIERS; kept literal here
+    # so the exposition layer never imports the policy layer.
+    TIER_LABELS = ("latency", "standard", "batch")
 
     def __init__(self, window: int = 512):
         self._lock = threading.Lock()
@@ -232,6 +243,16 @@ class ServingMetrics:
         # (fixed label set) and the latest chip-denominated demand
         self._forecast_events = {"up": 0, "down": 0}
         self._forecast_chip_demand = 0
+        # priority tiers: admission/preemption/escalation/shed
+        # counters and TTFT/TPOT windows per SLO class. Sheds are
+        # attributed to the tier that missed (the tier analog of the
+        # global _shed_total, which still counts everything).
+        self._tier_admitted = {t: 0 for t in self.TIER_LABELS}
+        self._tier_preempted = {t: 0 for t in self.TIER_LABELS}
+        self._tier_escalated = {t: 0 for t in self.TIER_LABELS}
+        self._tier_shed = {t: 0 for t in self.TIER_LABELS}
+        self._tier_ttft = {t: _Window(window) for t in self.TIER_LABELS}
+        self._tier_tpot = {t: _Window(window) for t in self.TIER_LABELS}
 
     # ---- ingestion -------------------------------------------------------
 
@@ -243,9 +264,35 @@ class ServingMetrics:
         with self._lock:
             self._rejected_total += 1
 
-    def request_shed(self):
+    def request_shed(self, tier: str = "standard"):
+        """One request shed past its deadline, attributed to the SLO
+        class that missed. Unknown tiers still count globally."""
         with self._lock:
             self._shed_total += 1
+            if tier in self._tier_shed:
+                self._tier_shed[tier] += 1
+
+    def tier_admitted(self, tier: str):
+        if tier not in self.TIER_LABELS:
+            return
+        with self._lock:
+            self._tier_admitted[tier] += 1
+
+    def tier_preempted(self, tier: str):
+        """One running request evicted by scheduler admission
+        preemption, labelled with the VICTIM's tier."""
+        if tier not in self.TIER_LABELS:
+            return
+        with self._lock:
+            self._tier_preempted[tier] += 1
+
+    def tier_escalated(self, tier: str):
+        """One waiting request promoted a tier by the aging
+        escalator, labelled with its base tier."""
+        if tier not in self.TIER_LABELS:
+            return
+        with self._lock:
+            self._tier_escalated[tier] += 1
 
     def request_completed(self):
         with self._lock:
@@ -273,13 +320,17 @@ class ServingMetrics:
         with self._lock:
             self._replica_readmissions += 1
 
-    def observe_ttft(self, ms: float):
+    def observe_ttft(self, ms: float, tier: Optional[str] = None):
         with self._lock:
             self._ttft_ms.observe(ms)
+            if tier in self._tier_ttft:
+                self._tier_ttft[tier].observe(ms)
 
-    def observe_tpot(self, ms: float):
+    def observe_tpot(self, ms: float, tier: Optional[str] = None):
         with self._lock:
             self._tpot_ms.observe(ms)
+            if tier in self._tier_tpot:
+                self._tier_tpot[tier].observe(ms)
 
     def observe_tokens(self, n: int, ts: Optional[float] = None):
         with self._lock:
@@ -495,6 +546,13 @@ class ServingMetrics:
         with self._lock:
             return self._ttft_ms.quantiles()
 
+    def tier_ttft_quantiles(self, tier: str) -> Dict[float, float]:
+        """TTFT quantiles for one SLO class (empty windows return
+        zeros, unknown tiers an empty dict)."""
+        with self._lock:
+            win = self._tier_ttft.get(tier)
+            return win.quantiles() if win is not None else {}
+
     def update_kernel_path(self, path: str, steps: int):
         """Refresh the per-attention-body decode-step counter from the
         engine's kernel_path and cumulative dispatch count. Same max()
@@ -512,6 +570,26 @@ class ServingMetrics:
     def shed_total(self) -> int:
         with self._lock:
             return self._shed_total
+
+    @property
+    def tier_admitted_total(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._tier_admitted)
+
+    @property
+    def tier_preempted_total(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._tier_preempted)
+
+    @property
+    def tier_escalated_total(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._tier_escalated)
+
+    @property
+    def tier_shed_total(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._tier_shed)
 
     @property
     def rejected_total(self) -> int:
@@ -832,6 +910,62 @@ class ServingMetrics:
                 "Requests shed past their deadline.",
                 self._shed_total,
             )
+            for fam, help_, store in (
+                (
+                    "serving_tier_admitted_total",
+                    "Requests admitted, by SLO tier.",
+                    self._tier_admitted,
+                ),
+                (
+                    "serving_tier_preempted_total",
+                    "Running requests evicted by admission "
+                    "preemption, by victim tier.",
+                    self._tier_preempted,
+                ),
+                (
+                    "serving_tier_escalated_total",
+                    "Waiting requests promoted by the aging "
+                    "escalator, by base tier.",
+                    self._tier_escalated,
+                ),
+                (
+                    "serving_tier_shed_total",
+                    "Requests shed past their deadline, by the tier "
+                    "that missed.",
+                    self._tier_shed,
+                ),
+            ):
+                lines.append(f"# HELP {fam} {help_}")
+                lines.append(f"# TYPE {fam} counter")
+                for t in self.TIER_LABELS:
+                    lines.append(f'{fam}{{tier="{t}"}} {store[t]}')
+            for fam, help_, wins in (
+                (
+                    "serving_tier_ttft_ms",
+                    "Time to first token by SLO tier, ms.",
+                    self._tier_ttft,
+                ),
+                (
+                    "serving_tier_tpot_ms",
+                    "Mean time per output token by SLO tier, ms.",
+                    self._tier_tpot,
+                ),
+            ):
+                lines.append(f"# HELP {fam} {help_}")
+                lines.append(f"# TYPE {fam} summary")
+                for t in self.TIER_LABELS:
+                    win = wins[t]
+                    for quant, val in win.quantiles().items():
+                        lines.append(
+                            f'{fam}{{tier="{t}",'
+                            f'quantile="{quant}"}} {val:.6g}'
+                        )
+                    lines.append(
+                        f'{fam}_sum{{tier="{t}"}} {win.total:.6g}'
+                    )
+                    lines.append(
+                        f'{fam}_count{{tier="{t}"}} {win.count}'
+                    )
             counter(
                 "serving_requests_rejected_total",
                 "Requests rejected at admission.",

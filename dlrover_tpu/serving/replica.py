@@ -73,6 +73,21 @@ def _kv_get(kv, key: str) -> bytes:
     return kv.get(key)
 
 
+def _tier_wait_depth(rep: "InferenceReplica", tier: str) -> int:
+    """QUEUED requests competing in `tier` on one replica — the
+    routing key that spreads same-tier waiting across the fleet.
+    Duck-typed: schedulers without per-tier heaps (test doubles)
+    count as 0, and a probe failure must not fail routing."""
+    fn = getattr(rep.scheduler, "tier_queue_depths", None)
+    if not callable(fn):
+        return 0
+    try:
+        return int(fn().get(tier, 0))
+    # graftlint: allow(EXC-001) reason=tier depth is a routing hint only; a raising scheduler is caught by the health probe, not here
+    except Exception:  # noqa: BLE001
+        return 0
+
+
 class InferenceReplica:
     """One serving replica: a scheduler over one engine, registered in
     the master KV store."""
@@ -498,6 +513,7 @@ class ReplicaPool:
         max_new: Optional[int] = None,
         deadline_s: Optional[float] = None,
         adapter_id: Optional[str] = None,
+        tier: Optional[str] = None,
     ) -> ServeRequest:
         """Affinity-aware routing with failover: try healthy replicas
         in preference order until one admits. Documented precedence,
@@ -516,10 +532,16 @@ class ReplicaPool:
            coolest candidate's by more than `affinity_max_imbalance`
            — the cap that keeps a hot prefix from starving the
            fleet.
-        3. ADAPTER residency — within equal affinity depth, replicas
-           whose device bank already holds `adapter_id` are tried
-           first (residency skips the host→device upload).
-        4. LOAD — final tiebreak, from the incrementally-maintained
+        3. SLO TIER spread — within equal affinity depth, replicas
+           with the shallowest same-tier wait queue are tried first,
+           so one replica never accumulates the fleet's whole
+           latency (or batch) class while its peers idle; an
+           affinity hit still dominates (re-hitting a warm prefix
+           beats an even queue).
+        4. ADAPTER residency — next, replicas whose device bank
+           already holds `adapter_id` are tried first (residency
+           skips the host→device upload).
+        5. LOAD — final tiebreak, from the incrementally-maintained
            ranking (mark_rank_dirty/ranked_replicas), so the hot
            path is O(candidates), not O(n log n) per request.
 
@@ -541,6 +563,14 @@ class ReplicaPool:
                 candidates,
                 key=lambda r: adapter_id not in r.adapters_resident(),
             )  # stable: load order preserved within each half
+        if tier is not None and len(candidates) > 1:
+            # stable over the adapter+load order: same-tier waiting
+            # depth decides, earlier keys break its ties (duck-typed
+            # — schedulers without tier heaps count as depth 0)
+            candidates = sorted(
+                candidates,
+                key=lambda r: _tier_wait_depth(r, tier),
+            )
         depths: Dict[str, int] = {}
         capped: List[InferenceReplica] = []
         if self.affinity_routing and len(candidates) > 1:
@@ -566,6 +596,8 @@ class ReplicaPool:
             self.scale_hint(force=True)
             raise NoHealthyReplicasError("no healthy replicas")
         kw = {} if adapter_id is None else {"adapter_id": adapter_id}
+        if tier is not None:
+            kw["tier"] = tier
         last_err: Optional[AdmissionError] = None
         for rep in candidates:
             try:

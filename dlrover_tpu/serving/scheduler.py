@@ -10,6 +10,26 @@ admission:
   cannot promise to serve, at submit time, with a typed error the
   gateway maps to HTTP 429 — instead of queueing unboundedly and
   missing every deadline at once.
+- priority tiers: every request carries an SLO class in TIERS
+  ("latency" | "standard" | "batch"). Each tier is its own EDF heap;
+  dispatch is strict priority across tiers (admit from the highest
+  non-empty heap), EDF within a tier. An aging escalator promotes a
+  waiting request one tier per `tier_aging_s` waited, so batch work
+  is starvation-free by construction: after at most
+  (len(TIERS)-1) * tier_aging_s it competes in the latency heap,
+  where its fixed deadline eventually beats every later-submitted
+  arrival under EDF.
+- admission preemption: when the next waiter is latency-tier and no
+  slot (or paged-KV headroom) is free, the scheduler evicts the
+  coldest running batch-tier request — snapshot its resume ticket
+  (journaled PRNG key + emitted tokens), cancel its slot, and requeue
+  it at the back of the batch heap. Resume is the failover
+  replay-prefill path: greedy byte-identical, sampled continuing the
+  journaled key stream. This is the Podracer move — batch fills the
+  spare capacity, latency traffic reclaims it on demand. Admission
+  preemption lives HERE (and the page machinery in paged_kv.py),
+  never in the engine or pool (graftlint TIER-001); the engine's own
+  _preempt_slot remains the orthogonal memory-pressure swap.
 - EDF dispatch: waiting requests are admitted earliest-deadline-first
   into freed slots (a deadline is an SLO, so the queue is a deadline
   heap, not FIFO).
@@ -17,7 +37,7 @@ admission:
   waits is shed — it would burn slot time to miss its SLO anyway, and
   shedding it early keeps the queue honest for the requests behind it.
   Requests already decoding are never shed (their tokens are sunk
-  cost about to pay off).
+  cost about to pay off). Sheds are attributed to the request's tier.
 
 Tokens stream out per engine chunk through each request's stream
 queue; the gateway forwards them as they land, so TTFT is one chunk
@@ -30,7 +50,7 @@ import heapq
 import queue
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence
 
 import numpy as np
 
@@ -41,6 +61,13 @@ from dlrover_tpu.serving.chaos import ChipLost
 from dlrover_tpu.serving.engine import ContinuousBatcher
 from dlrover_tpu.serving.failover import RequestJournal, ResumeTicket
 from dlrover_tpu.serving.metrics import ServingMetrics
+
+# SLO classes, highest priority first. Index order IS dispatch order:
+# the pump admits from the first non-empty tier heap. The last tier
+# ("batch") is the only preemptible one — Podracer's fill-the-gaps
+# work, evicted when a latency request would otherwise miss admission.
+TIERS = ("latency", "standard", "batch")
+TIER_RANK = {t: i for i, t in enumerate(TIERS)}
 
 
 class AdmissionError(RuntimeError):
@@ -76,6 +103,17 @@ class SloConfig:
     # single chatty tenant from pinning every engine slot while other
     # adapters starve in the queue.
     max_active_per_adapter: int = 0
+    # per-tier admission quota: live (waiting + running) requests one
+    # SLO class may hold before a 429 (absent / 0 = unlimited). The
+    # tier analog of max_active_per_adapter — caps how much of the
+    # replica batch traffic may occupy, so the spare-capacity filler
+    # can never crowd out interactive admission in the first place.
+    tier_budgets: Optional[Mapping[str, int]] = None
+    # aging escalator: seconds a request waits per one-tier promotion
+    # (0 disables). A batch request becomes standard after one period
+    # and latency-eligible after two — the bounded-delay guarantee
+    # behind "strict priority without starvation".
+    tier_aging_s: float = 30.0
 
 
 class ServeRequest:
@@ -90,6 +128,7 @@ class ServeRequest:
         deadline: float,
         submit_ts: float,
         adapter_id: Optional[str] = None,
+        tier: str = "standard",
     ):
         self.id = req_id
         self.prompt = prompt
@@ -100,6 +139,17 @@ class ServeRequest:
         # model). Carried across failover/readmit: replay must hit the
         # same adapter weights to stay byte-identical.
         self.adapter_id = adapter_id
+        # SLO class: `tier` is the immutable label the client asked
+        # for (budgets, metrics, and shed attribution key off it);
+        # `effective_tier` is where the request currently competes —
+        # the aging escalator promotes it toward "latency" while the
+        # request waits, and it names the heap the entry lives in.
+        self.tier = tier
+        self.effective_tier = tier
+        # admission preemptions survived (scheduler-level evictions
+        # in favour of a latency-tier arrival; excludes the engine's
+        # memory-pressure swaps, which are invisible up here)
+        self.preemptions = 0
         self.state = RequestState.QUEUED
         self.tokens: List[int] = []
         self.first_token_ts: Optional[float] = None
@@ -212,16 +262,20 @@ class RequestScheduler:
         self._clock = clock
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
-        # EDF heap of (deadline, prompt_len, adapter_rank, seq,
-        # request). First tiebreak is shortest-prompt-first: among
-        # equal deadlines a long prefill must not convoy short ones
-        # behind it (the prefill-phase analog of SJF). Second is the
-        # adapter's first-seen ordinal — see _adapter_rank_of. Final
-        # tiebreak is a scheduler-local sequence, NOT req.id: a
+        # one EDF heap PER TIER of (deadline, prompt_len, adapter_rank,
+        # seq, request); dispatch walks TIERS in order (strict
+        # priority) and pops EDF within the first non-empty heap. An
+        # entry always lives in the heap named by its request's
+        # effective_tier — the aging escalator moves entries between
+        # heaps as they wait. First tiebreak is shortest-prompt-first:
+        # among equal deadlines a long prefill must not convoy short
+        # ones behind it (the prefill-phase analog of SJF). Second is
+        # the adapter's first-seen ordinal — see _adapter_rank_of.
+        # Final tiebreak is a scheduler-local sequence, NOT req.id: a
         # failover-readmitted request carries its id from ANOTHER
         # scheduler, and a collision would fall through to comparing
         # ServeRequests.
-        self._waiting: List[Any] = []
+        self._waiting: Dict[str, List[Any]] = {t: [] for t in TIERS}
         self._seq = 0
         self._running: Dict[int, ServeRequest] = {}  # engine idx -> req
         self._next_id = 0
@@ -261,12 +315,40 @@ class RequestScheduler:
             adapter_id, len(self._adapter_rank) + 1
         )
 
+    def _waiting_total_locked(self) -> int:
+        """QUEUED entries across every tier heap (lazy-cancelled
+        entries excluded). Caller holds the lock."""
+        return sum(
+            1
+            for heap_ in self._waiting.values()
+            for _, _, _, _, r in heap_
+            if r.state is RequestState.QUEUED
+        )
+
+    def _push_waiting_locked(
+        self, req: ServeRequest, prompt_len: int
+    ) -> None:
+        """Push one entry into the heap of the request's effective
+        tier. Caller holds the lock."""
+        heapq.heappush(
+            self._waiting[req.effective_tier],
+            (
+                req.deadline,
+                int(prompt_len),
+                self._adapter_rank_of_locked(req.adapter_id),
+                self._seq,
+                req,
+            ),
+        )
+        self._seq += 1
+
     def _adapter_load_locked(self, adapter_id: str) -> int:
         """Live (queued + running) requests held by one adapter id.
         Caller holds the lock."""
         n = sum(
             1
-            for _, _, _, _, r in self._waiting
+            for heap_ in self._waiting.values()
+            for _, _, _, _, r in heap_
             if (
                 r.state is RequestState.QUEUED
                 and r.adapter_id == adapter_id
@@ -278,23 +360,49 @@ class RequestScheduler:
             if r.adapter_id == adapter_id
         )
 
+    def _tier_load_locked(self, tier: str) -> int:
+        """Live (queued + running) requests labelled with one tier —
+        counted by the immutable label, not the escalated heap, so a
+        tenant cannot dodge its budget by waiting out the aging
+        escalator. Caller holds the lock."""
+        n = sum(
+            1
+            for heap_ in self._waiting.values()
+            for _, _, _, _, r in heap_
+            if r.state is RequestState.QUEUED and r.tier == tier
+        )
+        return n + sum(
+            1 for r in self._running.values() if r.tier == tier
+        )
+
     def submit(
         self,
         prompt: Sequence[int],
         max_new: Optional[int] = None,
         deadline_s: Optional[float] = None,
         adapter_id: Optional[str] = None,
+        tier: Optional[str] = None,
+        prng_key: Optional[np.ndarray] = None,
     ) -> ServeRequest:
         """Admit one request or raise AdmissionError. Returns the
-        handle whose `stream` yields token chunks as they decode."""
+        handle whose `stream` yields token chunks as they decode.
+        `prng_key` pins the sampling key the first engine admission
+        uses (deterministic replay / parity tests); None lets the
+        engine draw one."""
         arr = np.asarray(prompt, np.int32)
         slo = self.slo
         want = max_new or min(self.engine.max_new, slo.max_new_tokens)
+        tier = tier or "standard"
+        if tier not in TIERS:
+            self.metrics.request_rejected()
+            raise AdmissionError(
+                f"unknown tier {tier!r} (expected one of {TIERS})"
+            )
         with self._cond:
             if self.crashed:
                 self.metrics.request_rejected()
                 raise AdmissionError("replica crashed, pending restart")
-            if len(self._waiting) >= slo.max_queue_depth:
+            if self._waiting_total_locked() >= slo.max_queue_depth:
                 self.metrics.request_rejected()
                 raise AdmissionError(
                     f"queue full ({slo.max_queue_depth} waiting)"
@@ -338,6 +446,13 @@ class RequestScheduler:
                         f"adapter {adapter_id!r} at its per-tenant "
                         f"quota ({quota} active)"
                     )
+            budget = int((slo.tier_budgets or {}).get(tier, 0))
+            if budget > 0 and self._tier_load_locked(tier) >= budget:
+                self.metrics.request_rejected()
+                raise AdmissionError(
+                    f"tier {tier!r} at its admission budget "
+                    f"({budget} active)"
+                )
             now = self._clock()
             req = ServeRequest(
                 req_id=self._next_id,
@@ -346,22 +461,16 @@ class RequestScheduler:
                 deadline=now + (deadline_s or slo.default_deadline_s),
                 submit_ts=now,
                 adapter_id=adapter_id,
+                tier=tier,
             )
             self._next_id += 1
             req.scheduler = self
-            heapq.heappush(
-                self._waiting,
-                (
-                    req.deadline,
-                    int(arr.size),
-                    self._adapter_rank_of_locked(adapter_id),
-                    self._seq,
-                    req,
-                ),
-            )
-            self._seq += 1
+            if prng_key is not None:
+                req.prng_key = np.asarray(prng_key, np.uint32)
+            self._push_waiting_locked(req, arr.size)
             self.metrics.request_submitted()
-            self.metrics.set_queue_depth(len(self._waiting))
+            self.metrics.tier_admitted(tier)
+            self.metrics.set_queue_depth(self._waiting_total_locked())
             self._cond.notify_all()
             return req
 
@@ -369,7 +478,21 @@ class RequestScheduler:
 
     def queue_depth(self) -> int:
         with self._lock:
-            return len(self._waiting)
+            return self._waiting_total_locked()
+
+    def tier_queue_depths(self) -> Dict[str, int]:
+        """QUEUED entries per tier heap (by effective tier — where
+        they currently compete). The pool's tier-aware routing sort
+        reads this to spread same-tier waiting across replicas."""
+        with self._lock:
+            return {
+                t: sum(
+                    1
+                    for _, _, _, _, r in heap_
+                    if r.state is RequestState.QUEUED
+                )
+                for t, heap_ in self._waiting.items()
+            }
 
     def active_count(self) -> int:
         with self._lock:
@@ -378,7 +501,9 @@ class RequestScheduler:
     def pressure(self) -> float:
         """Waiting load relative to the admission bound, in [0, 1+]."""
         with self._lock:
-            return len(self._waiting) / max(1, self.slo.max_queue_depth)
+            return self._waiting_total_locked() / max(
+                1, self.slo.max_queue_depth
+            )
 
     def telemetry(self) -> Dict[str, float]:
         """One replica-level observation for the fleet telemetry
@@ -389,7 +514,7 @@ class RequestScheduler:
         cache is off."""
         cache = getattr(self.engine, "prefix_cache", None)
         with self._lock:
-            waiting = len(self._waiting)
+            waiting = self._waiting_total_locked()
             running = len(self._running)
         return {
             "queue_depth": waiting,
@@ -402,36 +527,149 @@ class RequestScheduler:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._waiting) or bool(self._running)
+            return bool(self._running) or any(
+                self._waiting[t] for t in TIERS
+            )
 
     # ---- the loop --------------------------------------------------------
 
     def _shed_expired_locked(self, now: float):
         """Shed every WAITING request whose deadline already passed
-        (the heap is deadline-ordered, so they sit at the front).
-        Cancelled entries linger in the heap until they surface here
-        or at admission (lazy removal) — just drop them. Caller holds
-        self._cond (the _locked convention)."""
-        while self._waiting:
-            deadline, _, _, _, req = self._waiting[0]
-            if req.state is not RequestState.QUEUED:
-                heapq.heappop(self._waiting)
+        (each tier heap is deadline-ordered, so within a tier they
+        sit at the front). The shed is attributed to the request's
+        OWN tier — the class that missed its SLO — not a global
+        count. Cancelled entries linger in the heaps until they
+        surface here or at admission (lazy removal) — just drop
+        them. Caller holds self._cond (the _locked convention)."""
+        for heap_ in self._waiting.values():
+            while heap_:
+                deadline, _, _, _, req = heap_[0]
+                if req.state is not RequestState.QUEUED:
+                    heapq.heappop(heap_)
+                    continue
+                if deadline > now:
+                    break
+                heapq.heappop(heap_)
+                req._end(RequestState.SHED, now)
+                self.journal.close(req)
+                self.metrics.request_shed(req.tier)
+                logger.info(
+                    "shed request %d (tier %s): deadline passed "
+                    "%.3fs ago in queue",
+                    req.id, req.tier, now - req.deadline,
+                )
+
+    def _escalate_aged_locked(self, now: float):
+        """Aging escalator: promote waiting requests one tier per
+        `tier_aging_s` waited since submission (computed from the
+        IMMUTABLE base tier, so repeated scans are idempotent and a
+        preempted-then-requeued batch request keeps its seniority).
+        The heap entry moves with the request — its deadline key is
+        unchanged, so per-tier EDF order and front-shedding stay
+        intact. Caller holds the lock."""
+        aging = self.slo.tier_aging_s
+        if aging <= 0:
+            return
+        for ti in range(1, len(TIERS)):
+            heap_ = self._waiting[TIERS[ti]]
+            if not heap_:
                 continue
-            if deadline > now:
-                break
-            heapq.heappop(self._waiting)
-            req._end(RequestState.SHED, now)
-            self.journal.close(req)
-            self.metrics.request_shed()
-            logger.info(
-                "shed request %d: deadline passed %.3fs ago in queue",
-                req.id, now - req.deadline,
-            )
+            keep, moved = [], []
+            for entry in heap_:
+                req = entry[-1]
+                if req.state is not RequestState.QUEUED:
+                    continue  # lazy-drop cancelled entries
+                target = max(
+                    0,
+                    TIER_RANK[req.tier]
+                    - int((now - req.submit_ts) / aging),
+                )
+                if target < ti:
+                    moved.append((entry, target))
+                else:
+                    keep.append(entry)
+            if not moved:
+                continue
+            heapq.heapify(keep)
+            self._waiting[TIERS[ti]] = keep
+            for entry, target in moved:
+                req = entry[-1]
+                req.effective_tier = TIERS[target]
+                heapq.heappush(self._waiting[TIERS[target]], entry)
+                self.metrics.tier_escalated(req.tier)
+                logger.info(
+                    "escalated request %d: tier %s -> %s after "
+                    "%.1fs waiting",
+                    req.id, req.tier, req.effective_tier,
+                    now - req.submit_ts,
+                )
+
+    def _peek_next_locked(self):
+        """(tier, request) at the front of the highest-priority
+        non-empty heap, dropping lazily-cancelled entries on the way;
+        (None, None) when nothing waits. Caller holds the lock."""
+        for tier in TIERS:
+            heap_ = self._waiting[tier]
+            while heap_ and heap_[0][-1].state is not RequestState.QUEUED:
+                heapq.heappop(heap_)
+            if heap_:
+                return tier, heap_[0][-1]
+        return None, None
+
+    def _preempt_for_admission_locked(self) -> bool:
+        """Evict the coldest RUNNING batch-tier request so a
+        latency-tier arrival can admit: snapshot its resume ticket
+        (emitted tokens fold into the replay prompt; the journaled
+        key continues the sampling stream), cancel its engine slot
+        (which frees the slot, its pages, and any prefix/adapter
+        pins), and requeue it in the batch heap. Resume is the
+        failover replay path, so the preempted request's final bytes
+        are identical to an undisturbed run. "Coldest" is the
+        engine's own footprint measure (request_progress — same
+        quantity its memory-pressure swap orders by); a victim still
+        in the engine queue has no footprint at all and is preferred.
+        Returns True if a slot was freed. Caller holds the lock.
+
+        This is the ONLY admission-preemption site in the serving
+        stack (graftlint TIER-001): the engine and pool never evict
+        for admission on their own."""
+        progress = getattr(self.engine, "request_progress", None)
+        victim_idx = None
+        victim_key = None
+        for idx, r in self._running.items():
+            if r.effective_tier != TIERS[-1]:
+                continue
+            prog = progress(idx) if progress is not None else None
+            if prog is None:  # engine-queued: zero resident KV
+                prog = -1
+            key = (prog, idx)
+            if victim_key is None or key < victim_key:
+                victim_key, victim_idx = key, idx
+        if victim_idx is None:
+            return False
+        victim = self._running.pop(victim_idx)
+        ticket = self.journal.snapshot(victim)
+        self.engine.cancel(victim_idx)
+        if ticket.prng_key is not None:
+            victim.prng_key = np.asarray(ticket.prng_key, np.uint32)
+        victim.state = RequestState.QUEUED
+        victim.preemptions += 1
+        self._push_waiting_locked(
+            victim, len(victim.prompt) + len(victim.tokens)
+        )
+        self.metrics.tier_preempted(victim.tier)
+        logger.info(
+            "preempted request %d (tier %s, %d tokens emitted) for "
+            "latency-tier admission",
+            victim.id, victim.tier, len(victim.tokens),
+        )
+        return True
 
     def pump(self) -> bool:
-        """One scheduling iteration: shed expired, admit EDF into free
-        slots, decode one chunk, stream the emitted tokens. Returns
-        True while work remains.
+        """One scheduling iteration: shed expired, escalate aged,
+        admit strict-priority EDF into free slots (preempting batch
+        work for blocked latency arrivals), decode one chunk, stream
+        the emitted tokens. Returns True while work remains.
 
         If the engine raises (injected fault or real failure), the
         scheduler marks itself crashed, snapshots every in-flight
@@ -444,16 +682,22 @@ class RequestScheduler:
                 return False
             now = self._clock()
             self._shed_expired_locked(now)
+            self._escalate_aged_locked(now)
             try:
-                # admit only up to the engine's free slots so EDF
-                # order, not engine-internal FIFO, decides dispatch
+                # admit only up to the engine's free slots so
+                # tier-then-EDF order, not engine-internal FIFO,
+                # decides dispatch
                 headroom_ok = getattr(
                     self.engine, "admission_headroom_ok", None
                 )
-                while (
-                    self._waiting
-                    and self.engine.queue_len() < self.engine.free_slots()
-                ):
+                while True:
+                    tier, req = self._peek_next_locked()
+                    if req is None:
+                        break
+                    room = (
+                        self.engine.queue_len()
+                        < self.engine.free_slots()
+                    )
                     # memory-aware gate (paged KV): when the page pool
                     # cannot back a worst-case admission and the engine
                     # already has work, wait for it to drain rather
@@ -461,18 +705,26 @@ class RequestScheduler:
                     # thrash. With the engine empty we admit anyway —
                     # it reclaims inline, so progress is guaranteed
                     # either way.
-                    if (
+                    blocked = (
                         headroom_ok is not None
                         and not headroom_ok()
                         and (
                             self.engine.active_count() > 0
                             or self.engine.queue_len() > 0
                         )
-                    ):
+                    )
+                    if not room or blocked:
+                        # a latency-tier waiter blocked on capacity
+                        # reclaims it from batch work: evict one
+                        # victim (slot + pages free immediately) and
+                        # re-evaluate. No victim => genuinely full.
+                        if (
+                            req.effective_tier == TIERS[0]
+                            and self._preempt_for_admission_locked()
+                        ):
+                            continue
                         break
-                    _, _, _, _, req = heapq.heappop(self._waiting)
-                    if req.state is not RequestState.QUEUED:
-                        continue  # cancelled while waiting
+                    heapq.heappop(self._waiting[tier])
                     pkg, req.handoff_pkg = req.handoff_pkg, None
                     if pkg is not None and not req.tokens:
                         # adopted prefill: install the shipped KV
@@ -497,19 +749,7 @@ class RequestScheduler:
                             # already decoding: put the request back
                             # and stop admitting — a retire this chunk
                             # releases a pin and the next pump retries
-                            heapq.heappush(
-                                self._waiting,
-                                (
-                                    req.deadline,
-                                    int(prompt.size),
-                                    self._adapter_rank_of_locked(
-                                        req.adapter_id
-                                    ),
-                                    self._seq,
-                                    req,
-                                ),
-                            )
-                            self._seq += 1
+                            self._push_waiting_locked(req, prompt.size)
                             break
                         except KeyError:
                             # unregistered between admission and
@@ -573,7 +813,8 @@ class RequestScheduler:
                     if req.first_token_ts is None:
                         req.first_token_ts = now
                         self.metrics.observe_ttft(
-                            (now - req.submit_ts) * 1000.0
+                            (now - req.submit_ts) * 1000.0,
+                            tier=req.tier,
                         )
                     req.tokens.extend(new_toks)
                     req.stream.put(new_toks)
@@ -589,7 +830,8 @@ class RequestScheduler:
                         self.metrics.observe_tpot(
                             (now - req.first_token_ts)
                             * 1000.0
-                            / (len(req.tokens) - 1)
+                            / (len(req.tokens) - 1),
+                            tier=req.tier,
                         )
                     req._end(RequestState.DONE, now)
                     self.metrics.request_completed()
@@ -605,10 +847,11 @@ class RequestScheduler:
             # slots, and dispatch to the coordinator OUTSIDE the lock
             # (it takes the target scheduler's lock)
             migrations = self._drain_prefilled_locked()
-            self.metrics.set_queue_depth(len(self._waiting))
+            depth = self._waiting_total_locked()
+            self.metrics.set_queue_depth(depth)
             self.metrics.set_role_queue_depth(
                 getattr(self.engine, "replica_role", "colocated"),
-                len(self._waiting),
+                depth,
             )
             self.metrics.set_active_requests(len(self._running))
             pc = getattr(self.engine, "prefix_cache", None)
@@ -653,7 +896,9 @@ class RequestScheduler:
                 a = astats()
                 if a:
                     self.metrics.update_adapters(a)
-            busy = bool(self._waiting) or bool(self._running)
+            busy = bool(self._running) or any(
+                self._waiting[t] for t in TIERS
+            )
         for req, ticket, pkg in migrations:
             self._dispatch_handoff(req, ticket, pkg)
         return busy or bool(migrations)
@@ -754,10 +999,11 @@ class RequestScheduler:
         for req in self._running.values():
             tickets.append(self.journal.snapshot(req))
         self._running.clear()
-        while self._waiting:
-            _, _, _, _, req = heapq.heappop(self._waiting)
-            if req.state is RequestState.QUEUED:
-                tickets.append(self.journal.snapshot(req))
+        for heap_ in self._waiting.values():
+            while heap_:
+                _, _, _, _, req = heapq.heappop(heap_)
+                if req.state is RequestState.QUEUED:
+                    tickets.append(self.journal.snapshot(req))
         self.journal = RequestJournal()
         self.metrics.set_queue_depth(0)
         self.metrics.set_active_requests(0)
@@ -788,31 +1034,26 @@ class RequestScheduler:
         429ing it — but still honours the deadline: an already-late
         request is shed here (returns False), never decoded. The
         journaled key is pinned so the resumed slot continues the
-        exact sampling stream."""
+        exact sampling stream. The request keeps its effective tier
+        — aging seniority survives the move."""
         with self._cond:
             if self.crashed:
                 raise AdmissionError("replica crashed, pending restart")
             now = self._clock()
             if req.deadline <= now:
                 req._end(RequestState.SHED, now)
-                self.metrics.request_shed()
+                self.metrics.request_shed(
+                    getattr(req, "tier", "standard")
+                )
                 return False
             if ticket.prng_key is not None:
                 req.prng_key = np.asarray(ticket.prng_key, np.uint32)
             req.scheduler = self
             req.state = RequestState.QUEUED
-            heapq.heappush(
-                self._waiting,
-                (
-                    req.deadline,
-                    int(len(req.prompt) + len(req.tokens)),
-                    self._adapter_rank_of_locked(req.adapter_id),
-                    self._seq,
-                    req,
-                ),
+            self._push_waiting_locked(
+                req, len(req.prompt) + len(req.tokens)
             )
-            self._seq += 1
-            self.metrics.set_queue_depth(len(self._waiting))
+            self.metrics.set_queue_depth(self._waiting_total_locked())
             self._cond.notify_all()
             return True
 
@@ -837,25 +1078,17 @@ class RequestScheduler:
             now = self._clock()
             if req.deadline <= now:
                 req._end(RequestState.SHED, now)
-                self.metrics.request_shed()
+                self.metrics.request_shed(
+                    getattr(req, "tier", "standard")
+                )
                 return False
             if ticket.prng_key is not None:
                 req.prng_key = np.asarray(ticket.prng_key, np.uint32)
             req.handoff_pkg = package
             req.scheduler = self
             req.state = RequestState.QUEUED
-            heapq.heappush(
-                self._waiting,
-                (
-                    req.deadline,
-                    int(len(req.prompt)),
-                    self._adapter_rank_of_locked(req.adapter_id),
-                    self._seq,
-                    req,
-                ),
-            )
-            self._seq += 1
-            self.metrics.set_queue_depth(len(self._waiting))
+            self._push_waiting_locked(req, len(req.prompt))
+            self.metrics.set_queue_depth(self._waiting_total_locked())
             self._cond.notify_all()
             return True
 
@@ -911,7 +1144,8 @@ class RequestScheduler:
         while crashed and resumes pumping here."""
         with self._cond:
             self.engine.reset()
-            self._waiting.clear()
+            for heap_ in self._waiting.values():
+                heap_.clear()
             self._running.clear()
             self.journal = RequestJournal()
             self.crashed = False

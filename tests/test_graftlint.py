@@ -47,6 +47,7 @@ from dlrover_tpu.analysis.rules import (
     ProgramCacheKeyRule,
     RawMeshRule,
     RlImportRule,
+    TierPreemptionRule,
     get_rules,
 )
 
@@ -807,6 +808,79 @@ def test_route_rule_ignores_outside_serving(tmp_path):
         rel="dlrover_tpu/master/kv_store.py",
     )
     assert not hits(FleetRoutingRule(), src)
+
+
+# ---------------------------------------------------------------------------
+# TIER-001: admission preemption only in scheduler.py + paged_kv.py
+
+
+def test_tier_rule_flags_adhoc_preemption(tmp_path):
+    # an engine (or pool) evicting a running request for admission on
+    # its own — bypasses the scheduler's snapshot-before-cancel
+    # ordering, so the victim's resume loses byte parity; both the
+    # bare and attribute call spellings must be caught
+    src = probe(
+        tmp_path,
+        """
+        def make_room(self, sched):
+            sched._preempt_for_admission_locked()
+            preempt_for_admission(self.victim)
+        """,
+        rel="dlrover_tpu/serving/engine.py",
+    )
+    found = hits(TierPreemptionRule(), src)
+    assert len(found) == 2
+    assert all("scheduler.py" in f.message for f in found)
+
+
+def test_tier_rule_allows_memory_pressure_swap(tmp_path):
+    # the engine's own page-pressure preempt-and-swap is the separate
+    # legal survival path (PR 6) — not an admission decision, never a
+    # finding; neither is observing tier counters
+    src = probe(
+        tmp_path,
+        """
+        def step(self):
+            slot = self._pick_preempt_slot()
+            self._preempt_slot(slot)
+            return self.metrics.tier_preempted_total
+        """,
+        rel="dlrover_tpu/serving/engine.py",
+    )
+    assert not hits(TierPreemptionRule(), src)
+
+
+def test_tier_rule_vacuous_on_owning_modules(tmp_path):
+    # the same offender impersonating the designated owners is exempt
+    # there, flagged anywhere else in serving (vacuity guard on the
+    # exemption)
+    code = """
+    def pump(self):
+        if self.blocked():
+            self._preempt_for_admission_locked()
+    """
+    for owner in (
+        "dlrover_tpu/serving/scheduler.py",
+        "dlrover_tpu/serving/paged_kv.py",
+    ):
+        src = probe(tmp_path, code, rel=owner)
+        assert not hits(TierPreemptionRule(), src)
+    src = probe(tmp_path, code, rel=SERVING_REL)
+    assert len(hits(TierPreemptionRule(), src)) == 1
+
+
+def test_tier_rule_ignores_outside_serving(tmp_path):
+    # tests drive the preemption API directly by design — the rule is
+    # a serving-layer invariant only
+    src = probe(
+        tmp_path,
+        """
+        def force_preempt(sched):
+            sched._preempt_for_admission_locked()
+        """,
+        rel="tests/test_serving_tiers.py",
+    )
+    assert not hits(TierPreemptionRule(), src)
 
 
 # ---------------------------------------------------------------------------

@@ -199,7 +199,8 @@ class TestSheddingAndOrder:
             _prompts((4,), seed=13)[0], max_new=2, deadline_s=500.0
         )
         heap_order = [
-            len(item[-1].prompt) for item in sorted(sched._waiting)
+            len(item[-1].prompt)
+            for item in sorted(sched._waiting["standard"])
         ]
         assert heap_order == sorted(heap_order)
         sched.run_to_completion()

@@ -1,0 +1,220 @@
+"""Trace-driven workload generator (serving/workload.py): seed
+determinism (same seed => byte-identical event stream), diurnal
+arrival shape, multi-turn prompt chaining through SessionBook,
+long-context outliers, tier labelling, and the no-wall-clock rule —
+the generator must be a pure function of its config so bench phase 13
+and the tier tests replay the exact same production day every run."""
+
+import dataclasses
+import inspect
+
+import numpy as np
+import pytest
+
+from dlrover_tpu.serving import workload
+from dlrover_tpu.serving.scheduler import TIERS
+from dlrover_tpu.serving.workload import (
+    SessionBook,
+    WorkloadConfig,
+    generate_trace,
+)
+
+
+def _cfg(**kw):
+    kw.setdefault("seed", 7)
+    kw.setdefault("horizon_s", 120.0)
+    kw.setdefault("base_rate", 0.5)
+    return WorkloadConfig(**kw)
+
+
+class TestDeterminism:
+    def test_same_seed_identical_stream(self):
+        """The satellite contract: same seed => identical event
+        stream, field for field (Trace/TraceEvent are frozen
+        dataclasses, so == is deep)."""
+        a = generate_trace(_cfg())
+        b = generate_trace(_cfg())
+        assert a == b
+        assert a.events == b.events
+        assert len(a.events) > 0
+
+    def test_different_seed_differs(self):
+        a = generate_trace(_cfg(seed=7))
+        b = generate_trace(_cfg(seed=8))
+        assert a.events != b.events
+
+    def test_no_wall_clock_in_module(self):
+        """Replayability is load-bearing: the generator must never
+        read the wall clock — every timestamp flows from the seeded
+        rng. Pin it at the source level so a drive-by `time.time()`
+        cannot silently break bench phase 13's locked axes."""
+        src = inspect.getsource(workload)
+        for needle in (
+            "import time",
+            "import datetime",
+            "time.time",
+            "time.monotonic",
+            "date.today",
+            "datetime.now",
+        ):
+            assert needle not in src, needle
+
+    def test_config_is_frozen(self):
+        cfg = _cfg()
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            cfg.seed = 1
+
+
+class TestArrivalShape:
+    def test_diurnal_peak_vs_trough(self):
+        """One full sinusoid period: the busiest arrival bucket must
+        see strictly more session starts than the quietest — the
+        burstiness predictive_scale() is supposed to see coming."""
+        cfg = _cfg(
+            seed=3,
+            horizon_s=600.0,
+            period_s=600.0,
+            base_rate=0.4,
+            burst_amplitude=0.9,
+            turns_lo=1,
+            turns_hi=1,
+        )
+        trace = generate_trace(cfg)
+        counts = trace.arrival_counts(6)
+        assert len(counts) == 6
+        assert sum(counts) == len(trace.events)
+        assert max(counts) > min(counts)
+
+    def test_rate_is_sinusoid_around_base(self):
+        cfg = _cfg(base_rate=1.0, burst_amplitude=0.5, period_s=100.0)
+        rates = [cfg.rate(t) for t in np.linspace(0, 100.0, 200)]
+        assert max(rates) == pytest.approx(1.5, rel=0.05)
+        assert min(rates) == pytest.approx(0.5, rel=0.05)
+        assert all(r >= 0 for r in rates)
+
+    def test_events_sorted_by_time(self):
+        trace = generate_trace(_cfg(seed=5, horizon_s=300.0))
+        times = [ev.t for ev in trace.events]
+        assert times == sorted(times)
+
+    def test_amplitude_validation(self):
+        with pytest.raises(ValueError, match="burst_amplitude"):
+            generate_trace(_cfg(burst_amplitude=1.5))
+        with pytest.raises(ValueError, match="tier"):
+            generate_trace(_cfg(latency_frac=0.9, batch_frac=0.3))
+
+
+class TestTiers:
+    def test_every_event_has_known_tier_and_deadline(self):
+        cfg = _cfg(seed=11, horizon_s=400.0, base_rate=0.6)
+        trace = generate_trace(cfg)
+        for ev in trace.events:
+            assert ev.tier in TIERS
+            assert ev.deadline_s == cfg.tier_deadline_s(ev.tier)
+            assert ev.deadline_s > 0
+
+    def test_tier_is_per_session(self):
+        """The SLO class is a property of the CLIENT, not the turn:
+        every turn of one session carries the same tier (this is what
+        lets the bench's latency-solo leg filter whole sessions
+        without breaking prompt chains)."""
+        cfg = _cfg(seed=11, horizon_s=400.0, turns_lo=2, turns_hi=4)
+        trace = generate_trace(cfg)
+        by_session = {}
+        for ev in trace.events:
+            by_session.setdefault(ev.session, set()).add(ev.tier)
+        assert any(
+            len([e for e in trace.events if e.session == s]) > 1
+            for s in by_session
+        )
+        for tiers in by_session.values():
+            assert len(tiers) == 1
+
+    def test_tier_mix_covers_all_tiers(self):
+        trace = generate_trace(
+            _cfg(seed=2, horizon_s=900.0, base_rate=0.5)
+        )
+        seen = {ev.tier for ev in trace.events}
+        assert seen == set(TIERS)
+
+
+class TestSessions:
+    def test_multi_turn_chaining(self):
+        """Turn k's prompt is turn k-1's prompt + reply + new user
+        tokens — the prefix-affinity pattern PR 12 routes on. The
+        SessionBook owns the chaining so the replayer only feeds
+        replies back."""
+        cfg = _cfg(seed=9, horizon_s=400.0, turns_lo=3, turns_hi=4)
+        trace = generate_trace(cfg)
+        book = SessionBook(trace)
+        prompts = {}
+        for ev in trace.events:
+            assert book.ready(ev)
+            p = book.prompt_for(ev).tolist()
+            assert len(p) <= cfg.max_prompt_tokens
+            if ev.turn > 0:
+                prev, prev_reply = prompts[(ev.session, ev.turn - 1)]
+                chained = prev + prev_reply + list(ev.user_tokens)
+                assert p == chained[-cfg.max_prompt_tokens:]
+            reply = [int(x) for x in np.arange(ev.max_new) + 1]
+            prompts[(ev.session, ev.turn)] = (p, reply)
+            book.record_reply(ev, reply)
+
+    def test_ready_gates_on_prior_reply(self):
+        """Turn k+1 is not replayable until turn k's reply landed —
+        the replayer must defer it, exactly as a real chat client
+        cannot send the next message before reading the last."""
+        cfg = _cfg(seed=9, horizon_s=400.0, turns_lo=2, turns_hi=3)
+        trace = generate_trace(cfg)
+        multi = [ev for ev in trace.events if ev.n_turns > 1]
+        assert multi, "config must yield at least one multi-turn session"
+        ev0 = next(ev for ev in multi if ev.turn == 0)
+        ev1 = next(
+            ev
+            for ev in trace.events
+            if ev.session == ev0.session and ev.turn == 1
+        )
+        book = SessionBook(trace)
+        assert book.ready(ev0)
+        book.prompt_for(ev0)
+        # reply not recorded yet -> turn 1 must wait
+        assert not book.ready(ev1)
+        book.record_reply(ev0, [1, 2])
+        assert book.ready(ev1)
+
+    def test_record_reply_without_pending_raises(self):
+        trace = generate_trace(_cfg(seed=9))
+        book = SessionBook(trace)
+        with pytest.raises(ValueError):
+            book.record_reply(trace.events[0], [1])
+
+    def test_long_context_outliers(self):
+        """long_context_prob=1 forces every session to open with the
+        outlier prefix: first-turn prompts jump to ~long_context
+        size; prob=0 keeps them small. The tail exists and is
+        controllable — bench uses a small prob to stress paged-KV
+        admission."""
+        big = generate_trace(
+            _cfg(seed=4, long_context_prob=1.0, horizon_s=200.0)
+        )
+        small = generate_trace(
+            _cfg(seed=4, long_context_prob=0.0, horizon_s=200.0)
+        )
+        assert all(ev.long_context for ev in big.events if ev.turn == 0)
+        assert not any(ev.long_context for ev in small.events)
+        book_b, book_s = SessionBook(big), SessionBook(small)
+        first_b = next(ev for ev in big.events if ev.turn == 0)
+        first_s = next(ev for ev in small.events if ev.turn == 0)
+        assert len(book_b.prompt_for(first_b)) > len(
+            book_s.prompt_for(first_s)
+        )
+
+    def test_n_sessions_and_turn_counts(self):
+        cfg = _cfg(seed=6, horizon_s=300.0, turns_lo=1, turns_hi=4)
+        trace = generate_trace(cfg)
+        assert trace.n_sessions == len(
+            {ev.session for ev in trace.events}
+        )
+        for ev in trace.events:
+            assert 0 <= ev.turn < ev.n_turns
+            assert cfg.turns_lo <= ev.n_turns <= cfg.turns_hi
