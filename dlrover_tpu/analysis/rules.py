@@ -1353,6 +1353,136 @@ class TierPreemptionRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# PREFILL-001: the partial write frontier mutates only in engine
+# admission/step and decode.py prefill programs
+
+
+# engine.py functions allowed to write the frontier: construction and
+# crash reset (mint/clear the vectors), the admission that installs
+# it, the interleaved dispatcher that advances it, the release-path
+# cleanup, and the fused chunk programs themselves. Everything else —
+# scheduler, gateway, handoff, failover, tests-by-import — must treat
+# it as read-only engine state: a frontier written anywhere else can
+# desynchronize the host mirror from the device copy, and the
+# byte-parity contract of chunked prefill rests on the mirror being
+# dispatch-authoritative.
+_FRONTIER_WRITERS = frozenset(
+    {
+        "__init__",
+        "reset",
+        "_device_state",
+        "_admit",
+        "_dispatch_interleaved",
+        "_clear_prefill",
+        "_run_pf",
+        "_run_pf_paged",
+        "_run_pf_lora",
+        "_run_pf_paged_lora",
+    }
+)
+
+
+def _mentions_frontier(node: ast.AST) -> bool:
+    """Whether an assignment-target subtree names the frontier in any
+    spelling: a bare/attribute name containing "frontier"
+    (self._frontier[slot] = ..., frontier = frontier.at[...]) or a
+    "frontier" string key (d["frontier"] = ...). Reads and call NAMES
+    (e.g. self._cow_frontier(...)) are not writes and never match."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "frontier" in sub.id:
+            return True
+        if isinstance(sub, ast.Attribute) and "frontier" in sub.attr:
+            return True
+        if (
+            isinstance(sub, ast.Constant)
+            and isinstance(sub.value, str)
+            and sub.value == "frontier"
+        ):
+            return True
+    return False
+
+
+def frontier_write_sites(
+    tree: ast.AST,
+) -> List[Tuple[int, str, Optional[str]]]:
+    """(lineno, what, enclosing-function) for every statement that
+    WRITES a frontier: plain/aug/annotated assignments whose target
+    mentions it, and `frontier=` call keywords (d.update(frontier=…)
+    mutates the device-state dict exactly like a subscript store)."""
+    out = []
+    for node, owner in walk_with_owner(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if _mentions_frontier(t):
+                    out.append(
+                        (node.lineno, f"{ast.unparse(t)} = ...", owner)
+                    )
+                    break
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg is not None and "frontier" in kw.arg:
+                    out.append(
+                        (node.lineno, f"{kw.arg}=... keyword", owner)
+                    )
+                    break
+    return out
+
+
+class PrefillFrontierRule(Rule):
+    id = "PREFILL-001"
+    severity = CRITICAL
+    title = (
+        "partial write frontier mutates only in engine "
+        "admission/step and decode.py prefill programs"
+    )
+    rationale = (
+        "DEVIATIONS §19: the frontier is the mid-prefill slot's ONE "
+        "source of truth — the host mirror is dispatch-authoritative "
+        "(the fetched device copy is never folded back, so an async "
+        "harvest cannot regress it) and every byte-parity argument "
+        "for interleaved chunked prefill assumes the only writers "
+        "are the admission that installs it, the dispatcher that "
+        "advances it chunk by chunk, the release paths that clear "
+        "it, and the fused programs themselves. A write anywhere "
+        "else (scheduler policy, gateway handlers, failover replay) "
+        "can desynchronize mirror and device, corrupting resume "
+        "tickets and the flip-to-decode re-key."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        # decode.py's chunked-prefill primitives are legal writers
+        # wholesale; everything under serving/ is in scope, with
+        # engine.py reduced to the writer allowlist below
+        return _in_serving(src) and not _matches_file(
+            src.rel, DECODE_FILE
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        in_engine = _matches_file(src.rel, ENGINE_FILE)
+        out = []
+        for lineno, what, owner in frontier_write_sites(src.tree):
+            if in_engine and owner in _FRONTIER_WRITERS:
+                continue
+            out.append(
+                self.finding(
+                    src,
+                    lineno,
+                    f"{what} — the partial write frontier may only "
+                    "mutate in engine admission/step "
+                    "(_admit/_dispatch_interleaved/_clear_prefill) "
+                    "and models/decode.py prefill programs; read it "
+                    "through request_progress()/prefill_stats()",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -1373,6 +1503,7 @@ REGISTRY: List[Rule] = [
     AdapterBankRule(),
     FleetRoutingRule(),
     TierPreemptionRule(),
+    PrefillFrontierRule(),
 ]
 
 

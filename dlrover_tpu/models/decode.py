@@ -655,6 +655,61 @@ def prefill_suffix_row(
     return row
 
 
+def prefill_chunk_into_slot(
+    cfg,
+    params,
+    chunk: jax.Array,  # [C] REAL tokens only — no pad tail
+    cache: Dict[str, jax.Array],
+    slot,
+    start,
+    mesh=None,
+    adapters=None,
+) -> Dict[str, jax.Array]:
+    """Resume a slot's prefill at an arbitrary write frontier: run
+    `chunk` at positions [start, start+C), writing K/V straight into
+    row `slot` of the multi-slot bank. The chunked-admission twin of
+    `prefill_into_slot` — instead of one synchronous whole-prompt
+    prefill, the engine calls this once per budgeted chunk until the
+    frontier reaches the prompt end.
+
+    Byte-exactness of the resume is the `prefill_suffix_row`
+    argument: chunk queries attend over the already-installed cells
+    [0, start) AND the chunk itself through the position-masked
+    cached-attention path (each chunk position is written before it
+    is read), so the K/V this writes equals what one blocking prefill
+    would have written — exactly, for exact banks. An int8 bank
+    dequantizes the earlier chunks' cells where blocking prefill
+    attends full-precision activations, so chunked int8 prefill is
+    self-consistent but not bit-par with blocking (DEVIATIONS §19).
+
+    `slot` and `start` are traced scalars; C is static (the engine
+    quantizes chunk lengths down to powers of two, so the tail costs
+    log2(prefill_chunk) compiles, never one per remainder). The
+    chunk carries no pad tail by contract — every cell written is a
+    real prompt cell, which is what lets the next chunk resume at
+    start+C without a masked garbage gap."""
+    c = chunk.shape[0]
+    row = {}
+    for name, arr in cache.items():
+        size = (arr.shape[0], 1) + arr.shape[2:]
+        row[name] = jax.lax.dynamic_slice(
+            arr, (0, slot) + (0,) * (arr.ndim - 2), size
+        )
+    positions = (jnp.asarray(start, jnp.int32) + jnp.arange(c))[None]
+    _, row = _forward_cached(
+        cfg, params, chunk[None], row, positions, start, mesh=mesh,
+        adapters=adapters,
+    )
+    out = {}
+    for name, arr in cache.items():
+        out[name] = jax.lax.dynamic_update_slice(
+            arr,
+            row[name].astype(arr.dtype),
+            (0, slot) + (0,) * (arr.ndim - 2),
+        )
+    return out
+
+
 def install_exact_row(
     cache: Dict[str, jax.Array], row: Dict[str, jax.Array], slot
 ) -> Dict[str, jax.Array]:
@@ -1044,6 +1099,38 @@ def paged_install_row(
             src[name].astype(arr.dtype)
         )
     return out
+
+
+def paged_prefill_chunk(
+    cfg,
+    params,
+    chunk: jax.Array,       # [C] REAL tokens only — no pad tail
+    pool: Dict[str, jax.Array],
+    table_row: jax.Array,   # [P] the slot's REAL page ids
+    start,
+    mesh=None,
+    adapters=None,
+) -> Dict[str, jax.Array]:
+    """Paged twin of `prefill_chunk_into_slot`: run `chunk` at
+    positions [start, start+C), scattering K/V through `table_row`'s
+    pages (the same `_write_pages_and_attend` path every paged
+    forward uses, so int8 pools quantize on write identically).
+
+    The caller passes the slot's REAL table row — never the
+    trash-routed table the fused chunk program's decode half sees: a
+    mid-prefill slot rides with device done=True so the decode scan
+    freezes it (its frozen rewrites trash-route exactly like any done
+    row's), while its prefill writes land in its owned pages here.
+    The engine allocates the slot's full page run at admission, so
+    every chunk position maps to an owned page."""
+    c = chunk.shape[0]
+    positions = (jnp.asarray(start, jnp.int32) + jnp.arange(c))[None]
+    _, pool = _forward_paged(
+        cfg, params, chunk[None], pool, table_row[None], positions,
+        mesh=mesh,
+        adapters=adapters,
+    )
+    return pool
 
 
 def pool_copy_page(

@@ -96,10 +96,12 @@ from dlrover_tpu.models.decode import (
     install_exact_row,
     paged_decode_step,
     paged_install_row,
+    paged_prefill_chunk,
     paged_verify_step,
     pool_copy_page,
     pool_put_row,
     pool_take_row,
+    prefill_chunk_into_slot,
     prefill_exact_row,
     prefill_into_slot,
     prefill_suffix_row,
@@ -479,6 +481,222 @@ def _build_chunk_program(
     return {"dense": _run_chunk_lora, "paged": _run_chunk_paged_lora}
 
 
+def _build_pf_chunk_program(
+    cfg, pad_id, eos_id, temperature, top_k, top_p, mesh=None,
+    adapters=False,
+):
+    """Interleaved chunked-prefill variant of the chunk program: ONE
+    compiled dispatch runs up to `prefill_chunk` tokens of a pending
+    prompt's prefill (positions [pstart, pstart+C) of slot `pslot`)
+    AND a k-step decode scan over every live slot — so a cold
+    admission stops monopolizing the step loop and decode TPOT stays
+    bounded while long prompts stream in chunk by chunk.
+
+    The decode half is the `_build_chunk_program` scan verbatim (same
+    `_advance`, same trash-routing, same gather/scatter window off
+    TPU); the prefilling slot rides through it FROZEN (device
+    done=True — its rewrites are dead by the position mask dense-side
+    and trash-routed paged-side), so interleaving changes nothing the
+    live rows can observe. The prefill half writes through
+    models/decode.py's chunked-prefill primitives, which attend the
+    already-installed cells — the `prefill_suffix_row` byte-exactness
+    argument, chunk by chunk.
+
+    `frontier` is the per-slot partial-write frontier ([B] int32,
+    device-resident beside tok/pos/done); the program advances
+    `pslot`'s entry past the chunk it just wrote. Built only when
+    `prefill_chunk > 0`: the plain program, its cache keys, and the
+    pc=0 engine are structurally untouched (the parity oracle)."""
+
+    def _warp(logits):
+        logits = logits / temperature
+        if 0 < top_k < logits.shape[-1]:
+            logits = _mask_top_k(logits, top_k)
+        if top_p < 1.0:
+            logits = _mask_top_p(logits, top_p)
+        return logits
+
+    def _advance(logits, tok, pos, done, limit, keys):
+        if temperature <= 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            pair = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+            keys, subs = pair[:, 0], pair[:, 1]
+            nxt = jax.vmap(
+                lambda l, kk: jax.random.categorical(kk, l)
+            )(_warp(logits), subs).astype(jnp.int32)
+        nxt = jnp.where(done, pad_id, nxt)
+        hit_eos = (
+            (nxt == eos_id)
+            if eos_id is not None
+            else jnp.zeros_like(done)
+        )
+        new_done = done | hit_eos | (pos + 2 >= limit)
+        pos = jnp.where(done, pos, pos + 1)
+        tok = jnp.where(done, tok, nxt)
+        return tok, pos, new_done, keys, nxt
+
+    on_tpu = jax.default_backend() == "tpu"
+
+    @partial(jax.jit, donate_argnums=(0,), static_argnums=(8,))
+    def _run_pf(
+        cache, params, tok, pos, done, limit, keys, frontier, k,
+        ptoks, pslot, pstart,
+    ):
+        cache = prefill_chunk_into_slot(
+            cfg, params, ptoks, cache, pslot, pstart, mesh=mesh
+        )
+        frontier = frontier.at[pslot].set(pstart + ptoks.shape[0])
+
+        def body(carry, _):
+            cache, tok, pos, done, keys = carry
+            logits, cache = decode_step(
+                cfg, params, tok, cache, pos, mesh=mesh
+            )
+            tok, pos, done, keys, nxt = _advance(
+                logits, tok, pos, done, limit, keys
+            )
+            return (cache, tok, pos, done, keys), nxt
+
+        (cache, tok, pos, done, keys), emitted = jax.lax.scan(
+            body, (cache, tok, pos, done, keys), None, length=k,
+        )
+        return cache, tok, pos, done, keys, frontier, emitted.T
+
+    @partial(jax.jit, donate_argnums=(0,), static_argnums=(9,))
+    def _run_pf_paged(
+        pool, table, params, tok, pos, done, limit, keys, frontier,
+        k, ptoks, pslot, pstart,
+    ):
+        # the prefill writes through the slot's REAL table row —
+        # gathered BEFORE the decode half trash-routes done rows
+        # (the prefilling slot IS a done row to the decode scan)
+        pool = paged_prefill_chunk(
+            cfg, params, ptoks, pool, table[pslot], pstart, mesh=mesh
+        )
+        frontier = frontier.at[pslot].set(pstart + ptoks.shape[0])
+        table = jnp.where(done[:, None], 0, table)
+        if on_tpu:
+            def body(carry, _):
+                pool, tok, pos, done, keys = carry
+                logits, pool = paged_decode_step(
+                    cfg, params, tok, pool, table, pos, mesh=mesh
+                )
+                tok, pos, done, keys, nxt = _advance(
+                    logits, tok, pos, done, limit, keys
+                )
+                return (pool, tok, pos, done, keys), nxt
+
+            (pool, tok, pos, done, keys), emitted = jax.lax.scan(
+                body, (pool, tok, pos, done, keys), None, length=k,
+            )
+            return pool, tok, pos, done, keys, frontier, emitted.T
+
+        view = gather_pool_view(pool, table)
+        start = pos
+
+        def body(carry, _):
+            cache, tok, pos, done, keys = carry
+            logits, cache = decode_step(
+                cfg, params, tok, cache, pos, mesh=mesh
+            )
+            tok, pos, done, keys, nxt = _advance(
+                logits, tok, pos, done, limit, keys
+            )
+            return (cache, tok, pos, done, keys), nxt
+
+        (view, tok, pos, done, keys), emitted = jax.lax.scan(
+            body, (view, tok, pos, done, keys), None, length=k,
+        )
+        pool = scatter_pool_window(pool, view, table, start, k)
+        return pool, tok, pos, done, keys, frontier, emitted.T
+
+    if not adapters:
+        return {"dense": _run_pf, "paged": _run_pf_paged}
+
+    @partial(jax.jit, donate_argnums=(0,), static_argnums=(8,))
+    def _run_pf_lora(
+        cache, params, tok, pos, done, limit, keys, frontier, k,
+        ptoks, pslot, pstart, abank, aidx,
+    ):
+        # the prefill half gathers the PREFILLING slot's adapter (its
+        # prompt K/V must come from the adapted projections); the
+        # decode half rides the full per-slot index vector as usual
+        ad1 = _lora_operand(abank, aidx[pslot][None])
+        cache = prefill_chunk_into_slot(
+            cfg, params, ptoks, cache, pslot, pstart, mesh=mesh,
+            adapters=ad1,
+        )
+        frontier = frontier.at[pslot].set(pstart + ptoks.shape[0])
+        ad = _lora_operand(abank, aidx)
+
+        def body(carry, _):
+            cache, tok, pos, done, keys = carry
+            logits, cache = decode_step(
+                cfg, params, tok, cache, pos, mesh=mesh, adapters=ad
+            )
+            tok, pos, done, keys, nxt = _advance(
+                logits, tok, pos, done, limit, keys
+            )
+            return (cache, tok, pos, done, keys), nxt
+
+        (cache, tok, pos, done, keys), emitted = jax.lax.scan(
+            body, (cache, tok, pos, done, keys), None, length=k,
+        )
+        return cache, tok, pos, done, keys, frontier, emitted.T
+
+    @partial(jax.jit, donate_argnums=(0,), static_argnums=(9,))
+    def _run_pf_paged_lora(
+        pool, table, params, tok, pos, done, limit, keys, frontier,
+        k, ptoks, pslot, pstart, abank, aidx,
+    ):
+        ad1 = _lora_operand(abank, aidx[pslot][None])
+        pool = paged_prefill_chunk(
+            cfg, params, ptoks, pool, table[pslot], pstart, mesh=mesh,
+            adapters=ad1,
+        )
+        frontier = frontier.at[pslot].set(pstart + ptoks.shape[0])
+        ad = _lora_operand(abank, aidx)
+        table = jnp.where(done[:, None], 0, table)
+        if on_tpu:
+            def body(carry, _):
+                pool, tok, pos, done, keys = carry
+                logits, pool = paged_decode_step(
+                    cfg, params, tok, pool, table, pos, mesh=mesh,
+                    adapters=ad,
+                )
+                tok, pos, done, keys, nxt = _advance(
+                    logits, tok, pos, done, limit, keys
+                )
+                return (pool, tok, pos, done, keys), nxt
+
+            (pool, tok, pos, done, keys), emitted = jax.lax.scan(
+                body, (pool, tok, pos, done, keys), None, length=k,
+            )
+            return pool, tok, pos, done, keys, frontier, emitted.T
+
+        view = gather_pool_view(pool, table)
+        start = pos
+
+        def body(carry, _):
+            cache, tok, pos, done, keys = carry
+            logits, cache = decode_step(
+                cfg, params, tok, cache, pos, mesh=mesh, adapters=ad
+            )
+            tok, pos, done, keys, nxt = _advance(
+                logits, tok, pos, done, limit, keys
+            )
+            return (cache, tok, pos, done, keys), nxt
+
+        (view, tok, pos, done, keys), emitted = jax.lax.scan(
+            body, (view, tok, pos, done, keys), None, length=k,
+        )
+        pool = scatter_pool_window(pool, view, table, start, k)
+        return pool, tok, pos, done, keys, frontier, emitted.T
+
+    return {"dense": _run_pf_lora, "paged": _run_pf_paged_lora}
+
+
 def _build_spec_program(
     cfg, pad_id, eos_id, temperature, top_k, top_p, mesh=None,
     adapters=False,
@@ -826,6 +1044,16 @@ def _state_cancel_prog(done, slot):
 
 
 @jax.jit
+def _state_frontier_prog(frontier, slot, val):
+    """Admission scatter for the partial write frontier ([B] int32,
+    minted only when prefill_chunk > 0). Release paths need no
+    scatter: a retired slot's stale frontier is dead — the interleaved
+    dispatcher only reads entries it set itself at admission, and the
+    pf chunk program only writes the slot it is prefilling."""
+    return frontier.at[slot].set(val)
+
+
+@jax.jit
 def _state_adapt_prog(adapt, slot, val):
     """Admission scatter for the per-slot adapter-index vector (only
     minted when multi-adapter serving is on). Release paths need no
@@ -887,6 +1115,12 @@ class _Inflight:
     dlens: Optional[np.ndarray] = None      # spec: drafted lengths
     was_live: Optional[np.ndarray] = None   # spec: live at dispatch
     version: int = 0                # weight version at dispatch
+    # interleaved dispatch: which slots were MID-PREFILL when it was
+    # built. Their fetched done=True is the freeze, not a finish, and
+    # their fetched key drifted (the scan splits every row's key);
+    # harvest must neither finish them nor let the drift reach the
+    # key mirror the journal reads.
+    pf_mask: Optional[np.ndarray] = None
 
 
 class ContinuousBatcher:
@@ -932,6 +1166,8 @@ class ContinuousBatcher:
         weight_refresh_replay: bool = True,  # live mode: replay slots
         adapter_registry=None,       # serving/adapters.AdapterRegistry
         adapter_cache_slots: int = 8,  # device adapter bank slots (LRU)
+        prefill_chunk: int = 0,  # tokens of prefill per interleaved
+                                 # dispatch (0 = blocking admission)
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -956,6 +1192,10 @@ class ContinuousBatcher:
             raise ValueError(
                 f"replica_role must be 'colocated', 'prefill' or "
                 f"'decode', got {replica_role!r}"
+            )
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}"
             )
         _check_positional_capacity(cfg, max_len)
         # ---- serving mesh (GSPMD tensor slice) --------------------------
@@ -1125,6 +1365,29 @@ class ContinuousBatcher:
                 adapter_cache_slots,
                 place=self._adapter_bank_place,
             )
+        # ---- interleaved chunked prefill --------------------------------
+        # prefill_chunk > 0 splits cold admissions into bounded chunks
+        # co-scheduled with decode: _admit installs the slot FROZEN
+        # (device done=True, zero tokens emitted) with a partial write
+        # frontier, and each dispatch fuses up to prefill_chunk prompt
+        # tokens with the usual decode scan in ONE compiled program
+        # until the frontier reaches the prompt end and the slot flips
+        # to decoding. prefill_chunk=0 keeps the blocking path — and
+        # every structure below except these tiny host vectors —
+        # bit-exact (the parity oracle).
+        self._prefill_chunk = prefill_chunk
+        self._prefilling = np.zeros(n_slots, bool)
+        self._frontier = np.zeros(n_slots, np.int32)
+        # prefill-role only: slots whose prefill is COMPLETE and
+        # parked for export. Blocking prefill-role engines never
+        # dispatch, so parked slots could stay device-live; the
+        # interleaved engine keeps dispatching while other slots
+        # stream in, so parked slots must be frozen on device and
+        # recognized at harvest (their done=True is the park, not a
+        # finish — releasing their pages would kill the export)
+        self._parked = np.zeros(n_slots, bool)
+        self._admission_stall_ms = 0.0     # time _admit blocked the loop
+        self._prefill_chunks_total = 0     # interleaved chunks dispatched
         # host MIRRORS of the slot state (tiny [B] vectors). The truth
         # lives on device in self._dev; these track it so admission
         # and scheduler decisions (_next_chunk_len, free_slots,
@@ -1260,6 +1523,26 @@ class ContinuousBatcher:
                 top_p, mesh=self.mesh, adapters=lora_on,
             ),
         )[self.kv_layout]
+        # interleaved chunked-prefill variant: bound ONLY when the
+        # knob is on, so prefill_chunk=0 engines add zero cache keys
+        # and keep the pre-PR key population bit-exact
+        self._run_pf = None
+        if self._prefill_chunk > 0:
+            key = (
+                (cfg, self.pad_id, self.eos_id, temperature, top_k,
+                 top_p, self.mesh, version, "prefill")
+                + _kernel_cache_tag() + self._adapter_tag()
+            )
+            self._bound_keys.append((_CHUNK_PROGRAMS, key))
+            self._run_pf = _cached_program(
+                _CHUNK_PROGRAMS,
+                # graftlint: allow(JIT-003) reason=hashable tuple literal assigned above and recorded in _bound_keys so a weight refresh can retire the prior version's entries
+                key,
+                lambda: _build_pf_chunk_program(
+                    cfg, self.pad_id, self.eos_id, temperature,
+                    top_k, top_p, mesh=self.mesh, adapters=lora_on,
+                ),
+            )[self.kv_layout]
         key = (
             (cfg, self.max_len, self.mesh, version)
             + _kernel_cache_tag() + self._adapter_tag()
@@ -1414,6 +1697,12 @@ class ContinuousBatcher:
             # joins the resident state ONLY when adapters are on: the
             # adapterless _dev keeps its exact pre-adapter structure
             state["adapt"] = self._replicate(jnp.asarray(self.adapt))
+        if self._prefill_chunk > 0:
+            # partial write frontier, same gating discipline: the
+            # blocking engine's _dev keeps its exact pre-PR structure
+            state["frontier"] = self._replicate(
+                jnp.asarray(self._frontier)
+            )
         return state
 
     def _next_chunk_len(self) -> int:
@@ -1434,7 +1723,15 @@ class ContinuousBatcher:
         chunk-1 steps while the others keep working."""
         # vectorized over the host-side [B] arrays (a Python generator
         # here costs O(n_slots) interpreter work EVERY chunk)
-        rem = int((self.limit - self.pos - 1)[~self.done].max())
+        live = ~self.done & ~self._prefilling & ~self._parked
+        if not live.any():
+            # only mid-prefill slots occupied: the interleaved
+            # dispatch still needs a (vacuous) decode scan — make it
+            # the cheapest one (unreachable at prefill_chunk=0, where
+            # _prefilling is identically False and step() gates on
+            # not done.all())
+            return 1
+        rem = int((self.limit - self.pos - 1)[live].max())
         k_target = max(1, min(rem, self.chunk))
         if k_target == self.chunk:
             return k_target
@@ -1711,6 +2008,12 @@ class ContinuousBatcher:
 
     def _admit(self, slot: int, req: _Request):
         p = len(req.prompt)
+        # the stall this admission charges the step loop: everything
+        # below until the state scatters runs synchronously — with
+        # prefill_chunk>0 it shrinks to host bookkeeping because the
+        # prefill itself moves into the interleaved dispatches
+        t0 = time.perf_counter()
+        pf_start: Optional[int] = None
         if req.adopted is not None:
             # cross-replica handoff: install the shipped KV run and
             # skip the prefill entirely. Cleared immediately — a later
@@ -1720,6 +2023,19 @@ class ContinuousBatcher:
 
             pkg, req.adopted = req.adopted, None
             _handoff.adopt_into_slot(self, slot, pkg)
+        elif self._prefill_chunk > 0:
+            # interleaved chunked admission: install the slot with a
+            # partial write frontier and NO prompt forward — the step
+            # loop streams the prefill in chunks fused with decode.
+            # The preempted flag clears only AFTER the allocation
+            # lands: a readmission that raises OutOfPages goes back
+            # to the queue still marked, so it keeps waiting instead
+            # of regaining preemption rights (see _admit_chunked_paged
+            # on why that would livelock)
+            pf_start = self._admit_chunked(slot, req, p)
+            if self._paged and req.preempted:
+                req.preempted = False
+                self._swap_resumes += 1
         elif self._paged:
             if req.preempted:
                 req.preempted = False
@@ -1778,14 +2094,36 @@ class ContinuousBatcher:
             d["adapt"] = _state_adapt_prog(
                 d["adapt"], slot, int(req.adapter_slot)
             )
+        if pf_start is not None:
+            # mid-prefill lifecycle state: the slot is occupied (host
+            # done=False, mirrors installed above) but FROZEN on
+            # device (done=True — the decode scans it rides through
+            # must not advance it) until the frontier reaches the
+            # prompt end and _flip_to_decode re-arms it
+            self._prefilling[slot] = True
+            self._frontier[slot] = pf_start
+            d["done"] = _state_cancel_prog(d["done"], slot)
+        if self._prefill_chunk > 0:
+            d["frontier"] = _state_frontier_prog(
+                d["frontier"], slot, pf_start if pf_start is not None else p
+            )
+        self._admission_stall_ms += (time.perf_counter() - t0) * 1e3
         self.slot_req[slot] = req
         if self.spec is not None:
             self.spec.begin_slot(slot, req.prompt)
-        if self.replica_role == "prefill":
+        if self.replica_role == "prefill" and pf_start is None:
             # admission already wrote KV cells 0..p-1: the prefill is
             # DONE. Park the request for export — step() never
-            # dispatches decode work on this role.
+            # dispatches decode work on this role. (A chunked
+            # admission parks in _flip_to_decode instead, once the
+            # frontier actually reaches the prompt end.)
             self._prefill_ready.append(req)
+            if self._prefill_chunk > 0:
+                # interleaved dispatches DO run on this role while
+                # other slots stream their prefills — freeze the
+                # parked slot so the decode half cannot advance it
+                self._parked[slot] = True
+                d["done"] = _state_cancel_prog(d["done"], slot)
 
     def _admit_with_prefix(self, slot: int, req: _Request, p: int):
         """Prefix-cached admission: install the longest cached
@@ -1843,6 +2181,108 @@ class ContinuousBatcher:
             new_row, is_new = pc.insert(req.prompt[:publish_len])
             if is_new:
                 self.pool = self._publish_fn(self.pool, work, new_row)
+
+    def _admit_chunked(self, slot: int, req: _Request, p: int):
+        """Chunked admission (prefill_chunk > 0): run NO prompt
+        forward here — only install any cached prefix and report
+        where the interleaved dispatcher must start prefilling.
+
+        Returns the initial frontier (0 for a cold prompt, the
+        matched depth for a warm one), or None when nothing is owed
+        (a full prefix hit — the slot then admits live, exactly like
+        the blocking path's hit branch). Chunked admissions never
+        publish into the prefix cache: publishing needs the exact
+        fp32 work row the blocking prefill programs return, and the
+        chunked path deliberately never materializes one."""
+        if self._paged:
+            return self._admit_chunked_paged(slot, req, p)
+        pc = self.prefix_cache
+        start = 0
+        # adaptered requests bypass the prefix cache (published
+        # prefixes are base-model K/V by contract), same as blocking
+        if pc is not None and req.adapter_id is None:
+            matched, row = pc.match(req.prompt)
+            start = min(matched, p)
+            if start > 0 and row is not None:
+                pc.acquire(row)
+                self._slot_row[slot] = row
+                # the hit program copies the WHOLE cached row; cells
+                # beyond the matched depth hold the publisher's
+                # garbage, which is dead — every chunk writes cell j
+                # before any later query attends j
+                self.cache = self._admit_hit_fn(
+                    self.cache, self.pool, slot, row
+                )
+                pc.record_admission(start)
+                if start >= p:
+                    return None
+            else:
+                start = 0
+                pc.record_admission(0)
+        return start
+
+    def _admit_chunked_paged(self, slot: int, req: _Request, p: int):
+        """Paged twin of _admit_chunked: allocate the slot's FULL
+        page run up front (every chunk position must map to an owned
+        page before the fused program writes it), share any matched
+        prefix's leading pages copy-free, and report the frontier.
+        No retreat loop: chunks are exact-length slices of the real
+        prompt, so there is no pad bucket to overrun max_len.
+
+        Swap rights are seniority-gated: only a NEVER-preempted
+        arrival may reclaim by preempting a live slot. Blocking
+        admission completes the whole prefill inside _admit, so every
+        swap round nets forward progress; a chunked admission only
+        installs a frontier, and two requests that each fit alone but
+        not together would otherwise evict each other's zero-token
+        frontiers forever (admit A, preempt mid-prefill B, readmit B,
+        preempt mid-prefill A, ...). Every preemption strips the
+        victim's swap rights, so mutual-eviction cycles cannot form:
+        a preempted readmission that cannot alloc waits in the queue
+        (step() requeues it) until a live slot retires."""
+        pc = self.prefix_cache
+        lora = req.adapter_id is not None
+        n_need = self._request_pages(req)
+        matched, row, start = 0, None, 0
+        if pc is not None and not lora:
+            matched, row = pc.match(req.prompt)
+            start = min(matched, p)
+            if row is None or row not in self._row_pages:
+                start = 0
+        shared: List[int] = []
+        if start > 0:
+            pc.acquire(row)
+            self._slot_row[slot] = row
+            shared = self._row_pages[row][: start // self.page_size]
+            self.allocator.share(shared)
+        try:
+            own = self._alloc_pages(
+                n_need - len(shared), swap_ok=not req.preempted
+            )
+        except OutOfPages:
+            if shared:
+                self.allocator.free(shared)
+                self._release_slot_row(slot)
+            raise
+        run = shared + own
+        self._slot_pages[slot] = run
+        full_hit = pc is not None and start >= p and start > 0
+        if full_hit:
+            # decode's first step rewrites cell p-1, which sits in a
+            # shared page: CoW before the table row is built so vals
+            # picks up the fresh page (mutates run in place)
+            self._cow_frontier(slot, p)
+        vals = np.full(self._pages_per_slot, TRASH_PAGE, np.int32)
+        vals[: len(run)] = run
+        self._table = _table_row_prog(self._table, slot, vals)
+        if pc is not None and not lora:
+            pc.record_admission(start)
+        if full_hit:
+            return None
+        # start is block-aligned and block % page_size == 0, so the
+        # first chunk write lands in an OWN page — shared pages are
+        # never written, no warm-path CoW needed
+        return start
 
     def _release_slot_row(self, slot: int):
         row = self._slot_row[slot]
@@ -1995,43 +2435,67 @@ class ContinuousBatcher:
         # decode rewrites cell p-1
         self._cow_frontier(slot, p)
 
-    def _alloc_pages(self, n: int) -> List[int]:
+    def _alloc_pages(self, n: int, swap_ok: bool = True) -> List[int]:
         """Allocate with reclaim: on a dry pool, evict LRU
         unreferenced prefix runs first (free memory nobody is using),
         then preempt-and-swap live requests until the allocation
-        fits. Raises OutOfPages only when nothing is left to
+        fits. `swap_ok=False` (a preempted chunked readmission)
+        stops after eviction — it may reclaim free memory but not
+        evict live work, the anti-livelock gate _admit_chunked_paged
+        documents. Raises OutOfPages only when nothing is left to
         reclaim."""
         while True:
             try:
                 return self.allocator.alloc(n)
             except OutOfPages:
-                if not self._reclaim_pages():
+                if not self._reclaim_pages(swap_ok):
                     raise
 
-    def _reclaim_pages(self) -> bool:
+    def _reclaim_pages(self, swap_ok: bool = True) -> bool:
         """One reclaim step. Eviction is strictly cheaper than
         preemption (no replay), so prefix runs go first."""
         pc = self.prefix_cache
         if pc is not None and pc.evict_lru():
             return True  # _on_prefix_evict freed the run
+        if not swap_ok:
+            return False
         slot = self._pick_preempt_slot()
         if slot is None:
             return False
         self._preempt_slot(slot)
         return True
 
+    def _slot_progress(self, slot: int) -> int:
+        """Preemption coldness of an occupied slot. Mid-decode: pos
+        (resident KV cells — the replay cost). Mid-prefill: NEGATIVE
+        (frontier - prompt length, the cells still owed) — a slot
+        that has consumed prompt but emitted nothing is strictly
+        cheaper to evict than ANY decoding slot (replay regenerates
+        zero tokens), and among prefilling slots the one furthest
+        from its prompt end is cheapest. Identical to the old
+        pos-only ranking when prefill_chunk=0 (\\_prefilling is
+        identically False)."""
+        if self._prefilling[slot]:
+            return int(self._frontier[slot]) - len(
+                self.slot_req[slot].prompt
+            )
+        return int(self.pos[slot])
+
     def _pick_preempt_slot(self) -> Optional[int]:
         """Coldest live slot = the smallest resident KV footprint
-        (fewest decoded cells): cheapest to swap out and replay.
-        Deterministic tie-break by slot index keeps parity sweeps
-        reproducible."""
-        best, best_pos = None, None
+        (fewest decoded cells; mid-prefill slots rank below every
+        decoding one): cheapest to swap out and replay. Deterministic
+        tie-break by slot index keeps parity sweeps reproducible."""
+        best, best_prog = None, None
         for slot in range(self.n_slots):
             req = self.slot_req[slot]
-            if req is None or self.done[slot]:
+            if req is None or (
+                self.done[slot] and not self._prefilling[slot]
+            ):
                 continue
-            if best_pos is None or int(self.pos[slot]) < best_pos:
-                best, best_pos = slot, int(self.pos[slot])
+            prog = self._slot_progress(slot)
+            if best_prog is None or prog < best_prog:
+                best, best_prog = slot, prog
         return best
 
     def _preempt_slot(self, slot: int) -> None:
@@ -2056,6 +2520,11 @@ class ContinuousBatcher:
             self._release_slot_pages(slot)
         if self.prefix_cache is not None:
             self._release_slot_row(slot)
+        # a mid-prefill victim re-queues with out=[] and its ORIGINAL
+        # admission key (the mirror holds it — harvest re-asserts it
+        # against scan drift): replay re-prefills from scratch,
+        # byte-identical to an undisturbed admission
+        self._clear_prefill(slot)
         self.slot_req[slot] = None
         self.done[slot] = True
         self._dev["done"] = _state_cancel_prog(self._dev["done"], slot)
@@ -2164,6 +2633,20 @@ class ContinuousBatcher:
         )
         return s
 
+    def prefill_stats(self) -> Dict[str, float]:
+        """Interleaved chunked-prefill telemetry for ServingMetrics /
+        the gateway: the knob, cumulative admission stall charged to
+        the step loop, interleaved chunks dispatched, and how many
+        slots are mid-prefill right now. Present (with zeros) even at
+        prefill_chunk=0 so the /metrics exposition — and the TTFT
+        decomposition it enables — is unconditional."""
+        return {
+            "prefill_chunk": float(self._prefill_chunk),
+            "admission_stall_ms": self._admission_stall_ms,
+            "prefill_chunks_total": float(self._prefill_chunks_total),
+            "prefilling_slots": float(int(self._prefilling.sum())),
+        }
+
     def adapter_active(self) -> Dict[str, int]:
         """Ledger-live (queued, in-slot, or finished-unretired)
         request count per adapter id — the gateway's per-adapter
@@ -2260,9 +2743,38 @@ class ContinuousBatcher:
             events = self._harvest()
             for slot in range(self.n_slots):
                 if self.done[slot] and self._queue:
-                    self._admit(slot, self._queue.popleft())
-            if not self.done.all() and self.replica_role != "prefill":
-                if self.spec is not None:
+                    req = self._queue.popleft()
+                    try:
+                        self._admit(slot, req)
+                    except OutOfPages:
+                        # chunked admission only: a preempted
+                        # readmission has no swap rights (the
+                        # anti-livelock gate), so a dry pool means
+                        # wait — requeue at the front and let the
+                        # live slots drain pages. Hard exhaustion
+                        # (nothing live to wait on) still raises,
+                        # same as the blocking path.
+                        if self._prefill_chunk == 0 or not any(
+                            self.slot_req[s] is not None
+                            for s in range(self.n_slots)
+                        ):
+                            raise
+                        self._queue.appendleft(req)
+                        break
+            can_decode = (
+                not self.done.all() and self.replica_role != "prefill"
+            )
+            pf_pending = (
+                self._prefill_chunk > 0 and bool(self._prefilling.any())
+            )
+            if can_decode or pf_pending:
+                # pf_pending dispatches even on a prefill-role replica
+                # (its chunked prefills advance ONLY through the fused
+                # program; the decode half is vacuous there) and
+                # bypasses speculation (a draft dispatch carries no
+                # prefill half — drafting resumes once no slot is
+                # mid-prefill)
+                if self.spec is not None and not pf_pending:
                     drafts, dlens = self._collect_drafts()
                     if int(dlens.max()) > 0:
                         self._dispatch_spec(drafts, dlens)
@@ -2291,6 +2803,9 @@ class ContinuousBatcher:
         return events
 
     def _dispatch_chunk(self) -> None:
+        if self._prefill_chunk > 0 and self._prefilling.any():
+            self._dispatch_interleaved()
+            return
         d = self._dev
         k = self._next_chunk_len()
         lora = self._adapter_args()
@@ -2322,6 +2837,126 @@ class ContinuousBatcher:
                 version=self._weight_version,
             )
         )
+
+    def _pf_chunk_len(self, rem: int) -> int:
+        """Tokens of prefill this dispatch carries: prefill_chunk,
+        shortened on the tail — quantized DOWN to a power of two so
+        the tail costs at most log2(prefill_chunk) extra compiles
+        (each distinct chunk length is its own traced program), and
+        NEVER padded: a padded tail would scatter pad-token K/V into
+        real cells (paged: into owned pages), which no mask could
+        make dead."""
+        c = min(self._prefill_chunk, rem)
+        k = 1
+        while k * 2 <= c:
+            k *= 2
+        return k
+
+    def _dispatch_interleaved(self) -> None:
+        """One fused dispatch: up to prefill_chunk prompt tokens of
+        the OLDEST mid-prefill slot (FIFO by request idx — one slot
+        per dispatch keeps the budget bounded) plus the usual k-step
+        decode scan over every live slot. When the chunk reaches the
+        prompt end the slot flips to decoding before the results are
+        even harvested — the flip is host bookkeeping plus one state
+        scatter that chains onto this dispatch's outputs."""
+        d = self._dev
+        k = self._next_chunk_len()
+        slot = min(
+            (
+                s for s in range(self.n_slots)
+                if self._prefilling[s]
+            ),
+            key=lambda s: self.slot_req[s].idx,
+        )
+        req = self.slot_req[slot]
+        p = len(req.prompt)
+        start = int(self._frontier[slot])
+        plen = self._pf_chunk_len(p - start)
+        ptoks = jnp.asarray(req.prompt[start:start + plen])
+        lora = self._adapter_args()
+        if self._paged:
+            pool, tok, pos, done, keys, frontier, emitted = (
+                self._run_pf(
+                    self.page_pool, self._table, self.params,
+                    d["tok"], d["pos"], d["done"], d["limit"],
+                    d["keys"], d["frontier"], k, ptoks, slot, start,
+                    *lora,
+                )
+            )
+            self.page_pool = pool
+        else:
+            cache, tok, pos, done, keys, frontier, emitted = (
+                self._run_pf(
+                    self.cache, self.params,
+                    d["tok"], d["pos"], d["done"], d["limit"],
+                    d["keys"], d["frontier"], k, ptoks, slot, start,
+                    *lora,
+                )
+            )
+            self.cache = cache
+        d.update(
+            tok=tok, pos=pos, done=done, keys=keys, frontier=frontier
+        )
+        # which slots are mid-prefill DURING this dispatch — captured
+        # BEFORE the flip: harvest must treat their fetched done=True
+        # as the freeze (not a finish) and their fetched keys as
+        # drift (the scan splits every row's key, frozen or not)
+        pf = self._prefilling.copy()
+        # the host mirror is dispatch-authoritative (the value is
+        # host-deterministic — start + plen); the fetched device copy
+        # is never folded back, so an async harvest of dispatch N-1
+        # cannot regress the frontier eagerly advanced for N
+        self._frontier[slot] = start + plen
+        self._prefill_chunks_total += 1
+        if start + plen >= p:
+            self._flip_to_decode(slot)
+        self._enqueue_fetch(
+            _Inflight(
+                kind="chunk",
+                arrays=(tok, pos, done, keys, emitted),
+                dispatched_at=0.0,
+                old_pos=self.pos.copy(),
+                version=self._weight_version,
+                pf_mask=pf,
+            )
+        )
+
+    def _flip_to_decode(self, slot: int) -> None:
+        """The frontier reached the prompt end: leave the mid-prefill
+        lifecycle state. Colocated/decode roles re-arm the slot with
+        the SAME admission scatter a blocking admission uses — and
+        the ORIGINAL admission key: the frozen rows rode the decode
+        scans, whose _advance split EVERY row's key, so the drifted
+        device key must be re-seeded or sampled output diverges from
+        the blocking oracle. Prefill-role replicas stay frozen (they
+        must never decode) and park the request for export instead —
+        frontier == prompt end IS this role's export gate."""
+        req = self.slot_req[slot]
+        self._prefilling[slot] = False
+        self.slot_key[slot] = req.prng_key
+        if self.replica_role != "prefill":
+            d = self._dev
+            d["tok"], d["pos"], d["done"], d["limit"], d["keys"] = (
+                _state_admit_prog(
+                    d["tok"], d["pos"], d["done"], d["limit"],
+                    d["keys"], slot, int(self.tok[slot]),
+                    int(self.pos[slot]), int(self.limit[slot]),
+                    self.slot_key[slot],
+                )
+            )
+        else:
+            self._parked[slot] = True
+            self._prefill_ready.append(req)
+
+    def _clear_prefill(self, slot: int) -> None:
+        """Release-path cleanup of the mid-prefill state. No device
+        scatter: a freed slot's stale device frontier is dead exactly
+        like a stale table row — the dispatcher only reads entries it
+        set at admission, and the slot is already frozen."""
+        self._prefilling[slot] = False
+        self._parked[slot] = False
+        self._frontier[slot] = 0
 
     def _collect_drafts(self):
         """Host drafting pass, batched in speculative.py: the per-slot
@@ -2406,18 +3041,41 @@ class ContinuousBatcher:
                         int(n_emit[slot]),
                     )
         self.tok, self.pos, self.slot_key = tok, pos, keys
-        return self._emit_events(emitted, counts, done, pend.version)
+        if pend.pf_mask is not None:
+            # slots that were mid-prefill during this dispatch: the
+            # fetched key is drift (the scan split every row's key,
+            # frozen or not) — the journal and preempt-replay read
+            # the key mirror, so re-assert the ORIGINAL admission key
+            for slot in range(self.n_slots):
+                if pend.pf_mask[slot]:
+                    req = self.slot_req[slot]
+                    if req is not None and req.prng_key is not None:
+                        self.slot_key[slot] = req.prng_key
+        return self._emit_events(
+            emitted, counts, done, pend.version, pend.pf_mask
+        )
 
     def _emit_events(
         self, emitted: np.ndarray, counts: np.ndarray,
         new_done: np.ndarray, version: int = 0,
+        pf_mask: Optional[np.ndarray] = None,
     ) -> List[StepEvent]:
         """Shared post-dispatch bookkeeping: `counts[slot]` leading
-        entries of `emitted[slot]` are the slot's real new tokens."""
+        entries of `emitted[slot]` are the slot's real new tokens.
+        pf_mask marks slots that were MID-PREFILL when the dispatch
+        was built: their fetched done=True is the admission freeze,
+        not a finish (counts is 0 for them — a frozen row's pos never
+        advances), so they must neither emit nor release."""
         events: List[StepEvent] = []
         for slot in range(self.n_slots):
             req = self.slot_req[slot]
             if req is None or req.done:
+                continue
+            if pf_mask is not None and pf_mask[slot]:
+                continue
+            if self._parked[slot]:
+                # prefill-role: done=True is the park freeze, not a
+                # finish — the pages must survive until export
                 continue
             new_toks = [
                 int(t) for t in emitted[slot][: int(counts[slot])]
@@ -2453,6 +3111,14 @@ class ContinuousBatcher:
         for slot in range(self.n_slots):
             if self.slot_req[slot] is None:
                 self.done[slot] = True
+            elif (pf_mask is not None and pf_mask[slot]) or (
+                self._parked[slot]
+            ):
+                # the fetched done carried the admission/park freeze;
+                # the HOST mirror's truth is "occupied" — without
+                # this the scheduler would re-admit over a
+                # mid-prefill (or awaiting-export) slot
+                self.done[slot] = False
         return events
 
     def retire(self, idx: int) -> np.ndarray:
@@ -2480,6 +3146,7 @@ class ContinuousBatcher:
                     self._release_slot_pages(slot)
                 if self.prefix_cache is not None:
                     self._release_slot_row(slot)
+                self._clear_prefill(slot)
         try:
             self._prefill_ready.remove(req)
         except ValueError:
@@ -2533,21 +3200,26 @@ class ContinuousBatcher:
                     self._release_slot_pages(slot)
                 if self.prefix_cache is not None:
                     self._release_slot_row(slot)
+                self._clear_prefill(slot)
                 break
         if req.adapter_id is not None:
             self._adapter_cache.release(req.adapter_id)
 
     def request_progress(self, idx: int) -> Optional[int]:
-        """Resident KV footprint (cells written) of a live request,
-        from the host mirrors — the scheduler's coldest-victim choice
-        for admission preemption reads this so its notion of "least
-        progress" is the engine's own (same quantity
-        _pick_preempt_slot orders by). None when the request is not
-        occupying a slot (still engine-queued: zero footprint)."""
+        """Preemption coldness of a live request, from the host
+        mirrors — the scheduler's coldest-victim choice for admission
+        preemption reads this so its notion of "least progress" is
+        the engine's own (the same _slot_progress quantity
+        _pick_preempt_slot orders by). Mid-decode: pos, the resident
+        KV cells (>= 0). Mid-prefill: NEGATIVE — frontier minus
+        prompt length, the cells still owed — so a
+        prefilled-but-unemitted slot always ranks colder than any
+        decoding one. None when the request is not occupying a slot
+        (still engine-queued: zero footprint)."""
         for slot in range(self.n_slots):
             req = self.slot_req[slot]
             if req is not None and not self.done[slot] and req.idx == idx:
-                return int(self.pos[slot])
+                return self._slot_progress(slot)
         return None
 
     def live_request_keys(self) -> Dict[int, np.ndarray]:
@@ -2603,6 +3275,11 @@ class ContinuousBatcher:
         self.done[:] = True
         self.slot_key[:] = 0
         self.adapt[:] = 0
+        # mid-prefill lifecycle state dies with the slots (the stall
+        # and chunk counters survive: they are cumulative telemetry)
+        self._prefilling[:] = False
+        self._parked[:] = False
+        self._frontier[:] = 0
         if self._adapter_cache is not None:
             # drop every ledger pin (the ledger itself is dropped
             # below) and re-mint the bank: a crash mid-upload leaves

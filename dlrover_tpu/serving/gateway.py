@@ -352,6 +352,13 @@ class ServingGateway:
                 active = getattr(engine, "adapter_active", None)
                 if callable(active):
                     out["adapters"]["active"] = active()
+        # interleaved chunked prefill: the knob, cumulative admission
+        # stall, fused chunk dispatches, and live mid-prefill slots
+        # (same duck-typing — engines without prefill_stats, and
+        # pool backends, skip the block)
+        pfstats = getattr(engine, "prefill_stats", None)
+        if callable(pfstats):
+            out["prefill"] = pfstats()
         # fleet front door: digest-map occupancy + affinity knobs
         # (pool backends only — a single scheduler has no fleet;
         # same duck-typing as the blocks above)

@@ -125,6 +125,10 @@ class ServingMetrics:
             "_tier_shed",
             "_tier_ttft",
             "_tier_tpot",
+            "_prefill_chunk",
+            "_admission_stall_ms",
+            "_prefill_chunks_total",
+            "_prefilling_slots",
         }
     )
 
@@ -253,6 +257,16 @@ class ServingMetrics:
         self._tier_shed = {t: 0 for t in self.TIER_LABELS}
         self._tier_ttft = {t: _Window(window) for t in self.TIER_LABELS}
         self._tier_tpot = {t: _Window(window) for t in self.TIER_LABELS}
+        # interleaved chunked prefill: TTFT decomposition telemetry,
+        # copied from the engine's prefill_stats() each pump. The
+        # stall counter is the admission time charged to the step
+        # loop (what chunking exists to shrink); chunks_total counts
+        # fused prefill+decode dispatches. Both rendered even at
+        # prefill_chunk=0 so dashboards can difference the knob.
+        self._prefill_chunk = 0
+        self._admission_stall_ms = 0.0
+        self._prefill_chunks_total = 0
+        self._prefilling_slots = 0
 
     # ---- ingestion -------------------------------------------------------
 
@@ -511,6 +525,26 @@ class ServingMetrics:
             self._adapter_slots = int(stats.get("slots", 0))
             self._adapter_active = int(
                 stats.get("active_requests", 0)
+            )
+
+    def update_prefill(self, stats: Dict[str, float]):
+        """Refresh interleaved chunked-prefill telemetry from the
+        engine's prefill_stats(). Stall/chunk totals get the same
+        max() monotonic guard as the blocks above (a restarted engine
+        must not rewind the exposition); the knob and the mid-prefill
+        slot count are gauges."""
+        with self._lock:
+            self._prefill_chunk = int(stats.get("prefill_chunk", 0))
+            self._admission_stall_ms = max(
+                self._admission_stall_ms,
+                float(stats.get("admission_stall_ms", 0.0)),
+            )
+            self._prefill_chunks_total = max(
+                self._prefill_chunks_total,
+                int(stats.get("prefill_chunks_total", 0)),
+            )
+            self._prefilling_slots = int(
+                stats.get("prefilling_slots", 0)
             )
 
     def affinity_routed(self, matched: bool, capped: bool = False):
@@ -1077,6 +1111,31 @@ class ServingMetrics:
                 "Fraction of device span hidden behind host work "
                 "(~0 synchronous, toward 1 under async dispatch).",
                 self._step_overlap_ratio,
+            )
+            counter(
+                "serving_admission_stall_ms",
+                "Time admissions blocked the step loop (prompt "
+                "prefill + install), ms — the TTFT component "
+                "interleaved chunked prefill shrinks.",
+                f"{self._admission_stall_ms:.6g}",
+            )
+            counter(
+                "serving_prefill_chunks_total",
+                "Fused prefill+decode dispatches (interleaved "
+                "chunked prefill).",
+                self._prefill_chunks_total,
+            )
+            gauge(
+                "serving_prefill_chunk_tokens",
+                "prefill_chunk knob: prompt tokens budgeted per "
+                "interleaved dispatch (0 = blocking admission).",
+                self._prefill_chunk,
+            )
+            gauge(
+                "serving_prefilling_slots",
+                "Slots currently mid-prefill (partial write "
+                "frontier short of the prompt end).",
+                self._prefilling_slots,
             )
             gauge(
                 "serving_paged_pool_occupancy",

@@ -640,9 +640,22 @@ class RequestScheduler:
             if r.effective_tier != TIERS[-1]:
                 continue
             prog = progress(idx) if progress is not None else None
-            if prog is None:  # engine-queued: zero resident KV
-                prog = -1
-            key = (prog, idx)
+            # three coldness classes, coldest first: engine-queued
+            # (no footprint at all), mid-prefill (the engine reports
+            # NEGATIVE progress — prompt consumed, zero tokens
+            # emitted: replay regenerates nothing), then decoding
+            # ranked by resident KV cells. The old None->-1 sentinel
+            # cannot survive real negative progress: a deeply
+            # mid-prefill slot (say -40) would rank COLDER than an
+            # engine-queued request (-1) that has no footprint at
+            # all, and the sentinel would alias a slot one cell shy
+            # of its prompt end.
+            if prog is None:
+                key = (0, 0, idx)
+            elif prog < 0:
+                key = (1, prog, idx)
+            else:
+                key = (2, prog, idx)
             if victim_key is None or key < victim_key:
                 victim_key, victim_idx = key, idx
         if victim_idx is None:
@@ -896,6 +909,9 @@ class RequestScheduler:
                 a = astats()
                 if a:
                     self.metrics.update_adapters(a)
+            pfstats = getattr(self.engine, "prefill_stats", None)
+            if pfstats is not None:
+                self.metrics.update_prefill(pfstats())
             busy = bool(self._running) or any(
                 self._waiting[t] for t in TIERS
             )

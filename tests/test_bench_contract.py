@@ -295,6 +295,17 @@ def test_serve_bench_smoke_emits_driver_contract():
         "trace_forecast_first_up_idx",
         "trace_forecast_peak_idx",
         "trace_forecast_lead_buckets",
+        # interleave phase: chunked prefill on one colocated replica
+        "interleave_blocking_tpot_p99_ms",
+        "interleave_tpot_p99_ms",
+        "interleave_tpot_p99_ratio",
+        "interleave_parity_ok",
+        "interleave_success_rate",
+        "interleave_prefill_chunk",
+        "interleave_chunks_total",
+        "interleave_stall_ms",
+        "interleave_blocking_stall_ms",
+        "n_interleave_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -519,3 +530,25 @@ def test_serve_bench_smoke_emits_driver_contract():
         < detail["trace_forecast_peak_idx"]
     )
     assert detail["trace_forecast_lead_buckets"] >= 1
+    # the interleave acceptance floor: on phase 9's own mixed
+    # long-prefill/short-decode workload, ONE colocated replica with
+    # the prefill_chunk knob on must bound the shorts' decode TPOT
+    # p99 to at most HALF of blocking admission — the disagg latency
+    # win without paying a second replica. Byte parity across all
+    # four runs (the knob changes WHEN work runs, never its bytes)
+    # and success 1.0 ride along, and the TTFT decomposition must
+    # show the stall actually moved out of _admit: the interleaved
+    # leg's admission stall is a fraction of blocking's, with the
+    # prefill work accounted as fused chunk dispatches instead
+    assert 0.0 < detail["interleave_tpot_p99_ratio"] <= 0.5
+    assert detail["interleave_tpot_p99_ms"] > 0
+    assert detail["interleave_blocking_tpot_p99_ms"] > 0
+    assert detail["interleave_parity_ok"] is True
+    assert detail["interleave_success_rate"] == 1.0
+    assert detail["interleave_prefill_chunk"] > 0
+    assert detail["interleave_chunks_total"] >= 1
+    assert (
+        detail["interleave_stall_ms"]
+        < detail["interleave_blocking_stall_ms"]
+    )
+    assert detail["n_interleave_requests"] > 0

@@ -17,6 +17,7 @@ bench preflights rely on).
 """
 
 import json
+import pathlib
 import subprocess
 import sys
 import textwrap
@@ -44,10 +45,12 @@ from dlrover_tpu.analysis.rules import (
     JitSelfCaptureRule,
     KernelHygieneRule,
     LockDisciplineRule,
+    PrefillFrontierRule,
     ProgramCacheKeyRule,
     RawMeshRule,
     RlImportRule,
     TierPreemptionRule,
+    frontier_write_sites,
     get_rules,
 )
 
@@ -881,6 +884,120 @@ def test_tier_rule_ignores_outside_serving(tmp_path):
         rel="tests/test_serving_tiers.py",
     )
     assert not hits(TierPreemptionRule(), src)
+
+
+# ---------------------------------------------------------------------------
+# PREFILL-001: partial write frontier mutates only in engine
+# admission/step and decode.py prefill programs
+
+
+def test_prefill_rule_flags_outside_writers(tmp_path):
+    # every write spelling: host-mirror subscript store, device-dict
+    # key store, and the d.update(frontier=...) keyword — a scheduler
+    # (or any non-engine serving module) touching any of them is a
+    # CRITICAL finding
+    src = probe(
+        tmp_path,
+        """
+        def rebalance(self, slot):
+            self.engine._frontier[slot] = 0
+            self.engine._dev["frontier"] = zeros
+            self.engine._dev.update(frontier=zeros)
+        """,
+        rel="dlrover_tpu/serving/scheduler.py",
+    )
+    rule = PrefillFrontierRule()
+    found = hits(rule, src)
+    assert len(found) == 3
+    assert rule.severity == CRITICAL  # rides the bench preflight gate
+    assert all("request_progress" in f.message for f in found)
+
+
+def test_prefill_rule_allows_engine_writers(tmp_path):
+    # the engine allowlist: admission installs, the interleaved
+    # dispatcher advances, the release path clears
+    src = probe(
+        tmp_path,
+        """
+        def _admit(self, slot, req):
+            self._frontier[slot] = start
+
+        def _dispatch_interleaved(self):
+            d.update(frontier=frontier)
+
+        def _clear_prefill(self, slot):
+            self._frontier[slot] = 0
+        """,
+        rel="dlrover_tpu/serving/engine.py",
+    )
+    assert not hits(PrefillFrontierRule(), src)
+
+
+def test_prefill_rule_vacuity_of_engine_allowlist(tmp_path):
+    # the allowlisted owner names are exempt ONLY inside engine.py —
+    # the same function impersonating another serving module is
+    # flagged, so the exemption can never silently widen
+    code = """
+    def _dispatch_interleaved(self):
+        self._frontier[slot] = start
+    """
+    src = probe(
+        tmp_path, code, rel="dlrover_tpu/serving/engine.py"
+    )
+    assert not hits(PrefillFrontierRule(), src)
+    src = probe(tmp_path, code, rel=SERVING_REL)
+    assert len(hits(PrefillFrontierRule(), src)) == 1
+    # an engine function OFF the allowlist is flagged too
+    src = probe(
+        tmp_path,
+        """
+        def _harvest(self):
+            self._frontier[slot] = fetched
+        """,
+        rel="dlrover_tpu/serving/engine.py",
+    )
+    assert len(hits(PrefillFrontierRule(), src)) == 1
+
+
+def test_prefill_rule_ignores_reads_and_decode(tmp_path):
+    # reads (progress ranking, stats) and call names are never
+    # writes; decode.py's chunk-resume primitives are legal writers
+    # wholesale
+    src = probe(
+        tmp_path,
+        """
+        def _slot_progress(self, slot):
+            self._cow_frontier(slot, p)
+            return int(self._frontier[slot]) - plen
+        """,
+        rel="dlrover_tpu/serving/scheduler.py",
+    )
+    assert not hits(PrefillFrontierRule(), src)
+    src = probe(
+        tmp_path,
+        """
+        def prefill_chunk_into_slot(cfg, params, chunk, cache, slot):
+            frontier = frontier.at[slot].set(start)
+        """,
+        rel="dlrover_tpu/models/decode.py",
+    )
+    assert not hits(PrefillFrontierRule(), src)
+
+
+def test_prefill_rule_not_vacuous_on_real_engine():
+    # the walker must see the real engine's frontier writes (the
+    # rule has something to protect) and the allowlist must cover
+    # every one of them (the tree stays clean)
+    root = pathlib.Path(analysis.__file__).resolve().parents[2]
+    src = SourceFile.parse(
+        root / "dlrover_tpu" / "serving" / "engine.py",
+        rel="dlrover_tpu/serving/engine.py",
+    )
+    sites = frontier_write_sites(src.tree)
+    assert len(sites) >= 4, "real engine frontier writes not seen"
+    owners = {owner for _, _, owner in sites}
+    assert "_admit" in owners and "_dispatch_interleaved" in owners
+    assert not hits(PrefillFrontierRule(), src)
 
 
 # ---------------------------------------------------------------------------

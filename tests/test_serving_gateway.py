@@ -506,6 +506,55 @@ class TestMetricsEndpoint:
             gw.stop()
             sched.stop()
 
+    def test_prefill_interleave_exposition(self, model):
+        """With interleaved chunked prefill on, /metrics carries the
+        TTFT decomposition (admission stall vs chunk count) and
+        /healthz the prefill block — the knob, totals, and how many
+        slots sit mid-prefill right now."""
+        cfg, params = model
+        eng = ContinuousBatcher(
+            cfg, params, n_slots=2, max_len=64, max_new_tokens=4,
+            chunk=4, pad_id=-1, prefill_chunk=4,
+        )
+        metrics = ServingMetrics()
+        sched = RequestScheduler(eng, SloConfig(), metrics=metrics)
+        sched.start()
+        gw = ServingGateway(sched, metrics=metrics)
+        gw.start()
+        try:
+            prompt = _prompts((24,), seed=6)[0]
+            toks, trailer = _post_stream(gw.port, prompt, max_new=4)
+            assert trailer["state"] == "done"
+            assert toks == lockstep_oracle(cfg, params, prompt, 4)
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=30
+            )
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+            conn.close()
+            for needle in (
+                "# TYPE serving_admission_stall_ms counter",
+                "# TYPE serving_prefill_chunks_total counter",
+                "serving_prefill_chunk_tokens 4",
+                "serving_prefilling_slots 0",
+            ):
+                assert needle in text, text
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=30
+            )
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            conn.close()
+            assert health["ok"] is True
+            assert health["prefill"]["prefill_chunk"] == 4
+            # 24-token prompt at a 4-token budget: several chunks
+            assert health["prefill"]["prefill_chunks_total"] >= 2
+            assert health["prefill"]["prefilling_slots"] == 0
+            assert health["prefill"]["admission_stall_ms"] >= 0.0
+        finally:
+            gw.stop()
+            sched.stop()
+
     def test_step_timing_exposition(self, model):
         """The dispatch micro-metrics reach /metrics: host vs device
         time per step, the dispatch counter, and the overlap-ratio
