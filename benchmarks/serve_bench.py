@@ -1921,6 +1921,135 @@ def main():
     il_stats = il_runs[-1][3]
     il_block_stats = il_block_runs[-1][3]
 
+    # ---- phase 15: host-DRAM KV tier (serving/kv_tier.py) -------------
+    # The missing rung of the memory hierarchy behind the prefix
+    # cache: a working set of tenant system prompts SEVERAL TIMES the
+    # device prefix pool (prefix_cache_rows=1) churns through a
+    # byte-capacity host tier. Round 1 publishes each tenant cold —
+    # every publish LRU-evicts the previous tenant's row, which the
+    # tiered engine demotes to host DRAM and the untiered one drops.
+    # Round 2 revisits every tenant: untiered pays the full cold
+    # re-prefill, tiered promotes the stored bytes back over PCIe.
+    # Locks: tiered warm TTFT p50 strictly under the untiered cold
+    # re-prefill p50 (PCIe beats recompute at the FLOPs-dominant
+    # scale), a promote hit-rate floor, byte parity (the tier never
+    # changes a token), success 1.0 — and, on the paged pressure leg,
+    # at least one preempted victim resumed from host bytes instead
+    # of replay. DEVIATIONS §20.
+    kt_tenants = 8 if on_tpu else 6
+    kt_rows = 1
+    ktrng = np.random.default_rng(15)
+    kt_prefixes = [
+        ktrng.integers(
+            1, min(500, pcfg.vocab_size), size=sys_len
+        ).tolist()
+        for _ in range(kt_tenants + 2)  # +2 warm-up tenants
+    ]
+    kt_tails = [
+        [
+            ktrng.integers(
+                1, min(500, pcfg.vocab_size), size=int(t)
+            ).tolist()
+            for t in ktrng.integers(2, 9, size=kt_tenants)
+        ]
+        for _ in range(2)  # distinct per-round turn suffixes
+    ]
+
+    def _kt_ttft_pass(tier_bytes):
+        """Drive the churn workload one request at a time (TTFT =
+        admission + first chunk, no queue wait). Returns the engine,
+        every output stream, per-round sorted TTFTs, and whether all
+        requests completed."""
+        kteng = ContinuousBatcher(
+            pcfg, pparams, n_slots=p_slots, max_len=p_max_len,
+            max_new_tokens=p_max_new, chunk=p_chunk, pad_id=-1,
+            prefix_cache_rows=kt_rows, kv_tier_bytes=tier_bytes,
+        )
+        ktsched = RequestScheduler(
+            kteng,
+            SloConfig(
+                max_queue_depth=2 * kt_tenants + 4,
+                max_new_tokens=p_max_new,
+                default_deadline_s=600.0,
+            ),
+            metrics=ServingMetrics(),
+        )
+        kt_outs = []
+        kt_ok = [True]
+
+        def _one(prompt, ttfts=None):
+            r = ktsched.submit(prompt, max_new=p_max_new)
+            ktsched.run_to_completion()
+            kt_outs.append(list(r.tokens))
+            kt_ok[0] &= r.state.value == "done"
+            if ttfts is not None:
+                ttfts.append(
+                    (r.first_token_ts - r.submit_ts) * 1000.0
+                )
+
+        # warm-up: cold publish, churn-evict (demote), revisit
+        # (promote) — every program the timed rounds need compiles
+        # here, outside the timed region
+        _one(kt_prefixes[kt_tenants])
+        _one(kt_prefixes[kt_tenants + 1])
+        _one(kt_prefixes[kt_tenants] + kt_tails[0][0])
+        cold_ts, revisit_ts = [], []
+        for rnd, ts in ((0, cold_ts), (1, revisit_ts)):
+            for i in range(kt_tenants):
+                _one(kt_prefixes[i] + kt_tails[rnd][i], ts)
+        return (
+            kteng, kt_outs, sorted(cold_ts), sorted(revisit_ts),
+            kt_ok[0],
+        )
+
+    _kt0_eng, kt0_outs, _kt0_cold, kt0_revisit, kt0_ok = (
+        _kt_ttft_pass(0)
+    )
+    kt1_eng, kt1_outs, kt1_cold, kt1_warm, kt1_ok = _kt_ttft_pass(
+        256 << 20
+    )
+    kt_parity_ok = kt0_outs == kt1_outs
+    kt_success = 1.0 if (kt0_ok and kt1_ok) else 0.0
+    kt_stats = kt1_eng.kv_tier_stats()
+    # the cold-prefill baseline is the UNTIERED engine's revisit
+    # round: the identical request stream, the only delta is the tier
+    kt_cold_p50 = pct(kt0_revisit, 0.5)
+    kt_warm_p50 = pct(kt1_warm, 0.5)
+
+    # paged pressure leg: the oversubscribed pool preempts under
+    # admission pressure; with the tier on, every victim must swap to
+    # host and resume from the stored bytes instead of replaying
+    ktsrng = np.random.default_rng(7)
+    kt_swap_prompts = [
+        ktsrng.integers(1, 250, size=int(n)).tolist()
+        for n in ktsrng.integers(12, 30, size=8)
+    ]
+
+    def _kt_swap(tier_bytes):
+        kseng = ContinuousBatcher(
+            cfg, params, n_slots=3, max_len=64, max_new_tokens=12,
+            chunk=4, pad_id=-1, kv_layout="paged", page_size=8,
+            n_pages=14, kv_tier_bytes=tier_bytes,
+        )
+        ksouts = [
+            [int(t) for t in o]
+            for o in kseng.generate_all(kt_swap_prompts)
+        ]
+        return kseng, ksouts
+
+    kts0_eng, kts0_outs = _kt_swap(0)
+    kts1_eng, kts1_outs = _kt_swap(64 << 20)
+    kt_swap_parity_ok = kts0_outs == kts1_outs
+    kts_stats = kts1_eng.kv_tier_stats()
+    kts_paged = kts1_eng.paged_stats()
+    kts0_paged = kts0_eng.paged_stats()
+    kt_swap_success = (
+        1.0
+        if kts_paged["swap_resumes"] == kts_paged["swap_preemptions"]
+        and kts0_paged["swap_preemptions"] > 0
+        else 0.0
+    )
+
     print(
         json.dumps(
             {
@@ -2261,6 +2390,38 @@ def main():
                     ),
                     "n_interleave_requests": (
                         n_d_short + n_d_long
+                    ),
+                    # kv-tier phase: host-DRAM tier evidence axes
+                    "kvtier_cold_ttft_ms_p50": round(
+                        kt_cold_p50, 2
+                    ),
+                    "kvtier_warm_ttft_ms_p50": round(
+                        kt_warm_p50, 2
+                    ),
+                    "kvtier_ttft_ratio": round(
+                        kt_warm_p50 / max(kt_cold_p50, 1e-9), 3
+                    ),
+                    "kvtier_parity_ok": kt_parity_ok,
+                    "kvtier_success_rate": kt_success,
+                    "kvtier_promote_hit_rate": round(
+                        kt_stats["promote_hit_rate"], 3
+                    ),
+                    "kvtier_demotions": int(kt_stats["demotions"]),
+                    "kvtier_promotions": int(
+                        kt_stats["promotions"]
+                    ),
+                    "kvtier_working_set_x": int(
+                        kt_tenants // kt_rows
+                    ),
+                    "kvtier_swap_outs": int(
+                        kts_stats["swap_outs"]
+                    ),
+                    "kvtier_swap_ins": int(kts_stats["swap_ins"]),
+                    "kvtier_swap_parity_ok": kt_swap_parity_ok,
+                    "kvtier_swap_success_rate": kt_swap_success,
+                    "n_kvtier_requests": (
+                        2 * (2 * kt_tenants + 3)
+                        + 2 * len(kt_swap_prompts)
                     ),
                 },
             }

@@ -27,6 +27,7 @@ DECODE_FILE = "dlrover_tpu/models/decode.py"
 ENGINE_FILE = SERVING_PREFIX + "engine.py"
 PAGED_KV_FILE = SERVING_PREFIX + "paged_kv.py"
 HANDOFF_FILE = SERVING_PREFIX + "handoff.py"
+KV_TIER_FILE = SERVING_PREFIX + "kv_tier.py"
 
 
 def _in_serving(src: SourceFile) -> bool:
@@ -159,6 +160,10 @@ HOST_COPY_ALLOWED: Dict[str, FrozenSet[str]] = {
     # point; export_run's np.asarray only copies the host-resident
     # prompt (engine.py's submit/_admit category), never KV
     HANDOFF_FILE: frozenset({"_host_bounce", "export_run"}),
+    # kv_tier.py: _fetch is the tier's single blocking-fetch site —
+    # demotion staging goes through it after the async D2H copies
+    # were started (same discipline as engine._to_host)
+    KV_TIER_FILE: frozenset({"_fetch"}),
 }
 
 
@@ -1041,6 +1046,10 @@ _RESHARD_ALLOWED: Dict[str, FrozenSet[str]] = {
         {"__init__", "_shard_params", "_shard_bank", "_replicate"}
     ),
     HANDOFF_FILE: frozenset({"adopt_into_slot"}),
+    # kv_tier.py: promotion places host-tier bytes back onto the
+    # POOL's existing sharding (a transfer, not a resize — the same
+    # category as handoff adoption)
+    KV_TIER_FILE: frozenset({"upload_row", "upload_pages"}),
 }
 
 
@@ -1191,7 +1200,10 @@ class AdapterBankRule(Rule):
 
 AFFINITY_FILE = SERVING_PREFIX + "affinity.py"
 REPLICA_FILE = SERVING_PREFIX + "replica.py"
-_ROUTING_EXEMPT = (REPLICA_FILE, AFFINITY_FILE)
+# kv_tier.py is exempt for digest CONSTRUCTION only: it keys demoted
+# entries with prefix_digest_chain (the same digests the heartbeat
+# advertises) but never reads the fleet map or ranks candidates
+_ROUTING_EXEMPT = (REPLICA_FILE, AFFINITY_FILE, KV_TIER_FILE)
 
 # the routing-decision API owned by serving/affinity.py: digest-map
 # reads, candidate ranking, and digest-chain construction. Everything
@@ -1483,6 +1495,125 @@ class PrefillFrontierRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# HBM-001: HBM<->host transfer primitives only in designated movers
+
+
+# the raw transfer primitives: starting an async D2H copy on a device
+# buffer, placing host bytes onto a device sharding, and the blocking
+# fetch. Any spelling counts — a direct `arr.copy_to_host_async()`,
+# the getattr("copy_to_host_async") duck-typed form, bare or
+# attributed device_put/device_get.
+_HBM_TRANSFER_CALLS = frozenset({"device_put", "device_get"})
+_HBM_ASYNC_ATTR = "copy_to_host_async"
+
+# functions allowed to move bytes across the PCIe boundary, per
+# serving file. engine.py: the ONE async D2H starter plus the
+# construction-time placement helpers ELASTIC-001 already pins;
+# handoff.py: adoption places shipped KV onto the target sharding;
+# kv_tier.py IS the tier-transfer module — its snapshot (D2H) and
+# upload (H2D) helpers plus its single blocking fetch. Serving files
+# not listed allow nothing.
+_HBM_ALLOWED: Dict[str, FrozenSet[str]] = {
+    ENGINE_FILE: frozenset(
+        {"_start_host_copy", "_shard_bank", "_replicate"}
+    ),
+    HANDOFF_FILE: frozenset({"adopt_into_slot"}),
+    KV_TIER_FILE: frozenset(
+        {
+            "snapshot_row",
+            "snapshot_pages",
+            "upload_row",
+            "upload_pages",
+            "_fetch",
+        }
+    ),
+}
+
+
+def hbm_transfer_sites(
+    tree: ast.AST,
+) -> List[Tuple[int, str, Optional[str]]]:
+    """(lineno, what, enclosing-function-name) for every HBM<->host
+    transfer primitive: device_put/device_get calls in any spelling,
+    `.copy_to_host_async` attribute uses, and the duck-typed
+    getattr(x, "copy_to_host_async", ...) form."""
+    out = []
+    for node, owner in walk_with_owner(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Name)
+                and f.id in _HBM_TRANSFER_CALLS
+            ):
+                out.append((node.lineno, f"{f.id}(...)", owner))
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr in _HBM_TRANSFER_CALLS
+            ):
+                out.append(
+                    (node.lineno, f"{ast.unparse(f)}(...)", owner)
+                )
+            elif (
+                isinstance(f, ast.Name)
+                and f.id == "getattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value == _HBM_ASYNC_ATTR
+            ):
+                out.append(
+                    (
+                        node.lineno,
+                        f'getattr(..., "{_HBM_ASYNC_ATTR}")',
+                        owner,
+                    )
+                )
+        elif (
+            isinstance(node, ast.Attribute)
+            and node.attr == _HBM_ASYNC_ATTR
+        ):
+            out.append((node.lineno, ast.unparse(node), owner))
+    return out
+
+
+class HbmTransferRule(Rule):
+    id = "HBM-001"
+    severity = CRITICAL
+    title = (
+        "HBM<->host transfer primitives only in designated movers"
+    )
+    rationale = (
+        "DEVIATIONS §20: with a host-DRAM KV tier in the stack, KV "
+        "bytes cross PCIe in exactly three places — the engine's "
+        "async dispatch fetch, handoff adoption, and the tier's "
+        "snapshot/upload helpers in serving/kv_tier.py. A stray "
+        "copy_to_host_async or device_put on a KV-shaped array "
+        "anywhere else is an unaccounted PCIe transfer: it serializes "
+        "against the dispatch pipeline, dodges the tier's byte "
+        "budget, and hides from the demotion/promotion counters the "
+        "bench contracts assert on."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src)
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        allowed = _file_config(src.rel, _HBM_ALLOWED) or frozenset()
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{what} in {owner or '<module>'}() — HBM<->host "
+                f"transfers allowed only in "
+                f"{sorted(allowed) or 'nothing in this file'}; move "
+                "KV through serving/kv_tier.py or the engine's "
+                "designated fetch/placement helpers",
+            )
+            for lineno, what, owner in hbm_transfer_sites(src.tree)
+            if owner not in allowed
+        ]
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -1504,6 +1635,7 @@ REGISTRY: List[Rule] = [
     FleetRoutingRule(),
     TierPreemptionRule(),
     PrefillFrontierRule(),
+    HbmTransferRule(),
 ]
 
 

@@ -98,8 +98,11 @@ class FleetDigestMap:
     entry. Thread-safe: heartbeats land on the pool thread while
     submit() reads on request threads."""
 
-    # both indexes mutate together under _lock (graftlint LOCK-001)
-    GUARDED_FIELDS = frozenset({"_by_digest", "_by_replica"})
+    # all four indexes mutate together under _lock (graftlint LOCK-001)
+    GUARDED_FIELDS = frozenset(
+        {"_by_digest", "_by_replica", "_host_by_digest",
+         "_host_by_replica"}
+    )
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -107,47 +110,73 @@ class FleetDigestMap:
         self._by_digest: Dict[str, set] = {}
         # replica id -> the digests it currently advertises
         self._by_replica: Dict[str, frozenset] = {}
+        # the HOST-TIER mirror of the two indexes above: prefixes a
+        # replica holds demoted in host DRAM (serving/kv_tier.py), one
+        # PCIe promotion away from device-warm. Routing half-counts
+        # them — a host hit beats a cold prefill, a device hit beats
+        # both — which is the digest map's `tier` bit.
+        self._host_by_digest: Dict[str, set] = {}
+        self._host_by_replica: Dict[str, frozenset] = {}
 
     def update(
-        self, replica_id: str, digests: Iterable[str]
+        self,
+        replica_id: str,
+        digests: Iterable[str],
+        host_digests: Iterable[str] = (),
     ) -> None:
-        """Replace `replica_id`'s advertised set (heartbeat refresh).
-        Digests the replica no longer publishes (evicted rows) drop
-        out — the map mirrors the cache, it never accretes."""
+        """Replace `replica_id`'s advertised sets (heartbeat refresh).
+        Digests the replica no longer publishes (evicted rows, evicted
+        host entries) drop out — the map mirrors the caches, it never
+        accretes. `host_digests` are the replica's host-DRAM tier
+        prefixes; replicas without a tier just advertise ()."""
         new = frozenset(digests)
+        new_host = frozenset(host_digests)
         with self._lock:
-            old = self._by_replica.get(replica_id, frozenset())
-            for d in old - new:
-                members = self._by_digest.get(d)
-                if members is not None:
-                    members.discard(replica_id)
-                    if not members:
-                        del self._by_digest[d]
-            for d in new - old:
-                self._by_digest.setdefault(d, set()).add(replica_id)
-            if new:
-                self._by_replica[replica_id] = new
-            else:
-                self._by_replica.pop(replica_id, None)
+            for by_digest, by_replica, fresh in (
+                (self._by_digest, self._by_replica, new),
+                (self._host_by_digest, self._host_by_replica, new_host),
+            ):
+                old = by_replica.get(replica_id, frozenset())
+                for d in old - fresh:
+                    members = by_digest.get(d)
+                    if members is not None:
+                        members.discard(replica_id)
+                        if not members:
+                            del by_digest[d]
+                for d in fresh - old:
+                    by_digest.setdefault(d, set()).add(replica_id)
+                if fresh:
+                    by_replica[replica_id] = fresh
+                else:
+                    by_replica.pop(replica_id, None)
 
     def drop(self, replica_id: str) -> None:
         """Remove every entry for a dead/ejected replica — called the
         moment the pool stops routing to it, so no request can be
         steered at a corpse by a digest published before it died."""
-        self.update(replica_id, ())
+        self.update(replica_id, (), ())
 
     def match_depths(
         self, chain: Sequence[str]
-    ) -> Dict[str, int]:
+    ) -> Dict[str, float]:
         """replica id → longest matched prefix depth, in BLOCKS
         (chain index + 1). A replica advertising chain[i] holds the
-        aligned prefix of (i+1)*block tokens. Replicas matching
-        nothing are absent."""
-        depths: Dict[str, int] = {}
+        aligned prefix of (i+1)*block tokens. A HOST-TIER match at
+        chain[i] scores i + 0.5 — deeper than any shallower device
+        match (PCIe promotion beats recomputing the extra blocks) but
+        shallower than a device match at the same depth (promotion is
+        not free) — so values are ints for pure device fleets and
+        floats only when a tier entry wins. Replicas matching nothing
+        are absent."""
+        depths: Dict[str, float] = {}
         with self._lock:
             for i, digest in enumerate(chain):
                 for rid in self._by_digest.get(digest, ()):
                     depths[rid] = i + 1
+            for i, digest in enumerate(chain):
+                for rid in self._host_by_digest.get(digest, ()):
+                    if i + 0.5 > depths.get(rid, 0):
+                        depths[rid] = i + 0.5
         return depths
 
     def replicas(self) -> List[str]:
@@ -164,12 +193,13 @@ class FleetDigestMap:
             return {
                 "digests": len(self._by_digest),
                 "replicas": len(self._by_replica),
+                "host_digests": len(self._host_by_digest),
             }
 
 
 def affinity_order(
     candidates: List,
-    depths: Dict[str, int],
+    depths: Dict[str, float],
     load_of: Callable[[object], float],
     max_imbalance: float,
     capped: Optional[List] = None,
@@ -191,7 +221,7 @@ def affinity_order(
     floor = min(load_of(r) for r in candidates)
     cutoff = floor + max_imbalance
 
-    def effective_depth(rep) -> int:
+    def effective_depth(rep) -> float:
         d = depths.get(rep.id, 0)
         if d > 0 and load_of(rep) > cutoff:
             if capped is not None:

@@ -156,7 +156,9 @@ def resize(engine, n_chips: int) -> ResizeReport:
             engine._prefix_rows,
             block=engine._prefix_block,
             on_evict=(
-                engine._on_prefix_evict if engine._paged else None
+                engine._on_prefix_evict
+                if (engine._paged or engine.kv_tier is not None)
+                else None
             ),
         )
         engine.pool = engine._shard_bank(

@@ -119,6 +119,7 @@ from dlrover_tpu.parallel.mesh import (
 )
 from dlrover_tpu.parallel.sharding import replicated, shard_tree
 from dlrover_tpu.serving.adapters import DeviceAdapterCache
+from dlrover_tpu.serving import kv_tier as _kv_tier
 from dlrover_tpu.serving.paged_kv import (
     TRASH_PAGE,
     OutOfPages,
@@ -1168,6 +1169,9 @@ class ContinuousBatcher:
         adapter_cache_slots: int = 8,  # device adapter bank slots (LRU)
         prefill_chunk: int = 0,  # tokens of prefill per interleaved
                                  # dispatch (0 = blocking admission)
+        kv_tier_bytes: int = 0,  # host-DRAM KV tier capacity (0 = off)
+        swap_to_host: bool = True,   # preempted runs demote, not drop
+        kv_tier_promote: str = "always",  # | "swap_only" | "never"
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -1196,6 +1200,15 @@ class ContinuousBatcher:
         if prefill_chunk < 0:
             raise ValueError(
                 f"prefill_chunk must be >= 0, got {prefill_chunk}"
+            )
+        if kv_tier_bytes < 0:
+            raise ValueError(
+                f"kv_tier_bytes must be >= 0, got {kv_tier_bytes}"
+            )
+        if kv_tier_promote not in ("always", "swap_only", "never"):
+            raise ValueError(
+                f"kv_tier_promote must be 'always', 'swap_only' or "
+                f"'never', got {kv_tier_promote!r}"
             )
         _check_positional_capacity(cfg, max_len)
         # ---- serving mesh (GSPMD tensor slice) --------------------------
@@ -1427,6 +1440,23 @@ class ContinuousBatcher:
         self._pending: Dict[int, None] = {}
         self._next_idx = 0
 
+        # ---- host-DRAM KV tier (serving/kv_tier.py) ---------------------
+        # The rung between eviction and recompute: evicted published
+        # prefixes and preempted page runs demote to host DRAM via
+        # async D2H and promote back over PCIe instead of paying a
+        # cold prefill or a full replay. kv_tier_bytes=0 keeps every
+        # path below bit-exact (no tier object, no new programs).
+        self.kv_tier = None
+        self._tier_swap = bool(swap_to_host)
+        self._tier_promote = kv_tier_promote
+        if kv_tier_bytes > 0:
+            self.kv_tier = _kv_tier.HostKVTier(
+                kv_tier_bytes,
+                block=prefix_block,
+                chaos=chaos,
+                chaos_tag=f"{chaos_tag}#kvtier",
+            )
+
         # ---- admission-time prefix cache --------------------------------
         # A radix tree over block-quantized prompt prefixes whose rows
         # live in a second, exact-dtype KV bank beside the slot bank.
@@ -1445,7 +1475,11 @@ class ContinuousBatcher:
             self.prefix_cache = RadixPrefixCache(
                 prefix_cache_rows,
                 block=prefix_block,
-                on_evict=self._on_prefix_evict if self._paged else None,
+                on_evict=(
+                    self._on_prefix_evict
+                    if (self._paged or self.kv_tier is not None)
+                    else None
+                ),
             )
             # exact dtype even when the slot bank is int8: install
             # re-quantizes, which keeps warm admissions byte-identical
@@ -2130,6 +2164,8 @@ class ContinuousBatcher:
         block-aligned prefix, prefill only the suffix bucket, publish
         the request's own aligned prefix for the next arrival."""
         pc = self.prefix_cache
+        if self.kv_tier is not None:
+            self._tier_promote_prefix(req)
         matched, row = pc.match(req.prompt)
         # a matched depth whose suffix bucket would overrun max_len
         # retreats block by block (the pool row stays valid for any
@@ -2201,6 +2237,8 @@ class ContinuousBatcher:
         # adaptered requests bypass the prefix cache (published
         # prefixes are base-model K/V by contract), same as blocking
         if pc is not None and req.adapter_id is None:
+            if self.kv_tier is not None:
+                self._tier_promote_prefix(req)
             matched, row = pc.match(req.prompt)
             start = min(matched, p)
             if start > 0 and row is not None:
@@ -2242,9 +2280,18 @@ class ContinuousBatcher:
         (step() requeues it) until a live slot retires."""
         pc = self.prefix_cache
         lora = req.adapter_id is not None
+        if self.kv_tier is not None and self._tier_swap_in(
+            slot, req, p
+        ):
+            # full swap-in: the run is resident, the frontier page is
+            # exclusively owned — the slot admits live (the blocking
+            # path's full-hit semantics)
+            return None
         n_need = self._request_pages(req)
         matched, row, start = 0, None, 0
         if pc is not None and not lora:
+            if self.kv_tier is not None:
+                self._tier_promote_prefix(req)
             matched, row = pc.match(req.prompt)
             start = min(matched, p)
             if row is None or row not in self._row_pages:
@@ -2292,13 +2339,209 @@ class ContinuousBatcher:
 
     # -- paged admission (kv_layout="paged") -------------------------------
 
-    def _on_prefix_evict(self, row: int) -> None:
+    def _on_prefix_evict(self, row: int, blocks=()) -> None:
         """Radix eviction callback: the published prefix's page run
         drops its reference — pages nobody else holds return to the
-        free list (no device work; the bytes just become dead)."""
-        run = self._row_pages.pop(row, None)
+        free list (no device work; the bytes just become dead). With
+        a host tier, eviction becomes DEMOTION first: the row's exact
+        bytes are gathered and their async D2H copy started before
+        the run is released, so the prefix survives one rung down."""
+        run = self._row_pages.pop(row, None) if self._paged else None
+        if self.kv_tier is not None and blocks:
+            self._tier_demote_row(row, blocks)
         if run:
             self.allocator.free(run)
+
+    # -- host-DRAM KV tier (serving/kv_tier.py) ----------------------------
+
+    def _tier_demote_row(self, row: int, blocks) -> None:
+        """Demote an evicted published prefix: gather its exact pool
+        row (static-width bucket) and hand the in-flight staging
+        buffers to the tier. Never raises into the eviction path — a
+        failed demotion (tier full, chaos fault mid-demotion) just
+        means the prefix dies the way it always did, and readmission
+        falls back to a cold prefill."""
+        tokens = [t for blk in blocks for t in blk]
+        depth = len(tokens)
+        if depth <= 0 or self.pool is None:
+            return
+        w = min(_pad_bucket(depth), self.max_len)
+        try:
+            staged = _kv_tier.snapshot_row(self.pool, row, w)
+            self.kv_tier.put_prefix(tokens, staged, depth)
+        # graftlint: allow(EXC-001) reason=demotion is an opportunistic save; the eviction it rides must complete regardless, and replay/cold-prefill remains correct
+        except Exception:  # noqa: BLE001
+            self.kv_tier.note_demote_failure()
+
+    def _tier_alloc(self, n: int, swap_ok: bool = True):
+        """_alloc_pages' promotion twin: the same reclaim loop, but
+        pages come out of allocator.promote() so PCIe-paid installs
+        stay observable next to cold allocs and handoff adoptions."""
+        while True:
+            try:
+                return self.allocator.promote(n)
+            except OutOfPages:
+                if not self._reclaim_pages(swap_ok):
+                    raise
+
+    def _tier_promote_prefix(self, req: _Request) -> None:
+        """Pre-admission promotion check: if the host tier holds a
+        strictly deeper prefix of this prompt than the radix cache,
+        upload it into a fresh pool row (and, paged, install it into
+        promoted pages) and re-publish — the admission match that
+        follows then hits it through the EXISTING warm/full-hit
+        paths, so promoted bytes flow through the same install
+        programs as originally published ones (byte parity for
+        free)."""
+        tier, pc = self.kv_tier, self.prefix_cache
+        if tier is None or pc is None or self._tier_promote != "always":
+            return
+        matched, _ = pc.match(req.prompt)
+        ent = tier.match_prefix(req.prompt, min_depth=matched)
+        if ent is None:
+            return
+        tier.acquire(ent)
+        try:
+            pages = None
+            if self._paged:
+                n_pg = ent.depth // self.page_size
+                try:
+                    pages = self._tier_alloc(
+                        n_pg, swap_ok=not req.preempted
+                    )
+                except OutOfPages:
+                    return  # pool dry: admission proceeds cold
+            row, is_new = pc.insert(list(ent.tokens))
+            if row is None or not is_new:
+                # every row pinned, or a racing publish beat us —
+                # nothing to upload; return the pages untouched
+                if pages:
+                    self.allocator.free(pages)
+                return
+            self.pool, dev_row = _kv_tier.upload_row(
+                self.pool, ent, row
+            )
+            if pages is not None:
+                vals = np.full(
+                    self._pages_per_slot, TRASH_PAGE, np.int32
+                )
+                vals[: len(pages)] = pages
+                w = next(iter(ent.data.values())).shape[2]
+                self.page_pool = _kv_tier.install_row_pages(
+                    self.page_pool, dev_row, vals, w
+                )
+                self._row_pages[row] = pages
+            tier.note_promoted(ent)
+        finally:
+            tier.release(ent)
+
+    def _tier_swap_in(self, slot: int, req: _Request, p: int) -> bool:
+        """Swap-to-host readmission: if the tier holds this exact
+        folded sequence's page run, promote fresh pages, scatter the
+        stored bytes onto them, and point the slot's table at the
+        result — the prefill is skipped entirely and the admission
+        tail resumes from the journaled position/key. False → the
+        caller runs the normal (replay) admission."""
+        tier = self.kv_tier
+        if (
+            tier is None
+            or not self._tier_swap
+            or self._tier_promote == "never"
+        ):
+            return False
+        salt = req.adapter_id or ""
+        ent = tier.peek_swap(req.prompt, salt=salt)
+        if ent is None:
+            return False
+        n_need = self._request_pages(req)
+        if ent.n_pages > n_need or ent.page_size != self.page_size:
+            return False
+        tier.acquire(ent)
+        try:
+            try:
+                pages = self._tier_alloc(
+                    n_need, swap_ok=not req.preempted
+                )
+            except OutOfPages:
+                return False  # replay path may still fit via sharing
+            self._slot_pages[slot] = pages
+            self.page_pool = _kv_tier.upload_pages(
+                self.page_pool, ent, pages[: ent.n_pages]
+            )
+            vals = np.full(self._pages_per_slot, TRASH_PAGE, np.int32)
+            vals[: len(pages)] = pages
+            self._table = _table_row_prog(self._table, slot, vals)
+        finally:
+            tier.release(ent)
+        tier.consume(ent)
+        return True
+
+    def _tier_swap_out_slot(self, slot: int, tokens) -> None:
+        """Swap-to-host demotion of a preempted victim: snapshot the
+        pages covering its valid cells [0, len(tokens)) and start
+        their D2H copies before the run is freed. Only a cleanly
+        decoding slot qualifies (mid-prefill KV is partial — replay
+        is already the cheap path there); any failure just leaves
+        replay as the fallback."""
+        tier = self.kv_tier
+        if (
+            tier is None
+            or not self._tier_swap
+            or not self._paged
+            or self._prefilling[slot]
+            or self._parked[slot]
+        ):
+            return
+        p = len(tokens)
+        if p <= 0 or int(self.pos[slot]) + 1 != p:
+            return
+        run = self._slot_pages[slot]
+        n_keep = (p - 1) // self.page_size + 1
+        if n_keep > len(run):
+            return
+        req = self.slot_req[slot]
+        salt = (req.adapter_id or "") if req is not None else ""
+        try:
+            staged = _kv_tier.snapshot_pages(
+                self.page_pool, run[:n_keep]
+            )
+            tier.put_swap(
+                tokens, staged, n_keep, self.page_size, salt=salt
+            )
+        # graftlint: allow(EXC-001) reason=demotion is an opportunistic save; the preemption it rides must complete regardless, and resume-by-replay remains correct
+        except Exception:  # noqa: BLE001
+            tier.note_demote_failure()
+
+    def swap_out(self, idx: int) -> None:
+        """cancel() with demotion: the scheduler's admission
+        preemption calls this instead of cancel so the victim's live
+        page run swaps to host — readmission then promotes it back
+        and resumes over PCIe instead of replaying the whole prefill.
+        Exactly cancel() when the tier is off or the slot does not
+        qualify."""
+        req = self._requests.get(idx)
+        if (
+            req is not None
+            and self.kv_tier is not None
+            and self._tier_swap
+            and self._paged
+        ):
+            for slot in range(self.n_slots):
+                if self.slot_req[slot] is req and not self.done[slot]:
+                    tokens = list(req.prompt) + [
+                        int(t) for t in req.out[req.folded:]
+                    ]
+                    self._tier_swap_out_slot(slot, tokens)
+                    break
+        self.cancel(idx)
+
+    def kv_tier_stats(self) -> Dict[str, float]:
+        """Host-tier telemetry for ServingMetrics / the gateway:
+        bytes, entries, demotion/promotion/swap/eviction counters and
+        the promote hit rate. {} when the tier is off."""
+        if self.kv_tier is None:
+            return {}
+        return self.kv_tier.stats()
 
     def _request_pages(self, req: _Request) -> int:
         """Exact page need for a request: its OWN limit (prompt plus
@@ -2325,9 +2568,18 @@ class ContinuousBatcher:
         # published prefix holds base-model K/V (wrong bytes for this
         # adapter), and this adapter's K/V must never publish
         lora = req.adapter_id is not None
+        if self.kv_tier is not None and self._tier_swap_in(
+            slot, req, p
+        ):
+            # full swap-in: the resumed run is resident and owned; no
+            # prefill, no prefix bookkeeping — the admission tail
+            # restores carry/pos/limit/key from the journaled request
+            return
         n_need = self._request_pages(req)
         matched, row, start = 0, None, 0
         if pc is not None and not lora:
+            if self.kv_tier is not None:
+                self._tier_promote_prefix(req)
             matched, row = pc.match(req.prompt)
             start = min(matched, p)
             while (
@@ -2517,6 +2769,12 @@ class ContinuousBatcher:
         req.prng_key = self.slot_key[slot].copy()
         req.preempted = True
         if self._paged:  # dense slots have no page run to free
+            # swap-to-host: the victim's valid cells demote before the
+            # run is freed — readmission promotes them back over PCIe
+            # instead of replaying the whole prefill (replay stays the
+            # fallback when the tier is off/full/faulted)
+            if self.kv_tier is not None:
+                self._tier_swap_out_slot(slot, req.prompt)
             self._release_slot_pages(slot)
         if self.prefix_cache is not None:
             self._release_slot_row(slot)
@@ -2740,6 +2998,12 @@ class ContinuousBatcher:
                 step_no = self._step_no
                 self._step_no += 1
                 self.chaos.on_engine_step(self.chaos_tag, step_no)
+            if self.kv_tier is not None:
+                # complete last step's demotion copies (started async
+                # at demote time — a whole dispatch has passed, so
+                # this is a completion, not a stall) and release their
+                # staging buffers
+                self.kv_tier.drain()
             events = self._harvest()
             for slot in range(self.n_slots):
                 if self.done[slot] and self._queue:
@@ -3294,6 +3558,11 @@ class ContinuousBatcher:
         # must never leak into the restarted engine
         self._dev = self._device_state()
         self._inflight = None
+        if self.kv_tier is not None:
+            # a crash mid-demotion may have left staging buffers whose
+            # producing dispatch died with the engine — drop every
+            # entry rather than trust bytes that may never land
+            self.kv_tier.clear()
         self.slot_req = [None] * self.n_slots
         self._slot_row = [None] * self.n_slots
         self._queue.clear()
@@ -3305,7 +3574,11 @@ class ContinuousBatcher:
             self.prefix_cache = RadixPrefixCache(
                 self._prefix_rows,
                 block=self._prefix_block,
-                on_evict=self._on_prefix_evict if self._paged else None,
+                on_evict=(
+                    self._on_prefix_evict
+                    if (self._paged or self.kv_tier is not None)
+                    else None
+                ),
             )
             self.pool = self._shard_bank(
                 init_kv_cache(

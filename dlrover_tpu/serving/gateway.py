@@ -306,6 +306,15 @@ class ServingGateway:
         if paged:
             out["paged_kv"] = paged
         engine = getattr(self.backend, "engine", None)
+        # host-DRAM KV tier: byte occupancy, entry counts, and the
+        # demote/promote/swap counters (serving/kv_tier.py). Engines
+        # without a tier (kv_tier_bytes=0, test doubles) return {}
+        # and skip the block.
+        tstats = getattr(engine, "kv_tier_stats", None)
+        if callable(tstats):
+            t = tstats()
+            if t:
+                out["kv_tier"] = t
         mesh_shape = getattr(engine, "mesh_shape", None)
         if mesh_shape is not None:
             out["mesh"] = {

@@ -1,0 +1,557 @@
+"""Host-DRAM KV tier behind the prefix cache and the paged page pool.
+
+DLRover's Flash Checkpoint thesis (PAPER.md) — async shared-memory
+save/load to host DRAM, off the training hot path — pointed at
+serving's real bottleneck: HBM. Today the radix prefix cache and the
+page pool evict to *nowhere*, and tier preemption recomputes a
+victim's whole KV from scratch via resume-by-replay. This module adds
+the missing rung of the memory hierarchy:
+
+- DEMOTION: when the radix cache LRU-evicts a published prefix row, or
+  a live page run is preempted under pressure, the K/V bytes are
+  gathered into fresh device staging buffers and their D2H copies are
+  STARTED asynchronously (the PR 5 `copy_to_host_async` pattern) —
+  the hot path never blocks on PCIe. `_fetch` is this module's single
+  blocking completion site (graftlint HOST-001/HBM-001), and it runs
+  lazily, after the copies have had whole dispatches to finish.
+- PROMOTION: a radix miss that hits the host tier uploads the stored
+  bytes back (`upload_row` / `upload_pages`, the designated H2D
+  sites) and installs them through the engine's EXISTING adoption
+  machinery — `PageAllocator.promote()` fresh pages + the same
+  quantize-on-install program publish used, so promoted bytes are
+  bit-identical to the bytes the original publish installed and
+  steady-state decode still never copies.
+- SWAP: a preempted victim's live page run demotes instead of being
+  discarded (`put_swap`), and readmission promotes it back and
+  resumes from the journaled position — greedy byte-identical,
+  sampled continues the journaled key chain. Replay remains the
+  fallback whenever the tier is full, the entry was evicted, or a
+  chaos fault struck mid-demotion.
+
+Entries are keyed by the SAME chained blake2b digests the fleet
+router speaks (`affinity.prefix_digest_chain`), so a replica's
+heartbeat can advertise its host-tier prefixes and the fleet digest
+map routes a warm-anywhere prompt to PCIe instead of a cold prefill.
+
+The tier is pure host bookkeeping plus a handful of module-level
+jitted transfer programs. With `kv_tier_bytes=0` (the default) the
+engine never constructs a HostKVTier and none of these programs is
+ever traced — zero new program-cache keys, bit-exact legacy paths.
+"""
+
+import logging
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlrover_tpu.models.decode import paged_install_row
+from dlrover_tpu.serving.affinity import (
+    MAX_PUBLISHED_DIGESTS,
+    prefix_digest_chain,
+)
+from dlrover_tpu.serving.paged_kv import TRASH_PAGE
+
+logger = logging.getLogger(__name__)
+
+
+def _bucket(n: int, lo: int = 4) -> int:
+    """Next power of two >= max(n, lo): the id-vector pad discipline
+    (engine._pad_bucket) — transfer programs compile per bucket, not
+    per run length."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Transfer programs. Plain module-level jits (the handoff.py idiom):
+# traced on first use only, so a tier-less engine mints no new
+# program-cache keys. Nothing here donates on the GATHER side — the
+# source pools may still have pending async host copies from the
+# dispatch pipeline; the INSTALL side donates the pool it replaces,
+# exactly like the engine's own install programs.
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _row_slice_prog(arr, row, w):
+    """Gather pool row `row`'s leading `w` cells -> [L, 1, w, ...]."""
+    return jax.lax.dynamic_slice(
+        arr,
+        (0, row, 0) + (0,) * (arr.ndim - 3),
+        (arr.shape[0], 1, w) + arr.shape[3:],
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _row_install_prog(arr, data, row):
+    """Scatter a stored row slice back into pool row `row`."""
+    return jax.lax.dynamic_update_slice(
+        arr,
+        data.astype(arr.dtype),
+        (0, row, 0) + (0,) * (arr.ndim - 3),
+    )
+
+
+@jax.jit
+def _page_gather_prog(arr, ids):
+    """Gather pages `ids` from a page-pool entry -> [L, m, ps, ...]."""
+    return arr[:, ids]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _page_scatter_prog(arr, ids, data):
+    """Scatter stored pages onto freshly promoted ids (pad ids are
+    TRASH_PAGE — garbage landing on the trash page is the layout's
+    contract)."""
+    return arr.at[:, ids].set(data.astype(arr.dtype))
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(4,))
+def _pages_install_prog(pages, row_cache, table_row, start, length):
+    """Install a stored exact row into promoted pages through the SAME
+    quantize-on-install primitive publish used — promoted page bytes
+    match the original published bytes exactly."""
+    return paged_install_row(pages, row_cache, table_row, start, length)
+
+
+def _fetch(x) -> np.ndarray:
+    """THE tier's one blocking D2H completion site (HOST-001 /
+    HBM-001): the copy was started asynchronously at demotion time by
+    snapshot_row/snapshot_pages, so this completes it instead of
+    issuing a fresh synchronous transfer."""
+    return np.asarray(x)
+
+
+def snapshot_row(pool, row: int, w: int) -> Dict[str, Any]:
+    """D2H start for a prefix demotion: gather pool row `row`'s
+    leading `w` cells into fresh staging buffers and BEGIN their host
+    copies. Returns device arrays with copies in flight; the tier
+    finalizes them lazily via _fetch."""
+    staged = {}
+    for name, arr in pool.items():
+        piece = _row_slice_prog(arr, row, w)
+        start = getattr(piece, "copy_to_host_async", None)
+        if start is not None:
+            start()
+        staged[name] = piece
+    return staged
+
+
+def snapshot_pages(page_pool, ids: Sequence[int]) -> Dict[str, Any]:
+    """D2H start for a swap-out demotion: gather the run's pages
+    (ids padded to a bucket with TRASH_PAGE) and begin their host
+    copies."""
+    m = _bucket(len(ids))
+    padded = list(ids) + [TRASH_PAGE] * (m - len(ids))
+    ids_arr = jnp.asarray(padded, jnp.int32)
+    staged = {}
+    for name, arr in page_pool.items():
+        piece = _page_gather_prog(arr, ids_arr)
+        start = getattr(piece, "copy_to_host_async", None)
+        if start is not None:
+            start()
+        staged[name] = piece
+    return staged
+
+
+def upload_row(
+    pool, ent: "TierEntry", row: int
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """H2D for a prefix promotion: device_put the stored exact-dtype
+    row bytes and install them into pool row `row`. Returns the new
+    pool AND the uploaded device row (so a paged engine can feed the
+    same upload into the page-install program without a second PCIe
+    trip). The designated H2D site (ELASTIC-001 / HBM-001)."""
+    out = dict(pool)
+    dev: Dict[str, Any] = {}
+    for name, host in ent.data.items():
+        arr = pool[name]
+        src = jax.device_put(host, arr.sharding)
+        dev[name] = src
+        out[name] = _row_install_prog(arr, src, row)
+    return out, dev
+
+
+def install_row_pages(page_pool, dev_row, vals: np.ndarray, w: int):
+    """Install an uploaded exact row into a promoted page run:
+    `vals` is the trash-padded page-id vector, `w` the stored row
+    width (cells past the real depth land on the trash page)."""
+    return _pages_install_prog(
+        page_pool, dev_row, jnp.asarray(vals), 0, w
+    )
+
+
+def upload_pages(page_pool, ent: "TierEntry", ids: Sequence[int]):
+    """H2D for a swap-in promotion: device_put the stored page bytes
+    and scatter them onto freshly promoted page ids (`ids` padded to
+    the stored bucket with TRASH_PAGE). The designated H2D site
+    (ELASTIC-001 / HBM-001)."""
+    out = dict(page_pool)
+    m = next(iter(ent.data.values())).shape[1]
+    padded = list(ids) + [TRASH_PAGE] * (m - len(ids))
+    ids_arr = jnp.asarray(padded, jnp.int32)
+    for name, host in ent.data.items():
+        arr = page_pool[name]
+        src = jax.device_put(host, arr.sharding)
+        out[name] = _page_scatter_prog(arr, ids_arr, src)
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+
+def swap_digest(tokens: Sequence[int], salt: str = "") -> str:
+    """One digest over the WHOLE folded token sequence: the
+    swap-entry key, from the same chained blake2b the prefix chain
+    uses, with block=len(tokens). `salt` (the adapter id) keeps
+    adaptered K/V from ever aliasing the base model's under equal
+    tokens."""
+    digest = prefix_digest_chain(tokens, max(len(tokens), 1))[0]
+    return f"{digest}/{salt}" if salt else digest
+
+
+@dataclass
+class TierEntry:
+    """One demoted K/V unit. `data` holds per-name arrays: device
+    staging buffers with copies in flight right after demotion,
+    replaced by host ndarrays at first finalize. `depth` is the
+    number of VALID leading cells (a swap entry's last cell is the
+    write frontier — garbage until the first resumed decode step
+    rewrites it, which is the replay contract's own semantics)."""
+
+    kind: str                     # "prefix" | "swap"
+    digest: str
+    tokens: Tuple[int, ...]
+    depth: int
+    data: Dict[str, Any]
+    nbytes: int
+    n_pages: int = 0              # swap: real pages stored (data is bucket-padded)
+    page_size: int = 0
+    final: bool = False           # data fully on host
+
+
+class HostKVTier:
+    """Ref-counted, capacity-bounded (bytes), LRU host-DRAM tier.
+
+    Thread-safety: the engine/scheduler thread mutates entries while
+    the replica heartbeat thread reads `prefix_digests()` — every
+    index touch holds _lock (graftlint LOCK-001).
+    """
+
+    GUARDED_FIELDS = frozenset({
+        "_entries", "_refs", "bytes_used",
+        "demotions", "promotions", "swap_outs", "swap_ins",
+        "evictions", "rejects", "demote_failures",
+        "promote_hits", "promote_misses",
+    })
+
+    def __init__(
+        self,
+        capacity_bytes: int,
+        block: int = 16,
+        chaos=None,
+        chaos_tag: str = "kv_tier",
+    ):
+        if capacity_bytes <= 0:
+            raise ValueError(
+                f"capacity_bytes must be > 0, got {capacity_bytes} "
+                "(use kv_tier_bytes=0 on the engine to disable the "
+                "tier)"
+            )
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        self.capacity_bytes = int(capacity_bytes)
+        self.block = block
+        # chaos hook: a fault plan on `chaos_tag` fires mid-demotion
+        # (inside put_*, after the gather was dispatched but before
+        # the entry is recorded) — the crash-mid-demotion shape the
+        # chaos tests drive; the engine catches and falls back to
+        # replay with nothing stored and nothing leaked
+        self.chaos = chaos
+        self.chaos_tag = chaos_tag
+        self._lock = threading.RLock()
+        # LRU: oldest first, newest last (OrderedDict move_to_end)
+        self._entries: "OrderedDict[Tuple[str, str], TierEntry]" = (
+            OrderedDict()
+        )
+        # entries pinned by an in-flight promotion upload: eviction
+        # must never drop bytes mid-upload
+        self._refs: Dict[Tuple[str, str], int] = {}
+        self.bytes_used = 0
+        # monotonic counters (ServingMetrics copies them verbatim)
+        self.demotions = 0
+        self.promotions = 0
+        self.swap_outs = 0
+        self.swap_ins = 0
+        self.evictions = 0
+        self.rejects = 0
+        self.demote_failures = 0
+        self.promote_hits = 0
+        self.promote_misses = 0
+        self._demote_seq = 0
+
+    # ---- internals -------------------------------------------------------
+
+    @staticmethod
+    def _key(kind: str, digest: str) -> Tuple[str, str]:
+        return (kind, digest)
+
+    def _finalize(self, ent: TierEntry) -> None:
+        """Complete the entry's pending D2H copies (idempotent)."""
+        if ent.final:
+            return
+        ent.data = {k: _fetch(v) for k, v in ent.data.items()}
+        ent.final = True
+
+    def _evict_for_locked(self, need: int) -> bool:
+        """Evict LRU unreferenced entries until `need` bytes fit.
+        False when they cannot (entry bigger than capacity, or
+        everything live is pinned)."""
+        if need > self.capacity_bytes:
+            return False
+        while self.bytes_used + need > self.capacity_bytes:
+            victim = None
+            for key in self._entries:  # oldest first
+                if self._refs.get(key, 0) == 0:
+                    victim = key
+                    break
+            if victim is None:
+                return False
+            ent = self._entries.pop(victim)
+            self.bytes_used -= ent.nbytes
+            self.evictions += 1
+        return True
+
+    def _put(self, ent: TierEntry) -> bool:
+        self._demote_seq += 1
+        if self.chaos is not None:
+            # may raise: the injected crash-mid-demotion. The gather
+            # was already dispatched by the engine; nothing has been
+            # recorded yet, so the failure leaks neither bytes nor
+            # entries — the caller falls back to replay.
+            self.chaos.on_engine_step(self.chaos_tag, self._demote_seq)
+        with self._lock:
+            key = self._key(ent.kind, ent.digest)
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes_used -= old.nbytes
+            if not self._evict_for_locked(ent.nbytes):
+                if old is not None:  # keep the previous bytes
+                    self._entries[key] = old
+                    self.bytes_used += old.nbytes
+                self.rejects += 1
+                return False
+            self._entries[key] = ent
+            self.bytes_used += ent.nbytes
+        return True
+
+    # ---- demotion --------------------------------------------------------
+
+    def put_prefix(
+        self, tokens: Sequence[int], staged: Dict[str, Any], depth: int
+    ) -> bool:
+        """Record an evicted published prefix (exact pool-row bytes,
+        copies in flight). `tokens` is the block-aligned prefix;
+        `depth` its length in cells."""
+        toks = tuple(int(t) for t in tokens)
+        chain = prefix_digest_chain(toks, self.block)
+        if not chain:
+            return False
+        nbytes = sum(int(a.nbytes) for a in staged.values())
+        ok = self._put(TierEntry(
+            kind="prefix", digest=chain[-1], tokens=toks,
+            depth=int(depth), data=staged, nbytes=nbytes,
+        ))
+        if ok:
+            with self._lock:
+                self.demotions += 1
+        return ok
+
+    def put_swap(
+        self,
+        tokens: Sequence[int],
+        staged: Dict[str, Any],
+        n_pages: int,
+        page_size: int,
+        salt: str = "",
+    ) -> bool:
+        """Record a preempted victim's live page run (cells
+        [0, len(tokens)), last cell garbage-but-rewritten — the same
+        contract replay resumes under)."""
+        toks = tuple(int(t) for t in tokens)
+        if not toks:
+            return False
+        nbytes = sum(int(a.nbytes) for a in staged.values())
+        ok = self._put(TierEntry(
+            kind="swap", digest=swap_digest(toks, salt), tokens=toks,
+            depth=len(toks), data=staged, nbytes=nbytes,
+            n_pages=int(n_pages), page_size=int(page_size),
+        ))
+        if ok:
+            with self._lock:
+                self.swap_outs += 1
+                self.demotions += 1
+        return ok
+
+    def note_demote_failure(self) -> None:
+        with self._lock:
+            self.demote_failures += 1
+
+    # ---- promotion -------------------------------------------------------
+
+    def match_prefix(
+        self, tokens: Sequence[int], min_depth: int = 0
+    ) -> Optional[TierEntry]:
+        """Deepest stored prefix of `tokens` STRICTLY deeper than
+        `min_depth` (the radix cache's own match — the tier only wins
+        when PCIe beats recompute), finalized and LRU-touched. Counts
+        the promote hit/miss the bench's hit-rate floor locks."""
+        chain = prefix_digest_chain(tokens, self.block)
+        with self._lock:
+            for i in range(len(chain) - 1, -1, -1):
+                if (i + 1) * self.block <= min_depth:
+                    break
+                ent = self._entries.get(self._key("prefix", chain[i]))
+                if ent is not None:
+                    self._finalize(ent)
+                    self._entries.move_to_end(self._key(
+                        "prefix", chain[i]
+                    ))
+                    self.promote_hits += 1
+                    return ent
+            self.promote_misses += 1
+        return None
+
+    def peek_swap(
+        self, tokens: Sequence[int], salt: str = ""
+    ) -> Optional[TierEntry]:
+        """The swap entry for this exact folded sequence, finalized —
+        NOT consumed: the caller installs first and consume()s only
+        after the install succeeded, so an OutOfPages admission can
+        retry (or fall back to replay) with the bytes intact."""
+        toks = tuple(int(t) for t in tokens)
+        if not toks:
+            return None
+        with self._lock:
+            ent = self._entries.get(
+                self._key("swap", swap_digest(toks, salt))
+            )
+            if ent is not None:
+                self._finalize(ent)
+            return ent
+
+    def consume(self, ent: TierEntry) -> None:
+        """A swap entry was promoted into a live slot: single-use by
+        design (its bytes now live on device and will diverge as the
+        slot decodes)."""
+        with self._lock:
+            key = self._key(ent.kind, ent.digest)
+            if self._entries.pop(key, None) is not None:
+                self.bytes_used -= ent.nbytes
+            self.swap_ins += 1
+            self.promotions += 1
+
+    def note_promoted(self, ent: TierEntry) -> None:
+        """A prefix entry was re-published on device. The host copy
+        stays (LRU-touched): if the row is evicted again, re-demotion
+        is an idempotent replace, and meanwhile the heartbeat keeps
+        advertising it."""
+        with self._lock:
+            self.promotions += 1
+
+    def acquire(self, ent: TierEntry) -> None:
+        """Pin an entry across a promotion upload — eviction skips
+        pinned entries, so capacity pressure can never drop bytes an
+        install is reading."""
+        with self._lock:
+            key = self._key(ent.kind, ent.digest)
+            self._refs[key] = self._refs.get(key, 0) + 1
+
+    def release(self, ent: TierEntry) -> None:
+        with self._lock:
+            key = self._key(ent.kind, ent.digest)
+            n = self._refs.get(key, 0)
+            if n <= 1:
+                self._refs.pop(key, None)
+            else:
+                self._refs[key] = n - 1
+
+    # ---- maintenance -----------------------------------------------------
+
+    def drain(self) -> None:
+        """Complete every pending D2H copy (the engine calls this once
+        per step, after the copies have had a full dispatch to land) —
+        staging buffers must not pin HBM indefinitely."""
+        with self._lock:
+            for ent in self._entries.values():
+                self._finalize(ent)
+
+    def clear(self) -> None:
+        """Drop everything (engine reset: a crash mid-demotion may
+        have left staging buffers whose dispatch died with the
+        engine)."""
+        with self._lock:
+            self._entries.clear()
+            self._refs.clear()
+            self.bytes_used = 0
+
+    # ---- advertisement / telemetry ---------------------------------------
+
+    def prefix_digests(
+        self, limit: int = MAX_PUBLISHED_DIGESTS
+    ) -> List[str]:
+        """Digests of the stored PREFIX entries, newest-first (the
+        heartbeat cap discipline cache_digests uses) — what the fleet
+        digest map records as this replica's host-tier bit."""
+        out: List[str] = []
+        with self._lock:
+            for key, ent in reversed(self._entries.items()):
+                if ent.kind == "prefix":
+                    out.append(ent.digest)
+                    if len(out) >= limit:
+                        break
+        return out
+
+    def entry_count(self, kind: Optional[str] = None) -> int:
+        with self._lock:
+            if kind is None:
+                return len(self._entries)
+            return sum(
+                1 for e in self._entries.values() if e.kind == kind
+            )
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            lookups = self.promote_hits + self.promote_misses
+            return {
+                "capacity_bytes": float(self.capacity_bytes),
+                "bytes_used": float(self.bytes_used),
+                "entries": float(len(self._entries)),
+                "prefix_entries": float(sum(
+                    1 for e in self._entries.values()
+                    if e.kind == "prefix"
+                )),
+                "swap_entries": float(sum(
+                    1 for e in self._entries.values()
+                    if e.kind == "swap"
+                )),
+                "demotions": float(self.demotions),
+                "promotions": float(self.promotions),
+                "swap_outs": float(self.swap_outs),
+                "swap_ins": float(self.swap_ins),
+                "evictions": float(self.evictions),
+                "rejects": float(self.rejects),
+                "demote_failures": float(self.demote_failures),
+                "promote_hits": float(self.promote_hits),
+                "promote_misses": float(self.promote_misses),
+                "promote_hit_rate": (
+                    self.promote_hits / lookups if lookups else 0.0
+                ),
+            }

@@ -93,6 +93,15 @@ class ServingMetrics:
             "_paged_cow_copies",
             "_paged_swap_preemptions",
             "_paged_swap_resumes",
+            "_kv_tier_bytes",
+            "_kv_tier_capacity",
+            "_kv_tier_entries",
+            "_kv_tier_demotions",
+            "_kv_tier_promotions",
+            "_kv_tier_swap_outs",
+            "_kv_tier_swap_ins",
+            "_kv_tier_evictions",
+            "_kv_tier_promote_hit_rate",
             "_mesh_tp",
             "_replica_chips",
             "_kernel_path_steps",
@@ -191,6 +200,15 @@ class ServingMetrics:
         self._paged_cow_copies = 0
         self._paged_swap_preemptions = 0
         self._paged_swap_resumes = 0
+        self._kv_tier_bytes = 0
+        self._kv_tier_capacity = 0
+        self._kv_tier_entries = 0
+        self._kv_tier_demotions = 0
+        self._kv_tier_promotions = 0
+        self._kv_tier_swap_outs = 0
+        self._kv_tier_swap_ins = 0
+        self._kv_tier_evictions = 0
+        self._kv_tier_promote_hit_rate = 0.0
         # mesh-slice gauges: copied from the engine's
         # mesh_shape/n_chips each pump. 1/1 is the un-meshed default
         # (a replica always occupies at least one device)
@@ -441,6 +459,39 @@ class ServingMetrics:
             self._paged_swap_resumes = max(
                 self._paged_swap_resumes,
                 int(stats.get("swap_resumes", 0)),
+            )
+
+    def update_kv_tier(self, stats: Dict[str, float]):
+        """Refresh host-DRAM KV tier telemetry from the engine's
+        kv_tier_stats() (serving/kv_tier.py). Bytes/entries/hit-rate
+        are gauges; the demotion/promotion/swap/eviction totals are
+        counters under the same max() monotonic guard as update_paged
+        — a restarted engine can reset its tier without the exposition
+        ever showing a counter going backwards."""
+        with self._lock:
+            self._kv_tier_bytes = int(stats.get("bytes_used", 0))
+            self._kv_tier_capacity = int(
+                stats.get("capacity_bytes", 0)
+            )
+            self._kv_tier_entries = int(stats.get("entries", 0))
+            self._kv_tier_promote_hit_rate = float(
+                stats.get("promote_hit_rate", 0.0)
+            )
+            self._kv_tier_demotions = max(
+                self._kv_tier_demotions, int(stats.get("demotions", 0))
+            )
+            self._kv_tier_promotions = max(
+                self._kv_tier_promotions,
+                int(stats.get("promotions", 0)),
+            )
+            self._kv_tier_swap_outs = max(
+                self._kv_tier_swap_outs, int(stats.get("swap_outs", 0))
+            )
+            self._kv_tier_swap_ins = max(
+                self._kv_tier_swap_ins, int(stats.get("swap_ins", 0))
+            )
+            self._kv_tier_evictions = max(
+                self._kv_tier_evictions, int(stats.get("evictions", 0))
             )
 
     def set_mesh(self, tp: int, n_chips: int):
@@ -1188,6 +1239,55 @@ class ServingMetrics:
                 "serving_paged_swap_resumes_total",
                 "Preempted requests resumed by replay.",
                 self._paged_swap_resumes,
+            )
+            gauge(
+                "serving_kv_tier_bytes",
+                "Host-DRAM KV tier bytes currently resident.",
+                self._kv_tier_bytes,
+            )
+            gauge(
+                "serving_kv_tier_capacity_bytes",
+                "Host-DRAM KV tier capacity (0 = tier off).",
+                self._kv_tier_capacity,
+            )
+            gauge(
+                "serving_kv_tier_entries",
+                "Entries (prefix rows + swap runs) in the host tier.",
+                self._kv_tier_entries,
+            )
+            counter(
+                "serving_kv_tier_demotions_total",
+                "KV entries demoted device→host (evicted prefixes "
+                "plus swapped-out victims).",
+                self._kv_tier_demotions,
+            )
+            counter(
+                "serving_kv_tier_promotions_total",
+                "KV entries promoted host→device (prefix uploads "
+                "plus swap-ins).",
+                self._kv_tier_promotions,
+            )
+            counter(
+                "serving_kv_tier_swap_outs_total",
+                "Preempted page runs demoted to the host tier.",
+                self._kv_tier_swap_outs,
+            )
+            counter(
+                "serving_kv_tier_swap_ins_total",
+                "Readmissions resumed from host-tier bytes instead "
+                "of replay.",
+                self._kv_tier_swap_ins,
+            )
+            counter(
+                "serving_kv_tier_evictions_total",
+                "Host-tier entries dropped by its byte-budget LRU.",
+                self._kv_tier_evictions,
+            )
+            gauge(
+                "serving_kv_tier_promote_hit_rate",
+                "Fraction of tier lookups that found a promotable "
+                "entry.",
+                self._kv_tier_promote_hit_rate,
             )
             gauge(
                 "serving_mesh_tp",

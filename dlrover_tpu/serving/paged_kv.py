@@ -60,6 +60,7 @@ class PageAllocator:
         self.pages_shared = 0
         self.cow_copies = 0
         self.pages_adopted = 0
+        self.pages_promoted = 0
 
     # -- capacity ----------------------------------------------------
 
@@ -112,6 +113,19 @@ class PageAllocator:
         handoff-vs-local admission mix stays observable."""
         pages = self.alloc(n)
         self.pages_adopted += n
+        return pages
+
+    def promote(self, n: int) -> List[int]:
+        """THE page-run install entry point for host-tier promotion
+        (serving/kv_tier.py): reserve `n` fresh pages to receive a run
+        uploaded from the host-DRAM tier. Accounting-wise this IS an
+        alloc — each page comes out at refcount 1, owned by whichever
+        run (radix republish or swapped-in slot) triggered the
+        promotion, so the one-CoW-site invariant holds — but it is
+        counted separately so PCIe-paid admissions stay observable
+        next to cold prefills and cross-replica adoptions."""
+        pages = self.alloc(n)
+        self.pages_promoted += n
         return pages
 
     def share(self, pages: List[int]) -> None:
@@ -206,4 +220,5 @@ class PageAllocator:
             "pages_shared": self.pages_shared,
             "cow_copies": self.cow_copies,
             "pages_adopted": self.pages_adopted,
+            "pages_promoted": self.pages_promoted,
         }

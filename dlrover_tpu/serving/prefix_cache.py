@@ -66,7 +66,9 @@ class RadixPrefixCache:
         self,
         n_rows: int,
         block: int = 16,
-        on_evict: Optional[Callable[[int], None]] = None,
+        on_evict: Optional[
+            Callable[[int, List[Tuple[int, ...]]], None]
+        ] = None,
     ):
         if n_rows < 1:
             raise ValueError(f"n_rows must be >= 1, got {n_rows}")
@@ -74,9 +76,11 @@ class RadixPrefixCache:
             raise ValueError(f"block must be >= 1, got {block}")
         self.n_rows = n_rows
         self.block = block
-        # fired with the row index whenever a row leaves the tree —
-        # the paged engine hangs page-run refcount drops off this so
-        # an evicted published prefix cannot leak pool pages
+        # fired with (row, block-edge path) whenever a row leaves the
+        # tree — the paged engine hangs page-run refcount drops off
+        # this so an evicted published prefix cannot leak pool pages,
+        # and the host tier (serving/kv_tier.py) uses the edge path to
+        # key the demoted K/V by digest before the bytes are dropped
         self.on_evict = on_evict
         self.root = _Node()
         self._row_node: Dict[int, _Node] = {}
@@ -214,9 +218,19 @@ class RadixPrefixCache:
         node.row = None
         del self._lru[row]
         self.evictions += 1
+        # capture the edge path BEFORE pruning detaches the chain:
+        # on_evict receives the evicted prefix's blocks so the host
+        # tier can demote the row under its digest key
+        blocks: List[Tuple[int, ...]] = []
+        if self.on_evict is not None:
+            walk = node
+            while walk.parent is not None:
+                blocks.append(walk.edge)
+                walk = walk.parent
+            blocks.reverse()
         self._prune(node)
         if self.on_evict is not None:
-            self.on_evict(row)
+            self.on_evict(row, blocks)
 
     @staticmethod
     def _prune(node: _Node) -> None:

@@ -190,6 +190,11 @@ class InferenceReplica:
                 # router's affinity signal. Digests only: no token
                 # data leaves the replica through the control plane.
                 "prefix_digests": self.prefix_digests(),
+                # digests resident in the host-DRAM KV tier — the
+                # digest map's `tier` bit: one PCIe promotion from
+                # device-warm, so routing half-counts them (ahead of
+                # cold prefill, behind a device-warm peer)
+                "kv_tier_digests": self.kv_tier_digests(),
             }
         ).encode()
 
@@ -203,6 +208,21 @@ class InferenceReplica:
             return []
         try:
             return cache_digests(cache)
+        # graftlint: allow(EXC-001) reason=digest advertisement is a routing hint only; a raising engine is caught by the health probe, not here
+        except Exception:  # noqa: BLE001
+            return []
+
+    def kv_tier_digests(self) -> List[str]:
+        """Digests of the prompt prefixes held demoted in this
+        replica's host-DRAM KV tier (newest-demoted first, capped like
+        prefix_digests); [] when the tier is off or the engine
+        predates it (test doubles). Swap entries never advertise —
+        they key exact folded sequences, useless to other requests."""
+        tier = getattr(self.scheduler.engine, "kv_tier", None)
+        if tier is None or not hasattr(tier, "prefix_digests"):
+            return []
+        try:
+            return list(tier.prefix_digests())
         # graftlint: allow(EXC-001) reason=digest advertisement is a routing hint only; a raising engine is caught by the health probe, not here
         except Exception:  # noqa: BLE001
             return []
@@ -453,7 +473,9 @@ class ReplicaPool:
         if not self.affinity_routing:
             return
         digests = rep.prefix_digests()
-        self.digest_map.update(rep.id, digests)
+        self.digest_map.update(
+            rep.id, digests, host_digests=rep.kv_tier_digests()
+        )
         if self.directory is not None:
             try:
                 self.directory.publish(rep.id, digests)

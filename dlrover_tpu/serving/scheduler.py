@@ -662,7 +662,16 @@ class RequestScheduler:
             return False
         victim = self._running.pop(victim_idx)
         ticket = self.journal.snapshot(victim)
-        self.engine.cancel(victim_idx)
+        # swap-to-host when the engine has a tier: the victim's live
+        # page run demotes to host DRAM under its resume-prompt digest
+        # so readmission promotes the bytes back instead of replaying
+        # the prefill. Falls back to plain cancel (replay resume) on
+        # engines without a tier — same resume contract either way.
+        swap_out = getattr(self.engine, "swap_out", None)
+        if swap_out is not None:
+            swap_out(victim_idx)
+        else:
+            self.engine.cancel(victim_idx)
         if ticket.prng_key is not None:
             victim.prng_key = np.asarray(ticket.prng_key, np.uint32)
         victim.state = RequestState.QUEUED
@@ -895,6 +904,11 @@ class RequestScheduler:
                 ps = paged_stats()
                 if ps:
                     self.metrics.update_paged(ps)
+            tier_stats = getattr(self.engine, "kv_tier_stats", None)
+            if tier_stats is not None:
+                ts = tier_stats()
+                if ts:
+                    self.metrics.update_kv_tier(ts)
             mesh_shape = getattr(self.engine, "mesh_shape", None)
             if mesh_shape is not None:
                 self.metrics.set_mesh(

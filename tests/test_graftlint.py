@@ -41,6 +41,7 @@ from dlrover_tpu.analysis.rules import (
     ElasticReshardRule,
     FleetRoutingRule,
     HandoffAdoptionRule,
+    HbmTransferRule,
     HostCopyRule,
     JitSelfCaptureRule,
     KernelHygieneRule,
@@ -52,6 +53,7 @@ from dlrover_tpu.analysis.rules import (
     TierPreemptionRule,
     frontier_write_sites,
     get_rules,
+    hbm_transfer_sites,
 )
 
 pytestmark = pytest.mark.lint
@@ -998,6 +1000,139 @@ def test_prefill_rule_not_vacuous_on_real_engine():
     owners = {owner for _, _, owner in sites}
     assert "_admit" in owners and "_dispatch_interleaved" in owners
     assert not hits(PrefillFrontierRule(), src)
+
+
+# ---------------------------------------------------------------------------
+# HBM-001: HBM<->host transfer primitives only in designated movers
+
+
+def test_hbm_rule_flags_stray_transfers(tmp_path):
+    # a serving file with no allowlist entry starting its own D2H
+    # copies and device_put-ing KV back — the unaccounted PCIe
+    # traffic the tier's byte budget exists to prevent
+    src = probe(
+        tmp_path,
+        """
+        import jax
+
+        def leak(arr, host, sh):
+            arr.copy_to_host_async()
+            start = getattr(arr, "copy_to_host_async", None)
+            return jax.device_put(host, sh)
+        """,
+        rel=SERVING_REL,
+    )
+    found = hits(HbmTransferRule(), src)
+    assert len(found) == 3
+    assert all("kv_tier" in f.message for f in found)
+
+
+def test_hbm_rule_allows_designated_movers(tmp_path):
+    # engine: the async D2H starter + placement helpers
+    src = probe(
+        tmp_path,
+        """
+        import jax
+
+        class Engine:
+            def _start_host_copy(self, arrays):
+                for a in arrays:
+                    start = getattr(a, "copy_to_host_async", None)
+                    if start is not None:
+                        start()
+
+            def _shard_bank(self, bank):
+                return {
+                    k: jax.device_put(v, self.sh)
+                    for k, v in bank.items()
+                }
+
+            def _replicate(self, x):
+                return jax.device_put(x, self.rep)
+        """,
+        rel=ENGINE_REL,
+    )
+    assert not hits(HbmTransferRule(), src)
+    # handoff: adoption places shipped KV onto the target sharding
+    src = probe(
+        tmp_path,
+        """
+        import jax
+
+        def adopt_into_slot(engine, pkg):
+            return jax.device_put(pkg.data, engine.sh)
+        """,
+        rel="dlrover_tpu/serving/handoff.py",
+    )
+    assert not hits(HbmTransferRule(), src)
+
+
+def test_hbm_rule_vacuity_of_kv_tier_allowlist(tmp_path):
+    # the tier's snapshot/upload helpers are legal; the SAME
+    # primitives in an unlisted kv_tier.py function are findings —
+    # the module is not exempt wholesale
+    code = """
+    import jax
+
+    def snapshot_row(pool, row, w):
+        piece = pool["k"][row]
+        start = getattr(piece, "copy_to_host_async", None)
+        if start is not None:
+            start()
+        return piece
+
+    def upload_row(pool, ent, row):
+        return jax.device_put(ent.data, pool["k"].sharding)
+
+    def sneaky(arr, host, sh):
+        arr.copy_to_host_async()
+        return jax.device_put(host, sh)
+    """
+    src = probe(
+        tmp_path, code, rel="dlrover_tpu/serving/kv_tier.py"
+    )
+    found = hits(HbmTransferRule(), src)
+    assert len(found) == 2
+    assert all("sneaky" in f.message for f in found)
+
+
+def test_hbm_rule_ignores_outside_serving(tmp_path):
+    # models/ and parallel/ move arrays by design — the rule is a
+    # serving-layer invariant only
+    src = probe(
+        tmp_path,
+        """
+        import jax
+
+        def place(x, sh):
+            x.copy_to_host_async()
+            return jax.device_put(x, sh)
+        """,
+        rel="dlrover_tpu/parallel/sharding.py",
+    )
+    assert not hits(HbmTransferRule(), src)
+
+
+def test_hbm_rule_not_vacuous_on_real_tree():
+    # the walker must see the real transfer sites (the rule has
+    # something to protect) and the allowlists must cover every one
+    # of them (the tree stays clean)
+    root = pathlib.Path(analysis.__file__).resolve().parents[2]
+    serving = root / "dlrover_tpu" / "serving"
+    owners = {}
+    for name in ("engine.py", "handoff.py", "kv_tier.py"):
+        src = SourceFile.parse(
+            serving / name, rel=f"dlrover_tpu/serving/{name}"
+        )
+        sites = hbm_transfer_sites(src.tree)
+        owners[name] = {o for _, _, o in sites}
+        assert sites, f"no transfer sites seen in {name}"
+        assert not hits(HbmTransferRule(), src)
+    assert "_start_host_copy" in owners["engine.py"]
+    assert "adopt_into_slot" in owners["handoff.py"]
+    assert {
+        "snapshot_row", "snapshot_pages", "upload_row", "upload_pages"
+    } <= owners["kv_tier.py"]
 
 
 # ---------------------------------------------------------------------------

@@ -139,7 +139,11 @@ class TestFleetDigestMap:
         m.update("r1", ["b", "c"])  # heartbeat refresh drops "a"
         assert m.match_depths(["a"]) == {}
         assert m.match_depths(["c"]) == {"r1": 1}
-        assert m.stats() == {"digests": 2, "replicas": 1}
+        assert m.stats() == {
+            "digests": 2,
+            "replicas": 1,
+            "host_digests": 0,
+        }
 
     def test_longest_match_wins(self):
         m = FleetDigestMap()

@@ -506,6 +506,68 @@ class TestMetricsEndpoint:
             gw.stop()
             sched.stop()
 
+    def test_kv_tier_exposition(self, model):
+        """With the host-DRAM KV tier on, /metrics carries the tier
+        families and /healthz a kv_tier block — the fleet-side view
+        of the demote/promote traffic. A 1-row radix cache churned by
+        distinct prompts demotes on every publish; the repeat round
+        promotes from host."""
+        cfg, params = model
+        eng = ContinuousBatcher(
+            cfg, params, n_slots=1, max_len=64, max_new_tokens=4,
+            chunk=4, pad_id=-1, kv_layout="paged",
+            prefix_cache_rows=1, kv_tier_bytes=32 << 20,
+        )
+        metrics = ServingMetrics()
+        sched = RequestScheduler(eng, SloConfig(), metrics=metrics)
+        sched.start()
+        gw = ServingGateway(sched, metrics=metrics)
+        gw.start()
+        try:
+            prompts = _prompts((20, 21, 22), seed=9)
+            for p in prompts + prompts:  # churn, then promote back
+                toks, trailer = _post_stream(gw.port, p, max_new=4)
+                assert trailer["state"] == "done"
+                assert toks == lockstep_oracle(cfg, params, p, 4)
+            st = eng.kv_tier_stats()
+            assert st["demotions"] > 0 and st["promotions"] > 0
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=30
+            )
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+            conn.close()
+            for needle in (
+                "# TYPE serving_kv_tier_bytes gauge",
+                "# TYPE serving_kv_tier_capacity_bytes gauge",
+                "# TYPE serving_kv_tier_demotions_total counter",
+                "# TYPE serving_kv_tier_promotions_total counter",
+                "# TYPE serving_kv_tier_swap_outs_total counter",
+                "# TYPE serving_kv_tier_swap_ins_total counter",
+                "# TYPE serving_kv_tier_evictions_total counter",
+                "# TYPE serving_kv_tier_promote_hit_rate gauge",
+                f"serving_kv_tier_demotions_total "
+                f"{int(st['demotions'])}",
+                f"serving_kv_tier_promotions_total "
+                f"{int(st['promotions'])}",
+            ):
+                assert needle in text, text
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=30
+            )
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            conn.close()
+            assert health["ok"] is True
+            tier = health["kv_tier"]
+            assert tier["capacity_bytes"] == float(32 << 20)
+            assert tier["demotions"] == st["demotions"]
+            assert tier["promotions"] == st["promotions"]
+            assert tier["bytes_used"] > 0
+        finally:
+            gw.stop()
+            sched.stop()
+
     def test_prefill_interleave_exposition(self, model):
         """With interleaved chunked prefill on, /metrics carries the
         TTFT decomposition (admission stall vs chunk count) and
