@@ -1614,6 +1614,105 @@ class HbmTransferRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# INTEG-001: KV integrity checksum discipline
+
+
+HEALTH_FILE = SERVING_PREFIX + "health.py"
+
+# the checksum primitives: the sentinel's own compute/verify helpers
+# plus raw blake2b in any spelling (hashlib.blake2b attribute or a
+# bare imported name)
+_INTEG_CALLS = frozenset(
+    {"kv_checksum", "verify_checksum", "blake2b"}
+)
+
+# functions allowed to compute or verify digests, per serving file.
+# health.py is the checksum module itself (excluded wholesale below);
+# affinity.py chains routing digests (identity, not integrity — but
+# the same blake2b primitive, so it must be pinned here or the rule
+# would flag it); kv_tier.py stamps at _finalize and verifies at its
+# one ingress gate; handoff.py stamps at export, verifies at the
+# coordinator ingress (on_prefill_done, before any target enqueues
+# the package) and again at direct adoption for out-of-band callers.
+# Serving files not listed allow nothing.
+_INTEG_ALLOWED: Dict[str, FrozenSet[str]] = {
+    AFFINITY_FILE: frozenset({"_block_digest"}),
+    KV_TIER_FILE: frozenset({"_finalize", "_verify_locked"}),
+    HANDOFF_FILE: frozenset(
+        {"export_run", "adopt_into_slot", "on_prefill_done"}
+    ),
+}
+
+
+def integrity_checksum_sites(
+    tree: ast.AST,
+) -> List[Tuple[int, str, Optional[str]]]:
+    """(lineno, what, enclosing-function-name) for every checksum
+    primitive call: kv_checksum/verify_checksum in any spelling, and
+    blake2b both bare and as hashlib.blake2b."""
+    out = []
+    for node, owner in walk_with_owner(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _INTEG_CALLS:
+            out.append((node.lineno, f"{f.id}(...)", owner))
+        elif (
+            isinstance(f, ast.Attribute) and f.attr in _INTEG_CALLS
+        ):
+            out.append(
+                (node.lineno, f"{ast.unparse(f)}(...)", owner)
+            )
+    return out
+
+
+class IntegrityChecksumRule(Rule):
+    id = "INTEG-001"
+    severity = CRITICAL
+    title = (
+        "KV checksum compute/verify only at designated "
+        "egress/ingress sites"
+    )
+    rationale = (
+        "DEVIATIONS §21: KV payload digests are stamped at exactly "
+        "two egress points (tier finalize, handoff export) and "
+        "verified at the matching ingress gates — that pairing is "
+        "what makes a mismatch attributable to in-transit "
+        "corruption. A checksum computed anywhere else either "
+        "re-hashes device buffers mid-flight (digesting garbage the "
+        "D2H copy hasn't landed), double-counts the integrity "
+        "telemetry the bench contract asserts on, or silently "
+        "shadows the quarantine path so corrupted bytes reach "
+        "decode."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        # the checksum module itself is the one place allowed to
+        # spell the primitives freely
+        return _in_serving(src) and not _matches_file(
+            src.rel, HEALTH_FILE
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        allowed = _file_config(src.rel, _INTEG_ALLOWED) or frozenset()
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{what} in {owner or '<module>'}() — checksum "
+                f"compute/verify allowed only in "
+                f"{sorted(allowed) or 'nothing in this file'}; stamp "
+                "at tier finalize / handoff export and verify at the "
+                "matching ingress via serving/health.py helpers",
+            )
+            for lineno, what, owner in integrity_checksum_sites(
+                src.tree
+            )
+            if owner not in allowed
+        ]
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -1636,6 +1735,7 @@ REGISTRY: List[Rule] = [
     TierPreemptionRule(),
     PrefillFrontierRule(),
     HbmTransferRule(),
+    IntegrityChecksumRule(),
 ]
 
 

@@ -8,6 +8,7 @@ it additionally serves as the host-level coordination store used before
 """
 
 import json
+import random
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Set
@@ -80,12 +81,23 @@ class RetryingKV:
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
         sleep=time.sleep,
+        jitter_seed: Optional[int] = None,
     ):
         self._kv = kv
         self.retries = retries
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self._sleep = sleep
+        # full jitter: with a seed, each sleep draws uniform(0, delay)
+        # so replicas retrying through the same master blip don't
+        # hammer it in lockstep. The undrawn delay still doubles, so
+        # the envelope stays the legacy exponential. None = exact
+        # legacy schedule.
+        self._jitter_rng = (
+            random.Random(jitter_seed)
+            if jitter_seed is not None
+            else None
+        )
 
     def _call(self, primary: str, fallback: str, *args):
         fn = getattr(self._kv, primary, None)
@@ -98,7 +110,10 @@ class RetryingKV:
             except self.TRANSIENT:
                 if attempt >= self.retries:
                     raise
-                self._sleep(delay)
+                if self._jitter_rng is not None:
+                    self._sleep(self._jitter_rng.uniform(0.0, delay))
+                else:
+                    self._sleep(delay)
                 delay = min(delay * 2.0, self.backoff_max_s)
 
     def set(self, key: str, value: bytes):

@@ -89,8 +89,15 @@ class FaultInjector:
     # plan installation — the only consumer — runs on the test thread
     # before any hook thread exists.
     GUARDED_FIELDS = frozenset(
-        {"_engine", "_slow", "_crashed", "_chips_lost", "_kv", "fired"}
+        {"_engine", "_slow", "_crashed", "_chips_lost", "_kv",
+         "_corrupt", "_corrupt_seen", "fired"}
     )
+
+    # KV byte-flip sites `corrupt_kv` can target: host-tier prefix
+    # entries, swap-to-host page runs, and disaggregated handoff
+    # packages — the three designated KV egress paths health.py's
+    # checksums cover.
+    CORRUPT_SITES = ("tier", "swap", "handoff")
 
     def __init__(self, seed: int = 0):
         self._rng = np.random.default_rng(seed)
@@ -104,6 +111,10 @@ class FaultInjector:
         self._chips_lost: Dict[str, int] = {}
         # tag -> [remaining_failures, exception factory]
         self._kv: Dict[str, List[Any]] = {}
+        # (tag, where) -> sorted op indices still to corrupt;
+        # (tag, where) -> ops seen so far at that site
+        self._corrupt: Dict[Tuple[str, str], List[int]] = {}
+        self._corrupt_seen: Dict[Tuple[str, str], int] = {}
         self.fired: List[Tuple[str, str, int]] = []  # (kind, tag, step)
 
     # ---- plan installation ----------------------------------------------
@@ -224,6 +235,34 @@ class FaultInjector:
         with self._lock:
             self._kv[tag] = [int(fail_next), exc_type]
 
+    def corrupt_kv(
+        self,
+        tag: str,
+        where: str = "tier",
+        at_step: Optional[int] = None,
+        between: Optional[Tuple[int, int]] = None,
+    ) -> int:
+        """Flip one byte of a KV payload in transit at `tag`'s
+        `where` site (tier | swap | handoff) — the host-memory /
+        PCIe-transport bit-flip shape health.py's content checksums
+        exist to catch.  `at_step` counts *operations at that site*
+        (0 = the next payload through), drawn from the seeded RNG when
+        `between=(lo, hi)` is given.  The flip happens AFTER the
+        egress checksum is stamped, so a verifying ingress must
+        quarantine the payload.  Returns the (possibly seed-drawn)
+        op index."""
+        if where not in self.CORRUPT_SITES:
+            raise ValueError(
+                f"corrupt_kv where must be one of {self.CORRUPT_SITES},"
+                f" got {where!r}"
+            )
+        op = self._pick_step(at_step, between)
+        with self._lock:
+            plan = self._corrupt.setdefault((tag, where), [])
+            plan.append(op)
+            plan.sort()
+        return op
+
     def revive(self, tag: str) -> None:
         """Clear the tag's crash state and any unfired engine plans —
         the replacement pod came up."""
@@ -296,6 +335,42 @@ class FaultInjector:
             self.fired.append(("kv", tag, plan[0]))
             exc_type = plan[1]
         raise exc_type(f"injected {op}({key}) failure for {tag}")
+
+    def maybe_corrupt(
+        self, tag: str, where: str, data: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """KV-payload hook: the designated egress sites pass every
+        host-side payload (dict of ndarrays) through here AFTER
+        stamping its checksum.  When a `corrupt_kv` plan matches this
+        site's op index, one byte of one array is flipped (seeded
+        choice of array/offset; the victim array is copied, never
+        mutated in place) and ("corrupt", "tag#where", op) is logged
+        to `fired`.  Returns the (possibly corrupted) payload."""
+        with self._lock:
+            key = (tag, where)
+            op = self._corrupt_seen.get(key, 0)
+            self._corrupt_seen[key] = op + 1
+            plan = self._corrupt.get(key)
+            if not plan or op < plan[0]:
+                return data
+            plan.pop(0)
+            names = sorted(
+                n for n, v in data.items()
+                if getattr(v, "nbytes", 0) > 0
+            )
+            if not names:
+                return data
+            victim = names[int(self._rng.integers(0, len(names)))]
+            arr = np.array(data[victim], copy=True)
+            flat = arr.view(np.uint8).reshape(-1)
+            off = int(self._rng.integers(0, flat.size))
+            flat[off] ^= 0xFF
+            out = dict(data)
+            out[victim] = arr
+            self.fired.append(("corrupt", f"{tag}#{where}", op))
+        logger.info("chaos: corrupted %s byte %d of %s/%s (op %d)",
+                    victim, off, tag, where, op)
+        return out
 
 
 class ChaosKV:

@@ -1172,6 +1172,7 @@ class ContinuousBatcher:
         kv_tier_bytes: int = 0,  # host-DRAM KV tier capacity (0 = off)
         swap_to_host: bool = True,   # preempted runs demote, not drop
         kv_tier_promote: str = "always",  # | "swap_only" | "never"
+        kv_checksums: int = 0,   # 1 = content-verify KV in transit
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -1209,6 +1210,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"kv_tier_promote must be 'always', 'swap_only' or "
                 f"'never', got {kv_tier_promote!r}"
+            )
+        if kv_checksums not in (0, 1):
+            raise ValueError(
+                f"kv_checksums must be 0 (off) or 1 (verify KV in "
+                f"transit), got {kv_checksums}"
             )
         _check_positional_capacity(cfg, max_len)
         # ---- serving mesh (GSPMD tensor slice) --------------------------
@@ -1260,6 +1266,13 @@ class ContinuousBatcher:
         self.chaos = chaos
         self.chaos_tag = chaos_tag
         self._step_no = 0
+        # KV integrity (serving/health.py): content checksums over
+        # host-side KV in transit. Host-bytes bookkeeping only — with
+        # the knob at 0 (and no tier/handoff stamped) every device
+        # path is bit-exact legacy and no new program is ever traced.
+        self.kv_checksums = int(kv_checksums)
+        self._integrity_checks = 0
+        self._integrity_quarantines = 0
         # MPMD phase split: "prefill" admits (admission IS the
         # prefill — the admit programs write KV cells 0..p-1
         # synchronously) but never dispatches a decode step; finished
@@ -1455,6 +1468,7 @@ class ContinuousBatcher:
                 block=prefix_block,
                 chaos=chaos,
                 chaos_tag=f"{chaos_tag}#kvtier",
+                checksums=bool(kv_checksums),
             )
 
         # ---- admission-time prefix cache --------------------------------
@@ -2542,6 +2556,26 @@ class ContinuousBatcher:
         if self.kv_tier is None:
             return {}
         return self.kv_tier.stats()
+
+    def health_stats(self) -> Dict[str, float]:
+        """KV-integrity telemetry (serving/health.py) for
+        ServingMetrics / the gateway: verifications and quarantines
+        across every checksum site this engine owns (tier ingress +
+        handoff adopt). {} with the knob off and nothing ever
+        verified, so the legacy telemetry stream is unchanged."""
+        checks = float(self._integrity_checks)
+        quarantines = float(self._integrity_quarantines)
+        if self.kv_tier is not None:
+            ts = self.kv_tier.stats()
+            checks += ts["integrity_checks"]
+            quarantines += ts["quarantines"]
+        if not self.kv_checksums and checks == 0 and quarantines == 0:
+            return {}
+        return {
+            "kv_checksums": float(self.kv_checksums),
+            "integrity_checks": checks,
+            "integrity_quarantines": quarantines,
+        }
 
     def _request_pages(self, req: _Request) -> int:
         """Exact page need for a request: its OWN limit (prompt plus

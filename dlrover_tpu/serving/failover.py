@@ -54,11 +54,21 @@ class CircuitBreaker:
         backoff_base_s: float = 0.5,
         backoff_max_s: float = 30.0,
         clock: Callable[[], float] = time.monotonic,
+        jitter_seed: Optional[int] = None,
     ):
         self.max_strikes = max_strikes
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self._clock = clock
+        # full jitter on probation backoff: with a seed, each delay
+        # draws uniform(0, exp_delay) so breakers tripped by the same
+        # fleet-wide event don't re-probe in lockstep. None keeps the
+        # exact legacy deterministic schedule.
+        self._jitter_rng = (
+            np.random.default_rng(jitter_seed)
+            if jitter_seed is not None
+            else None
+        )
         self.state = CLOSED
         self.strikes = 0
         self._opens = 0  # consecutive trips since last close
@@ -72,6 +82,8 @@ class CircuitBreaker:
                 self.backoff_base_s * (2.0 ** (self._opens - 1)),
                 self.backoff_max_s,
             )
+            if self._jitter_rng is not None:
+                delay = float(self._jitter_rng.uniform(0.0, delay))
         self._opens += 1
         self._retry_at = self._clock() + delay
         self.state = OPEN

@@ -129,11 +129,15 @@ class ServingGateway:
             def log_message(self, fmt, *args):
                 logger.debug("gateway: " + fmt, *args)
 
-            def _json(self, code: int, obj: dict):
+            def _json(
+                self, code: int, obj: dict, headers: dict = None
+            ):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, str(value))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -201,10 +205,18 @@ class ServingGateway:
                 except NoHealthyReplicasError as e:
                     # availability, not backpressure: retrying the
                     # same replica set cannot help until it scales
-                    self._json(503, {"error": e.reason})
+                    self._json(
+                        503,
+                        {"error": e.reason},
+                        headers={"Retry-After": gw._retry_after()},
+                    )
                     return
                 except AdmissionError as e:
-                    self._json(429, {"error": e.reason})
+                    self._json(
+                        429,
+                        {"error": e.reason},
+                        headers={"Retry-After": gw._retry_after()},
+                    )
                     return
                 if payload.get("stream", True):
                     self._stream(req)
@@ -249,7 +261,11 @@ class ServingGateway:
                     self._json(504, {"error": "generation timeout"})
                     return
                 if req.state is RequestState.SHED:
-                    self._json(503, gw._trailer(req))
+                    self._json(
+                        503,
+                        gw._trailer(req),
+                        headers={"Retry-After": gw._retry_after()},
+                    )
                     return
                 if req.state is RequestState.FAILED:
                     # crashed past its retry budget: the service
@@ -384,7 +400,41 @@ class ServingGateway:
                 "escalated": m.tier_escalated_total,
                 "shed": m.tier_shed_total,
             }
+        # health sentinel (serving/health.py): KV integrity
+        # check/quarantine totals from the engine, preflight and
+        # straggler state from the pool (same duck-typing — backends
+        # without the sentinel skip the block)
+        sentinel: dict = {}
+        hstats = getattr(engine, "health_stats", None)
+        if callable(hstats):
+            sentinel.update(hstats())
+        pstats = getattr(self.backend, "health_stats", None)
+        if callable(pstats):
+            sentinel.update(pstats())
+        if sentinel:
+            out["health_sentinel"] = sentinel
         return out
+
+    def _retry_after(self) -> int:
+        """Retry-After seconds for 503/429 responses, derived from
+        the backend's live queue pressure: an idle fleet says "come
+        right back" (1s), a saturated one pushes the retry out so
+        clients don't synchronize a thundering herd onto a backend
+        that is already shedding. Duck-typed: pool backends expose
+        aggregate_pressure(), single schedulers pressure(); anything
+        else gets the 1s floor."""
+        pressure = 0.0
+        for name in ("aggregate_pressure", "pressure"):
+            fn = getattr(self.backend, name, None)
+            if callable(fn):
+                try:
+                    pressure = float(fn())
+                # graftlint: allow(EXC-001) reason=the header is advisory; a pressure probe that raises must not turn an otherwise-correct 503 into a 500
+                except Exception:  # noqa: BLE001
+                    pressure = 0.0
+                break
+        pressure = min(max(pressure, 0.0), 2.0)
+        return max(1, int(round(1.0 + 4.0 * pressure)))
 
     def _prefix_cache(self):
         """The backing engine's RadixPrefixCache, when the backend is

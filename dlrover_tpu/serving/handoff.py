@@ -47,6 +47,11 @@ from dlrover_tpu.serving.engine import (
     _pad_bucket,
     _table_row_prog,
 )
+from dlrover_tpu.serving.health import (
+    KVIntegrityError,
+    kv_checksum,
+    verify_checksum,
+)
 from dlrover_tpu.serving.paged_kv import TRASH_PAGE, OutOfPages
 
 
@@ -116,6 +121,7 @@ class KVHandoff:
     page_size: int = 0            # paged only
     n_ship: int = 0               # occupied pages shipped (paged)
     src: str = ""                 # source engine's chaos tag
+    checksum: str = ""            # content digest (host transport)
 
     @property
     def nbytes(self) -> int:
@@ -163,8 +169,20 @@ def export_run(engine, idx: int, transport: str = "device") -> KVHandoff:
             for name, arr in engine.cache.items()
         }
         page_size, n_ship, n_cells = 0, 0, p
+    checksum = ""
     if transport == "host":
+        # the designated handoff EGRESS (graftlint INTEG-001): stamp
+        # the content digest the moment the bytes land on host, then
+        # let the chaos byte-flip hook model in-transit corruption —
+        # the adopt-side ingress verifies and quarantines
         data = {name: _host_bounce(v) for name, v in data.items()}
+        if getattr(engine, "kv_checksums", 0):
+            checksum = kv_checksum(data)
+        chaos = getattr(engine, "chaos", None)
+        if chaos is not None and hasattr(chaos, "maybe_corrupt"):
+            data = chaos.maybe_corrupt(
+                engine.chaos_tag, "handoff", data
+            )
     return KVHandoff(
         prompt=np.asarray(req.prompt, np.int32).copy(),
         max_new=max(int(engine.limit[slot]) - p, 1),
@@ -176,6 +194,7 @@ def export_run(engine, idx: int, transport: str = "device") -> KVHandoff:
         page_size=page_size,
         n_ship=n_ship,
         src=getattr(engine, "chaos_tag", ""),
+        checksum=checksum,
     )
 
 
@@ -228,6 +247,19 @@ def adopt_into_slot(engine, slot: int, pkg: KVHandoff) -> None:
     lands byte-identical to a colocated admission of the same prompt.
     Raises OutOfPages when the pool cannot back the request even
     after reclaim — the scheduler's replay fallback."""
+    if pkg.checksum:
+        # the designated handoff INGRESS (graftlint INTEG-001): a
+        # stamped package must still hash to its stamp. A mismatch
+        # quarantines the package — every adoption attempt raises, the
+        # coordinator reports failure, and the scheduler resumes the
+        # request by replay: corrupted bytes are never installed.
+        engine._integrity_checks += 1
+        if not verify_checksum(pkg.data, pkg.checksum):
+            engine._integrity_quarantines += 1
+            raise KVIntegrityError(
+                f"handoff package from {pkg.src or 'unknown source'} "
+                "failed content verification; quarantined"
+            )
     check_compatible(engine, pkg)
     if engine.kv_layout == "paged":
         p = pkg.n_cells
@@ -317,6 +349,30 @@ class HandoffCoordinator:
             # the state resume-by-replay must recover from
             self.chaos.on_engine_step(self.chaos_tag, step)
         req = ticket.req
+        if pkg.checksum:
+            # the handoff INGRESS gate (graftlint INTEG-001): verify
+            # the stamped package HERE, before any target enqueues it —
+            # adoption itself runs later, inside the target engine's
+            # admission pump, where a raise would read as a fatal
+            # engine failure and eject the healthy decoder. Returning
+            # False instead sends the source scheduler down the
+            # resume-by-replay fallback: the request re-prefills from
+            # its journaled prompt + prng_key, byte-identical, and the
+            # corrupted bytes are never shipped anywhere.
+            src_eng = getattr(scheduler, "engine", None)
+            if src_eng is not None and hasattr(src_eng, "_integrity_checks"):
+                src_eng._integrity_checks += 1
+            if not verify_checksum(pkg.data, pkg.checksum):
+                if src_eng is not None and hasattr(
+                    src_eng, "_integrity_quarantines"
+                ):
+                    src_eng._integrity_quarantines += 1
+                logger.warning(
+                    "handoff package for request %d failed content "
+                    "verification; quarantined — resuming by replay",
+                    req.id,
+                )
+                return False
         for rep in self._targets(scheduler):
             try:
                 adopted = rep.scheduler.adopt(req, ticket, pkg)

@@ -1,0 +1,291 @@
+"""Fleet health sentinel: preflight device self-checks, fleet-relative
+straggler detection, and KV content checksums.
+
+This is the serving-side mirror of the training stack's
+detect-before-you-die posture (``agent/node_check.py`` runs a device
+probe before a worker joins; ``master/diagnosis.py`` watches runtime
+telemetry for sick hardware).  The serving fleet's circuit breaker only
+reacts to *thrown* exceptions — a gray failure (a replica that is slow
+but alive, or a KV byte flipped in transit across PCIe) sails straight
+through it.  The three detectors here close the detect → degrade →
+eject → rejoin loop for those gray failures:
+
+``run_preflight``
+    A deterministic device probe (fixed-seed matmul + reduction whose
+    result digest is compared against a golden value computed once, on
+    the first single-device run) executed at replica start/restart and
+    after every elastic resize.  Failure fails *closed* into the
+    replica's existing ``degraded`` state.
+
+``StragglerDetector``
+    Per-replica step-latency EWMAs (computed replica-side, published
+    through the existing heartbeat/telemetry path) feed a
+    fleet-relative outlier test: a replica whose EWMA exceeds
+    ``ratio`` × the fleet median for ``patience`` consecutive health
+    passes is fenced.  Escalation is graded: suspect (probe) →
+    fenced (deprioritized in routing) → ejected (breaker open).
+
+``kv_checksum`` / ``verify_checksum``
+    blake2b content digests over host-side KV bytes, stamped at every
+    designated KV egress (tier finalize, handoff export) and verified
+    at every ingress (tier promote/swap-in, handoff adopt).  A
+    mismatch quarantines the entry — it is never re-served — and the
+    caller falls back to the universal resume-by-replay path, so the
+    request still finishes byte-identical.
+
+graftlint INTEG-001 confines checksum compute/verify calls to this
+module plus the designated kv_tier/handoff egress/ingress sites.
+
+Checksums run on host ``numpy`` bytes only: with ``kv_checksums=0``
+(and no sentinel installed) the serving path is bit-exact legacy with
+zero new program-cache entries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "CHECKSUM_BYTES",
+    "KVIntegrityError",
+    "kv_checksum",
+    "verify_checksum",
+    "preflight_digest",
+    "run_preflight",
+    "reset_preflight_golden",
+    "StragglerDetector",
+]
+
+# ---------------------------------------------------------------------------
+# KV content checksums
+# ---------------------------------------------------------------------------
+
+CHECKSUM_BYTES = 16
+
+
+class KVIntegrityError(RuntimeError):
+    """A KV payload failed content-checksum verification at ingress.
+
+    Raised by the designated ingress sites (handoff adopt); the
+    scheduler's existing handoff-failure handling catches it and falls
+    back to resume-by-replay, so the corrupted bytes are never served.
+    """
+
+
+def kv_checksum(data: Dict[str, np.ndarray]) -> str:
+    """Content digest of a host-side KV payload (dict of ndarrays).
+
+    Hashes name, dtype, shape, and raw bytes of every array in sorted
+    name order, so the digest is insensitive to dict insertion order
+    but sensitive to any byte, shape, or dtype change.  Host-only: the
+    arrays must already be fetched (``np.asarray`` forces a blocking
+    D2H elsewhere; this function never triggers one on purpose — it is
+    always called on finalized host copies).
+    """
+    h = hashlib.blake2b(digest_size=CHECKSUM_BYTES)
+    for name in sorted(data):
+        v = np.ascontiguousarray(data[name])
+        h.update(name.encode())
+        h.update(b"\x00")
+        h.update(str(v.dtype).encode())
+        h.update(str(v.shape).encode())
+        h.update(v.tobytes())
+    return h.hexdigest()
+
+
+def verify_checksum(data: Dict[str, np.ndarray], expected: str) -> bool:
+    """True iff ``data`` hashes to ``expected``.  Empty expected → False
+    (an unstamped payload cannot be verified; callers gate on the
+    checksum being present before calling)."""
+    if not expected:
+        return False
+    return kv_checksum(data) == expected
+
+
+# ---------------------------------------------------------------------------
+# Preflight device self-check
+# ---------------------------------------------------------------------------
+
+# Golden digest computed once per process, on the first probe run
+# (canonically a tp=1 single-device context — replica construction in
+# tests and the bench happens before any mesh reshaping).  Every later
+# probe — replica restart, post-elastic-resize — must reproduce it
+# bit-for-bit or the replica fails closed into `degraded`.
+_PREFLIGHT_GOLDEN: Optional[str] = None
+_PREFLIGHT_LOCK = threading.Lock()
+
+_PREFLIGHT_SEED = 0x5EED
+_PREFLIGHT_N = 32
+
+
+def _preflight_probe() -> np.ndarray:
+    """Fixed-seed matmul + reduction on the default device."""
+    import jax.numpy as jnp  # deferred: keep module import host-only
+
+    rng = np.random.default_rng(_PREFLIGHT_SEED)
+    a = rng.standard_normal((_PREFLIGHT_N, _PREFLIGHT_N)).astype(np.float32)
+    b = rng.standard_normal((_PREFLIGHT_N, _PREFLIGHT_N)).astype(np.float32)
+    out = jnp.tanh(jnp.dot(a, b)).sum(axis=0)
+    return np.asarray(out)
+
+
+def preflight_digest() -> str:
+    """Digest of the probe result on the current device."""
+    out = _preflight_probe()
+    h = hashlib.blake2b(digest_size=CHECKSUM_BYTES)
+    h.update(out.tobytes())
+    return h.hexdigest()
+
+
+def run_preflight() -> bool:
+    """Run the device self-check; True iff it matches the golden digest.
+
+    The first call in the process stamps the golden value (and
+    trivially passes); every subsequent call — including after a chip
+    loss and mesh re-form — must reproduce it exactly.
+    """
+    global _PREFLIGHT_GOLDEN
+    d = preflight_digest()
+    with _PREFLIGHT_LOCK:
+        if _PREFLIGHT_GOLDEN is None:
+            _PREFLIGHT_GOLDEN = d
+            return True
+        return d == _PREFLIGHT_GOLDEN
+
+
+def reset_preflight_golden() -> None:
+    """Forget the golden digest (test hook)."""
+    global _PREFLIGHT_GOLDEN
+    with _PREFLIGHT_LOCK:
+        _PREFLIGHT_GOLDEN = None
+
+
+# ---------------------------------------------------------------------------
+# Fleet-relative straggler detection
+# ---------------------------------------------------------------------------
+
+# Escalation levels returned by StragglerDetector.level().
+LEVEL_OK = 0        # within the fleet envelope
+LEVEL_SUSPECT = 1   # over the fence at least once — worth an extra probe
+LEVEL_FENCED = 2    # over for >= patience passes — deprioritize in routing
+LEVEL_EJECT = 3     # over for >= 2*patience passes — open the breaker
+
+
+class StragglerDetector:
+    """Fleet-relative outlier test over published step-latency EWMAs.
+
+    Each replica smooths its own pump wall-time into an EWMA
+    (scheduler-side) and publishes it through telemetry/heartbeats; the
+    pool feeds the latest value per replica into :meth:`observe` and
+    calls :meth:`evaluate` once per health pass.  A replica whose EWMA
+    exceeds ``ratio`` × the fleet median accumulates a strike per pass
+    (reset to zero the moment it re-enters the envelope — recovery is
+    the rejoin path).  Strikes map onto a graded escalation rather
+    than a binary eject, mirroring the paper's diagnosis layer.
+
+    The test is *relative*: with fewer than two replicas reporting
+    there is no fleet to be an outlier of, and nothing is ever
+    flagged.  ``min_latency_s`` keeps idle fleets (microsecond pumps)
+    from flagging scheduling noise.
+    """
+
+    # written by the pool's health thread, read by gateway handler
+    # threads through stats() — all access under self._lock
+    # (graftlint LOCK-001)
+    GUARDED_FIELDS = frozenset(
+        {"_ewma", "_strikes", "flagged_total", "ejections_total"}
+    )
+
+    def __init__(
+        self,
+        ratio: float = 3.0,
+        patience: int = 3,
+        min_latency_s: float = 1e-4,
+    ):
+        if ratio <= 1.0:
+            raise ValueError(f"straggler ratio must be > 1, got {ratio}")
+        if patience < 1:
+            raise ValueError(f"straggler patience must be >= 1, got {patience}")
+        self.ratio = float(ratio)
+        self.patience = int(patience)
+        self.min_latency_s = float(min_latency_s)
+        self._ewma: Dict[str, float] = {}
+        self._strikes: Dict[str, int] = {}
+        # monotone counters for /metrics
+        self.flagged_total = 0
+        self.ejections_total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, replica_id: str, ewma_s: Optional[float]) -> None:
+        """Record a replica's latest published step-latency EWMA."""
+        if ewma_s is None or ewma_s <= 0.0:
+            return
+        with self._lock:
+            self._ewma[replica_id] = float(ewma_s)
+
+    def forget(self, replica_id: str) -> None:
+        """Drop a replica (ejected/removed) from the fleet view."""
+        with self._lock:
+            self._ewma.pop(replica_id, None)
+            self._strikes.pop(replica_id, None)
+
+    def evaluate(self) -> Dict[str, int]:
+        """Run one fleet-relative pass; returns replica → strike count."""
+        with self._lock:
+            if len(self._ewma) < 2:
+                return dict(self._strikes)
+            med = float(np.median(list(self._ewma.values())))
+            fence = max(med * self.ratio, self.min_latency_s)
+            for rid, e in self._ewma.items():
+                if e > fence:
+                    n = self._strikes.get(rid, 0) + 1
+                    self._strikes[rid] = n
+                    if n == self.patience:
+                        self.flagged_total += 1
+                    if n == 2 * self.patience:
+                        self.ejections_total += 1
+                else:
+                    self._strikes[rid] = 0
+            return dict(self._strikes)
+
+    def level(self, replica_id: str) -> int:
+        """Current escalation level for a replica."""
+        with self._lock:
+            n = self._strikes.get(replica_id, 0)
+        if n >= 2 * self.patience:
+            return LEVEL_EJECT
+        if n >= self.patience:
+            return LEVEL_FENCED
+        if n >= 1:
+            return LEVEL_SUSPECT
+        return LEVEL_OK
+
+    def is_straggler(self, replica_id: str) -> bool:
+        """True once a replica has been fenced (>= patience strikes)."""
+        return self.level(replica_id) >= LEVEL_FENCED
+
+    def stragglers(self) -> List[str]:
+        """Replica ids currently at or past the fenced level."""
+        with self._lock:
+            return sorted(
+                rid
+                for rid, n in self._strikes.items()
+                if n >= self.patience
+            )
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            flagged = sum(
+                1 for n in self._strikes.values() if n >= self.patience
+            )
+            return {
+                "stragglers_flagged": float(flagged),
+                "stragglers_flagged_total": float(self.flagged_total),
+                "straggler_ejections_total": float(self.ejections_total),
+                "straggler_ratio": self.ratio,
+                "straggler_patience": float(self.patience),
+            }

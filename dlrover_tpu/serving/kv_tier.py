@@ -55,6 +55,7 @@ from dlrover_tpu.serving.affinity import (
     MAX_PUBLISHED_DIGESTS,
     prefix_digest_chain,
 )
+from dlrover_tpu.serving.health import kv_checksum, verify_checksum
 from dlrover_tpu.serving.paged_kv import TRASH_PAGE
 
 logger = logging.getLogger(__name__)
@@ -235,6 +236,7 @@ class TierEntry:
     n_pages: int = 0              # swap: real pages stored (data is bucket-padded)
     page_size: int = 0
     final: bool = False           # data fully on host
+    checksum: str = ""            # content digest stamped at finalize
 
 
 class HostKVTier:
@@ -250,6 +252,7 @@ class HostKVTier:
         "demotions", "promotions", "swap_outs", "swap_ins",
         "evictions", "rejects", "demote_failures",
         "promote_hits", "promote_misses",
+        "quarantines", "integrity_checks",
     })
 
     def __init__(
@@ -258,6 +261,7 @@ class HostKVTier:
         block: int = 16,
         chaos=None,
         chaos_tag: str = "kv_tier",
+        checksums: bool = False,
     ):
         if capacity_bytes <= 0:
             raise ValueError(
@@ -276,6 +280,11 @@ class HostKVTier:
         # replay with nothing stored and nothing leaked
         self.chaos = chaos
         self.chaos_tag = chaos_tag
+        # kv_checksums knob: stamp a content digest over every entry's
+        # host bytes at finalize (egress) and verify it before the
+        # bytes can ever be promoted (ingress) — a mismatch
+        # quarantines the entry and the caller replays (health.py)
+        self.checksums = bool(checksums)
         self._lock = threading.RLock()
         # LRU: oldest first, newest last (OrderedDict move_to_end)
         self._entries: "OrderedDict[Tuple[str, str], TierEntry]" = (
@@ -295,6 +304,8 @@ class HostKVTier:
         self.demote_failures = 0
         self.promote_hits = 0
         self.promote_misses = 0
+        self.quarantines = 0
+        self.integrity_checks = 0
         self._demote_seq = 0
 
     # ---- internals -------------------------------------------------------
@@ -304,11 +315,48 @@ class HostKVTier:
         return (kind, digest)
 
     def _finalize(self, ent: TierEntry) -> None:
-        """Complete the entry's pending D2H copies (idempotent)."""
+        """Complete the entry's pending D2H copies (idempotent).
+
+        The designated KV EGRESS site (graftlint INTEG-001): the
+        moment the bytes land on host is the moment the content
+        checksum is stamped.  The chaos byte-flip hook runs AFTER the
+        stamp — corruption "in transit" (host memory / PCIe) is
+        exactly what a verifying ingress must catch.
+        """
         if ent.final:
             return
         ent.data = {k: _fetch(v) for k, v in ent.data.items()}
+        if self.checksums:
+            ent.checksum = kv_checksum(ent.data)
+        if self.chaos is not None and hasattr(self.chaos, "maybe_corrupt"):
+            where = "tier" if ent.kind == "prefix" else "swap"
+            ent.data = self.chaos.maybe_corrupt(
+                self.chaos_tag, where, ent.data
+            )
         ent.final = True
+
+    def _verify_locked(self, ent: TierEntry) -> bool:
+        """Content-verify a finalized entry at its INGRESS (promote /
+        swap-in read).  Trivially true with checksums off or for
+        entries stored before the knob flipped."""
+        if not self.checksums or not ent.checksum:
+            return True
+        self.integrity_checks += 1
+        return verify_checksum(ent.data, ent.checksum)
+
+    def _quarantine_locked(self, ent: TierEntry) -> None:
+        """Drop a corrupted entry for good: it is never re-served,
+        its digest stops being advertised (prefix_digests reads
+        _entries), and its bytes are released."""
+        key = self._key(ent.kind, ent.digest)
+        if self._entries.pop(key, None) is not None:
+            self.bytes_used -= ent.nbytes
+        self._refs.pop(key, None)
+        self.quarantines += 1
+        logger.warning(
+            "kv_tier: quarantined corrupted %s entry %s (%d bytes)",
+            ent.kind, ent.digest[:16], ent.nbytes,
+        )
 
     def _evict_for_locked(self, need: int) -> bool:
         """Evict LRU unreferenced entries until `need` bytes fit.
@@ -421,6 +469,12 @@ class HostKVTier:
                 ent = self._entries.get(self._key("prefix", chain[i]))
                 if ent is not None:
                     self._finalize(ent)
+                    if not self._verify_locked(ent):
+                        # corrupted in transit: quarantine and keep
+                        # scanning shallower stored prefixes — worst
+                        # case the caller cold-prefills (replay)
+                        self._quarantine_locked(ent)
+                        continue
                     self._entries.move_to_end(self._key(
                         "prefix", chain[i]
                     ))
@@ -445,6 +499,11 @@ class HostKVTier:
             )
             if ent is not None:
                 self._finalize(ent)
+                if not self._verify_locked(ent):
+                    # corrupted in transit: quarantine; the caller
+                    # falls back to resume-by-replay
+                    self._quarantine_locked(ent)
+                    return None
             return ent
 
     def consume(self, ent: TierEntry) -> None:
@@ -554,4 +613,7 @@ class HostKVTier:
                 "promote_hit_rate": (
                     self.promote_hits / lookups if lookups else 0.0
                 ),
+                "checksums": float(self.checksums),
+                "integrity_checks": float(self.integrity_checks),
+                "quarantines": float(self.quarantines),
             }

@@ -138,6 +138,12 @@ class ServingMetrics:
             "_admission_stall_ms",
             "_prefill_chunks_total",
             "_prefilling_slots",
+            "_kv_integrity_checks",
+            "_kv_quarantines",
+            "_stragglers_flagged",
+            "_stragglers_flagged_total",
+            "_straggler_ejections_total",
+            "_preflight_failed",
         }
     )
 
@@ -285,6 +291,18 @@ class ServingMetrics:
         self._admission_stall_ms = 0.0
         self._prefill_chunks_total = 0
         self._prefilling_slots = 0
+        # health sentinel (serving/health.py): KV integrity
+        # verifications/quarantines copied from the engine's
+        # health_stats() each pump, straggler detector counters and
+        # the currently-fenced gauge copied on the pool's health
+        # pass, and the preflight-failure gauge. All zero with the
+        # sentinel off.
+        self._kv_integrity_checks = 0
+        self._kv_quarantines = 0
+        self._stragglers_flagged = 0
+        self._stragglers_flagged_total = 0
+        self._straggler_ejections_total = 0
+        self._preflight_failed = 0
 
     # ---- ingestion -------------------------------------------------------
 
@@ -493,6 +511,44 @@ class ServingMetrics:
             self._kv_tier_evictions = max(
                 self._kv_tier_evictions, int(stats.get("evictions", 0))
             )
+
+    def update_kv_integrity(self, stats: Dict[str, float]):
+        """Refresh KV integrity telemetry from the engine's
+        health_stats() (serving/health.py checksums). Both values are
+        running totals under the usual max() monotonic guard."""
+        with self._lock:
+            self._kv_integrity_checks = max(
+                self._kv_integrity_checks,
+                int(stats.get("integrity_checks", 0)),
+            )
+            self._kv_quarantines = max(
+                self._kv_quarantines,
+                int(stats.get("integrity_quarantines", 0)),
+            )
+
+    def update_straggler(self, stats: Dict[str, float]):
+        """Refresh straggler-sentinel telemetry from the pool's
+        detector stats(). The currently-fenced count is a gauge (a
+        recovered straggler drops it); the flagged/ejected totals are
+        counters under the max() monotonic guard."""
+        with self._lock:
+            self._stragglers_flagged = int(
+                stats.get("stragglers_flagged", 0)
+            )
+            self._stragglers_flagged_total = max(
+                self._stragglers_flagged_total,
+                int(stats.get("stragglers_flagged_total", 0)),
+            )
+            self._straggler_ejections_total = max(
+                self._straggler_ejections_total,
+                int(stats.get("straggler_ejections_total", 0)),
+            )
+
+    def set_preflight_failed(self, n: int):
+        """Replicas currently failing their preflight self-check
+        (gauge — a passing re-probe clears it)."""
+        with self._lock:
+            self._preflight_failed = int(n)
 
     def set_mesh(self, tp: int, n_chips: int):
         """Refresh the replica's mesh-slice shape (gauges, set
@@ -1288,6 +1344,42 @@ class ServingMetrics:
                 "Fraction of tier lookups that found a promotable "
                 "entry.",
                 self._kv_tier_promote_hit_rate,
+            )
+            counter(
+                "serving_kv_integrity_checks_total",
+                "KV payload checksum verifications at tier/swap/"
+                "handoff ingress.",
+                self._kv_integrity_checks,
+            )
+            counter(
+                "serving_kv_quarantines_total",
+                "KV payloads quarantined on checksum mismatch "
+                "(request fell back to replay).",
+                self._kv_quarantines,
+            )
+            gauge(
+                "serving_stragglers_flagged",
+                "Replicas currently fenced by the straggler "
+                "sentinel.",
+                self._stragglers_flagged,
+            )
+            counter(
+                "serving_stragglers_flagged_total",
+                "Straggler fence events (EWMA over ratio x fleet "
+                "median past patience).",
+                self._stragglers_flagged_total,
+            )
+            counter(
+                "serving_straggler_ejections_total",
+                "Persistent stragglers escalated to breaker-open "
+                "ejection.",
+                self._straggler_ejections_total,
+            )
+            gauge(
+                "serving_preflight_failed",
+                "Replicas currently failing their preflight device "
+                "self-check.",
+                self._preflight_failed,
             )
             gauge(
                 "serving_mesh_tp",

@@ -22,10 +22,12 @@ import json
 import os
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Sequence
 
 from dlrover_tpu.common.log import default_logger as logger
 from dlrover_tpu.master.kv_store import PrefixDirectory, RetryingKV
+from dlrover_tpu.serving import health as _health
 from dlrover_tpu.serving.affinity import (
     FleetDigestMap,
     affinity_order,
@@ -100,6 +102,8 @@ class InferenceReplica:
         chaos=None,
         kv_retries: int = 3,
         kv_backoff_s: float = 0.05,
+        preflight_check: bool = False,
+        kv_jitter_seed: Optional[int] = None,
     ):
         self.id = replica_id
         self.scheduler = scheduler
@@ -107,6 +111,10 @@ class InferenceReplica:
         self.chaos = chaos
         self.kv_retries = kv_retries
         self.kv_backoff_s = kv_backoff_s
+        # seeded full jitter on the KV retry backoff: simultaneous
+        # heartbeat failures must not re-hit the master in lockstep
+        # (None keeps the exact legacy delays)
+        self.kv_jitter_seed = kv_jitter_seed
         self.healthy = True
         self.strikes = 0
         # degraded = alive but serving on a shrunk mesh slice (chip
@@ -115,6 +123,13 @@ class InferenceReplica:
         # NOT accrue breaker strikes — the pool's probation re-probe
         # grows it back when the chips return.
         self.degraded = False
+        # preflight self-check (serving/health.py): a deterministic
+        # device probe at start/restart and after every elastic
+        # resize. A failure fails CLOSED into `degraded`, and
+        # `preflight_ok` pins the flag — the elastic pass must not
+        # clear degraded while the device still computes wrong bits.
+        self.preflight_check = preflight_check
+        self.preflight_ok = True
 
     @property
     def role(self) -> str:
@@ -144,6 +159,7 @@ class InferenceReplica:
             self.kv,
             retries=self.kv_retries,
             backoff_base_s=self.kv_backoff_s,
+            jitter_seed=self.kv_jitter_seed,
         )
         try:
             rkv.set(self.kv_key, self._meta())
@@ -179,6 +195,11 @@ class InferenceReplica:
                 "n_chips": int(getattr(eng, "n_chips", 1)),
                 "role": self.role,
                 "degraded": self.degraded,
+                "preflight_ok": self.preflight_ok,
+                # step-latency EWMA (scheduler-side smoothing): the
+                # fleet-relative straggler test's per-replica signal,
+                # riding the heartbeat like every other health bit
+                "step_latency_s": self.step_latency(),
                 # LoRA adapters resident in this replica's device bank
                 # (MRU-last) — the pool's routing prefers a replica
                 # that already holds the request's adapter, turning
@@ -242,7 +263,42 @@ class InferenceReplica:
         except Exception:  # noqa: BLE001
             return []
 
+    def step_latency(self) -> float:
+        """This replica's published step-latency EWMA in seconds
+        (0.0 before the first dispatch or on schedulers predating
+        it — test doubles). The straggler detector's input."""
+        return float(
+            getattr(self.scheduler, "_step_lat_ewma", 0.0) or 0.0
+        )
+
     # ---- health ----------------------------------------------------------
+
+    def run_preflight(self) -> bool:
+        """Run the deterministic device self-check and fail CLOSED:
+        a digest mismatch (or a raising probe) marks the replica
+        degraded and pins `preflight_ok` False, so the elastic pass
+        cannot heal it until a later preflight passes."""
+        try:
+            ok = _health.run_preflight()
+        except Exception:  # noqa: BLE001 — a raising probe = failed
+            logger.exception(
+                "replica %s preflight probe raised", self.id
+            )
+            ok = False
+        self.preflight_ok = ok
+        if not ok:
+            self.degraded = True
+            logger.warning(
+                "replica %s failed its preflight self-check; "
+                "degraded (failing closed)", self.id,
+            )
+        elif self.degraded:
+            # the device computes right bits again — the elastic pass
+            # owns the rest of the degraded decision (chip deficit)
+            logger.info(
+                "replica %s preflight passed again", self.id
+            )
+        return ok
 
     def probe(self) -> bool:
         """One health probe: the scheduler's driver thread is live (if
@@ -287,6 +343,10 @@ class InferenceReplica:
         except Exception:  # noqa: BLE001
             logger.exception("replica %s restart failed", self.id)
             return False
+        if self.preflight_check:
+            # a rebuilt engine re-earns its place: same discipline as
+            # the training agent's pre-join node check
+            self.run_preflight()
         self.register()
         return True
 
@@ -298,6 +358,8 @@ class InferenceReplica:
         return sched.pressure() + occupancy
 
     def start(self):
+        if self.preflight_check:
+            self.run_preflight()
         self.scheduler.start()
         self.register()
 
@@ -314,7 +376,7 @@ class ReplicaPool:
     # access only under self._lock (graftlint LOCK-001)
     GUARDED_FIELDS = frozenset(
         {"_replicas", "breakers", "_last_hint_ts", "_ranked",
-         "_rank_dirty"}
+         "_rank_dirty", "_straggler_fenced"}
     )
 
     def __init__(
@@ -339,6 +401,9 @@ class ReplicaPool:
         forecast_algorithm: str = (
             "optimize_serving_replica_resource"
         ),
+        straggler_ratio: float = 0.0,
+        straggler_patience: int = 3,
+        breaker_jitter_seed: Optional[int] = None,
     ):
         self.kv = kv
         # degraded-replica handling: shrink a chip-lossy replica live
@@ -352,6 +417,24 @@ class ReplicaPool:
         self._clock = clock
         self.breaker_backoff_base_s = breaker_backoff_base_s
         self.breaker_backoff_max_s = breaker_backoff_max_s
+        # seeded full jitter on the breakers' probation backoff:
+        # simultaneous ejections must not re-probe in lockstep (None
+        # keeps the exact legacy delays). Each replica's breaker gets
+        # a seed decorrelated by its id, deterministically.
+        self.breaker_jitter_seed = breaker_jitter_seed
+        # fleet-relative straggler detection (serving/health.py):
+        # ratio 0 = off (the legacy pool). The sentinel consumes the
+        # step-latency EWMAs heartbeats already publish; fenced
+        # replicas sort behind every healthy candidate in submit()
+        # and escalate to breaker-open when they stay slow.
+        self._sentinel: Optional[_health.StragglerDetector] = (
+            _health.StragglerDetector(
+                ratio=straggler_ratio, patience=straggler_patience
+            )
+            if straggler_ratio > 0
+            else None
+        )
+        self._straggler_fenced: frozenset = frozenset()
         # per-replica circuit breakers: consecutive-failure ejection,
         # exponential-backoff probation, one clean probe to re-admit
         self.breakers: Dict[str, CircuitBreaker] = {}
@@ -398,12 +481,21 @@ class ReplicaPool:
 
     # ---- membership ------------------------------------------------------
 
-    def _new_breaker(self) -> CircuitBreaker:
+    def _new_breaker(self, replica_id: str = "") -> CircuitBreaker:
+        seed = None
+        if self.breaker_jitter_seed is not None:
+            # decorrelate per replica, deterministically: same pool
+            # seed + same id = same jitter stream across runs
+            seed = (
+                self.breaker_jitter_seed
+                + zlib.crc32(replica_id.encode())
+            ) & 0xFFFFFFFF
         return CircuitBreaker(
             max_strikes=self.max_strikes,
             backoff_base_s=self.breaker_backoff_base_s,
             backoff_max_s=self.breaker_backoff_max_s,
             clock=self._clock,
+            jitter_seed=seed,
         )
 
     def add(self, replica: InferenceReplica):
@@ -411,7 +503,7 @@ class ReplicaPool:
             replica.kv = self.kv
         with self._lock:
             self._replicas[replica.id] = replica
-            self.breakers[replica.id] = self._new_breaker()
+            self.breakers[replica.id] = self._new_breaker(replica.id)
         sched = replica.scheduler
         if self.manager is not None and sched.on_failure is None:
             sched.on_failure = self.manager.on_scheduler_failure
@@ -426,7 +518,12 @@ class ReplicaPool:
     def remove(self, replica_id: str) -> Optional[InferenceReplica]:
         self._drop_affinity(replica_id)
         self.mark_rank_dirty()
+        if self._sentinel is not None:
+            self._sentinel.forget(replica_id)
         with self._lock:
+            self._straggler_fenced = (
+                self._straggler_fenced - {replica_id}
+            )
             return self._replicas.pop(replica_id, None)
 
     def replicas(self) -> List[InferenceReplica]:
@@ -541,6 +638,13 @@ class ReplicaPool:
         in preference order until one admits. Documented precedence,
         outermost first:
 
+        0. STRAGGLER fence — a replica the health sentinel has
+           flagged (step-latency EWMA over `straggler_ratio`× the
+           fleet median for `straggler_patience` consecutive passes)
+           sorts behind EVERY healthy candidate, whatever its
+           affinity or load: its in-flight requests finish, but new
+           work reaches it only when nobody else admits. Off (no
+           sort) with straggler_ratio=0.
         1. PHASE tier — new requests start with a prefill, so
            prefill-role replicas take them first (decode-role
            replicas only receive work through the handoff
@@ -611,6 +715,16 @@ class ReplicaPool:
                     self.affinity_max_imbalance,
                     capped,
                 )
+        if self._sentinel is not None and len(candidates) > 1:
+            with self._lock:
+                fenced = self._straggler_fenced
+            if fenced:
+                # the LAST stable sort = the outermost precedence:
+                # a fenced straggler loses to every healthy
+                # candidate, affinity and load included
+                candidates = sorted(
+                    candidates, key=lambda r: r.id in fenced
+                )
         if not candidates:
             # nothing can serve: record a scale-up hint (force bypasses
             # the cooldown — an empty pool is exactly the emergency the
@@ -657,6 +771,21 @@ class ReplicaPool:
                 logger.exception(
                     "health check failed for replica %s", rep.id
                 )
+        if self._sentinel is not None:
+            try:
+                self._straggler_pass()
+            except Exception:  # noqa: BLE001 — keep the round alive
+                logger.exception("straggler pass failed")
+        if self.metrics is not None:
+            spf = getattr(self.metrics, "set_preflight_failed", None)
+            if spf is not None:
+                spf(
+                    sum(
+                        1
+                        for r in self.replicas()
+                        if not getattr(r, "preflight_ok", True)
+                    )
+                )
 
     def _check_one(self, rep: InferenceReplica):
         """Breaker-driven health step for one replica.
@@ -672,7 +801,9 @@ class ReplicaPool:
         with self._lock:
             breaker = self.breakers.get(rep.id)
             if breaker is None:  # replica added behind the pool's back
-                breaker = self.breakers[rep.id] = self._new_breaker()
+                breaker = self.breakers[rep.id] = self._new_breaker(
+                    rep.id
+                )
         if not breaker.should_probe():
             return
         try:
@@ -720,6 +851,92 @@ class ReplicaPool:
                     "%.2fs)", rep.id, breaker.retry_in_s,
                 )
 
+    def _straggler_pass(self) -> None:
+        """One fleet-relative straggler round (serving/health.py):
+        feed every healthy replica's published step-latency EWMA to
+        the sentinel, evaluate the outlier test, and apply the graded
+        escalation — suspect replicas just logged (their probe
+        already ran this round), fenced replicas deprioritized in
+        submit(), persistent stragglers breaker-opened so probation
+        owns the rejoin. Recovery is automatic: back under the fence
+        the strikes reset, the flag drops, and routing resumes."""
+        det = self._sentinel
+        for rep in self.healthy_replicas():
+            det.observe(rep.id, rep.step_latency())
+        det.evaluate()
+        fenced = set()
+        for rep in self.replicas():
+            if not rep.healthy:
+                continue
+            lvl = det.level(rep.id)
+            if lvl >= _health.LEVEL_EJECT:
+                # terminal escalation: open the breaker — the same
+                # ejection path a crashed replica takes, probation
+                # re-probe included. The sentinel forgets it so a
+                # frozen EWMA cannot re-flag the corpse.
+                with self._lock:
+                    breaker = self.breakers.get(rep.id)
+                if breaker is not None:
+                    breaker.trip()
+                rep.healthy = False
+                self._drop_affinity(rep.id)
+                det.forget(rep.id)
+                self.mark_rank_dirty()
+                if self.metrics is not None:
+                    self.metrics.replica_ejected()
+                logger.warning(
+                    "replica %s ejected as a persistent straggler "
+                    "(%.1fms EWMA)", rep.id,
+                    rep.step_latency() * 1000.0,
+                )
+            elif lvl >= _health.LEVEL_FENCED:
+                fenced.add(rep.id)
+                logger.warning(
+                    "replica %s fenced as a straggler (%.1fms EWMA, "
+                    "ratio %.1fx over fleet median for %d+ passes)",
+                    rep.id, rep.step_latency() * 1000.0,
+                    det.ratio, det.patience,
+                )
+            elif lvl >= _health.LEVEL_SUSPECT:
+                logger.info(
+                    "replica %s is a straggler suspect (%.1fms EWMA)",
+                    rep.id, rep.step_latency() * 1000.0,
+                )
+        with self._lock:
+            changed = fenced != set(self._straggler_fenced)
+            self._straggler_fenced = frozenset(fenced)
+        if changed:
+            self.mark_rank_dirty()
+        if self.metrics is not None:
+            upd = getattr(self.metrics, "update_straggler", None)
+            if upd is not None:
+                upd(det.stats())
+
+    def health_stats(self) -> dict:
+        """Sentinel health block (gateway /healthz): preflight
+        outcomes plus the straggler detector's live view. Cheap —
+        flags and counters only, no probes run here."""
+        reps = self.replicas()
+        out: dict = {
+            "preflight_enabled": sum(
+                1
+                for r in reps
+                if getattr(r, "preflight_check", False)
+            ),
+            "preflight_failed": sum(
+                1
+                for r in reps
+                if not getattr(r, "preflight_ok", True)
+            ),
+        }
+        if self._sentinel is not None:
+            out.update(self._sentinel.stats())
+            with self._lock:
+                out["straggler_fenced"] = sorted(
+                    self._straggler_fenced
+                )
+        return out
+
     def _elastic_check(self, rep: InferenceReplica) -> None:
         """Degraded-state step for one HEALTHY replica: consult the
         engine's device health and re-form its mesh live when the
@@ -765,7 +982,17 @@ class ReplicaPool:
                 rep.id, report.old_tp, report.new_tp,
                 report.direction, report.replayed,
             )
-        if lost == 0 and rep.degraded:
+            # re-certify the re-formed mesh before trusting it with
+            # traffic — an elastic resize is exactly the moment a
+            # gray chip sneaks back in. Failing closed: a bad probe
+            # re-degrades the replica below.
+            if rep.preflight_check:
+                rep.run_preflight()
+        if (
+            lost == 0
+            and rep.degraded
+            and getattr(rep, "preflight_ok", True)
+        ):
             rep.degraded = False
             logger.info(
                 "replica %s restored to its full slice", rep.id

@@ -300,6 +300,15 @@ class RequestScheduler:
         self.handoff_transport = handoff_transport
         self.max_handoff_retries = max_handoff_retries
         self.crashed = False
+        # per-replica step-latency EWMA (serving/health.py straggler
+        # detection): wall time of engine.step() dispatches, smoothed
+        # here and published through telemetry()/heartbeats so the
+        # pool's fleet-relative outlier test never needs a new RPC.
+        # Wall clock on purpose (not self._clock): a straggler is slow
+        # in real time, and injected slowness (chaos.slow_replica)
+        # sleeps in real time too.
+        self._step_lat_ewma = 0.0
+        self._step_lat_alpha = 0.25
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -523,6 +532,7 @@ class RequestScheduler:
             "prefix_hits": int(getattr(cache, "hits", 0)),
             "prefix_misses": int(getattr(cache, "misses", 0)),
             "n_chips": int(getattr(self.engine, "n_chips", 1)),
+            "step_latency_s": float(self._step_lat_ewma),
         }
 
     def has_work(self) -> bool:
@@ -784,9 +794,19 @@ class RequestScheduler:
                     req.state = RequestState.RUNNING
                     self._running[idx] = req
                     self.journal.open(req)
-                events = (
-                    self.engine.step() if self.engine.has_work() else []
-                )
+                if self.engine.has_work():
+                    t_step = time.perf_counter()
+                    events = self.engine.step()
+                    dt = time.perf_counter() - t_step
+                    self._step_lat_ewma = (
+                        dt
+                        if self._step_lat_ewma == 0.0
+                        else self._step_lat_alpha * dt
+                        + (1.0 - self._step_lat_alpha)
+                        * self._step_lat_ewma
+                    )
+                else:
+                    events = []
             except ChipLost as exc:
                 # the replica is ALIVE but its slice shrank: re-form
                 # the mesh live at the surviving tp instead of
@@ -926,6 +946,11 @@ class RequestScheduler:
             pfstats = getattr(self.engine, "prefill_stats", None)
             if pfstats is not None:
                 self.metrics.update_prefill(pfstats())
+            hstats = getattr(self.engine, "health_stats", None)
+            if hstats is not None:
+                h = hstats()
+                if h:
+                    self.metrics.update_kv_integrity(h)
             busy = bool(self._running) or any(
                 self._waiting[t] for t in TIERS
             )

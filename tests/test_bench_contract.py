@@ -321,6 +321,15 @@ def test_serve_bench_smoke_emits_driver_contract():
         "kvtier_swap_parity_ok",
         "kvtier_swap_success_rate",
         "n_kvtier_requests",
+        # health-sentinel phase: the gray-failure campaign axes
+        "health_success_rate",
+        "health_parity_ok",
+        "health_quarantines",
+        "health_corrupt_fired",
+        "health_straggler_fenced_pumps",
+        "health_straggler_patience",
+        "health_preflight_ok",
+        "n_health_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -596,3 +605,22 @@ def test_serve_bench_smoke_emits_driver_contract():
     assert detail["kvtier_swap_parity_ok"] is True
     assert detail["kvtier_swap_success_rate"] == 1.0
     assert detail["n_kvtier_requests"] > 0
+    # the health-sentinel acceptance floor: under in-transit KV
+    # corruption plus a chaos-slowed replica, every request still
+    # completes byte-identical to the no-fault oracle (quarantined
+    # payloads fall back to replay — corrupted bytes never reach
+    # decode), at least one corruption fired and was caught, every
+    # preflight self-check passed, and the straggler was fenced
+    # within its patience window (plus warm-up slack for the EWMA to
+    # see the first slowed dispatch)
+    assert detail["health_success_rate"] == 1.0
+    assert detail["health_parity_ok"] is True
+    assert detail["health_corrupt_fired"] >= 1
+    assert detail["health_quarantines"] >= 1
+    assert detail["health_preflight_ok"] is True
+    assert detail["health_straggler_fenced_pumps"] >= 1
+    assert (
+        detail["health_straggler_fenced_pumps"]
+        <= detail["health_straggler_patience"] + 2
+    )
+    assert detail["n_health_requests"] > 0

@@ -43,6 +43,7 @@ from dlrover_tpu.analysis.rules import (
     HandoffAdoptionRule,
     HbmTransferRule,
     HostCopyRule,
+    IntegrityChecksumRule,
     JitSelfCaptureRule,
     KernelHygieneRule,
     LockDisciplineRule,
@@ -54,6 +55,7 @@ from dlrover_tpu.analysis.rules import (
     frontier_write_sites,
     get_rules,
     hbm_transfer_sites,
+    integrity_checksum_sites,
 )
 
 pytestmark = pytest.mark.lint
@@ -1199,6 +1201,133 @@ def test_pragma_for_other_rule_does_not_suppress(tmp_path):
     assert [f.rule_id for f in unsuppressed(findings)] == [
         "CLOCK-001"
     ]
+
+
+# ---------------------------------------------------------------------------
+# INTEG-001: KV integrity checksum discipline
+
+
+def test_integ_rule_flags_stray_checksum_in_serving(tmp_path):
+    # every spelling of the primitives counts: the health helpers,
+    # bare blake2b, and hashlib.blake2b
+    code = """
+    import hashlib
+    from dlrover_tpu.serving.health import kv_checksum, verify_checksum
+    from hashlib import blake2b
+
+    def sneaky_stamp(data):
+        return kv_checksum(data)
+
+    def sneaky_verify(data, d):
+        return verify_checksum(data, d)
+
+    def raw_digest(data):
+        h = hashlib.blake2b(digest_size=16)
+        return blake2b(h.hexdigest().encode())
+    """
+    src = probe(tmp_path, code)
+    found = hits(IntegrityChecksumRule(), src)
+    assert len(found) == 4
+    assert all(f.severity == "CRITICAL" for f in found)
+
+
+def test_integ_rule_vacuity_of_allowlists(tmp_path):
+    # the designated sites are legal; the SAME calls in an unlisted
+    # function of the SAME files are findings — neither kv_tier.py
+    # nor handoff.py is exempt wholesale
+    tier_code = """
+    from dlrover_tpu.serving.health import kv_checksum, verify_checksum
+
+    def _finalize(self, ent):
+        ent.checksum = kv_checksum(ent.data)
+
+    def _verify_locked(self, ent):
+        return verify_checksum(ent.data, ent.checksum)
+
+    def sneaky(self, ent):
+        return kv_checksum(ent.data)
+    """
+    src = probe(
+        tmp_path, tier_code, rel="dlrover_tpu/serving/kv_tier.py"
+    )
+    found = hits(IntegrityChecksumRule(), src)
+    assert len(found) == 1
+    assert "sneaky" in found[0].message
+
+    handoff_code = """
+    from dlrover_tpu.serving.health import kv_checksum, verify_checksum
+
+    def export_run(engine, idx, transport="device"):
+        return kv_checksum({})
+
+    def adopt_into_slot(engine, slot, pkg):
+        return verify_checksum(pkg.data, pkg.checksum)
+
+    def on_prefill_done(self, scheduler, ticket, pkg):
+        return verify_checksum(pkg.data, pkg.checksum)
+
+    def resneak(pkg):
+        return verify_checksum(pkg.data, pkg.checksum)
+    """
+    src = probe(
+        tmp_path, handoff_code, rel="dlrover_tpu/serving/handoff.py",
+        name="handoff_probe.py",
+    )
+    found = hits(IntegrityChecksumRule(), src)
+    assert len(found) == 1
+    assert "resneak" in found[0].message
+
+
+def test_integ_rule_health_module_exempt_wholesale(tmp_path):
+    src = probe(
+        tmp_path,
+        """
+        import hashlib
+
+        def kv_checksum(data):
+            return hashlib.blake2b(b"x").hexdigest()
+        """,
+        rel="dlrover_tpu/serving/health.py",
+    )
+    assert not hits(IntegrityChecksumRule(), src)
+
+
+def test_integ_rule_ignores_outside_serving(tmp_path):
+    # affinity-style digests outside serving/ (e.g. master/) are not
+    # this rule's business
+    src = probe(
+        tmp_path,
+        """
+        import hashlib
+
+        def content_key(b):
+            return hashlib.blake2b(b).hexdigest()
+        """,
+        rel="dlrover_tpu/master/kv_store.py",
+    )
+    assert not hits(IntegrityChecksumRule(), src)
+
+
+def test_integ_rule_not_vacuous_on_real_tree():
+    # the walker must see the real stamp/verify sites (the rule has
+    # something to protect) and the allowlists must cover every one
+    # of them (the tree stays clean)
+    root = pathlib.Path(analysis.__file__).resolve().parents[2]
+    serving = root / "dlrover_tpu" / "serving"
+    owners = {}
+    for name in ("kv_tier.py", "handoff.py", "affinity.py"):
+        src = SourceFile.parse(
+            serving / name, rel=f"dlrover_tpu/serving/{name}"
+        )
+        sites = integrity_checksum_sites(src.tree)
+        owners[name] = {o for _, _, o in sites}
+        assert sites, f"no checksum sites seen in {name}"
+        assert not hits(IntegrityChecksumRule(), src)
+    assert {"_finalize", "_verify_locked"} <= owners["kv_tier.py"]
+    assert {
+        "export_run", "adopt_into_slot", "on_prefill_done"
+    } <= owners["handoff.py"]
+    assert "_block_digest" in owners["affinity.py"]
 
 
 # ---------------------------------------------------------------------------

@@ -295,11 +295,65 @@ class TestAllReplicasUnhealthy:
             )
             resp = conn.getresponse()
             assert resp.status == 503
+            # backpressure exposition (serving/health.py PR): every
+            # 503 carries a Retry-After so shed clients back off
+            # instead of hammering an empty pool. An all-unhealthy
+            # pool reports full pressure (1.0) -> 1 + 4*1.0 = 5s.
+            assert resp.getheader("Retry-After") == "5"
             assert "error" in json.loads(resp.read())
             conn.close()
         finally:
             gw.stop()
             pool.stop()
+
+    @pytest.mark.parametrize(
+        "exc_cls,status",
+        [("no_healthy", 503), ("admission", 429)],
+        ids=["503-unavailable", "429-backpressure"],
+    )
+    def test_retry_after_scales_with_queue_pressure(
+        self, exc_cls, status
+    ):
+        """A saturated backend pushes Retry-After out past the floor:
+        clients shed under pressure must not re-synchronize into a
+        thundering herd. Formula: round(1 + 4 * clamp(pressure, 0, 2))
+        off the backend's live aggregate pressure."""
+        from dlrover_tpu.serving.replica import NoHealthyReplicasError
+        from dlrover_tpu.serving.scheduler import AdmissionError
+
+        exc = (
+            NoHealthyReplicasError("no healthy replicas")
+            if exc_cls == "no_healthy"
+            else AdmissionError("queue full")
+        )
+
+        class SaturatedBackend:
+            def aggregate_pressure(self):
+                return 1.5
+
+            def submit(self, *a, **kw):
+                raise exc
+
+        gw = ServingGateway(SaturatedBackend())
+        gw.start()
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", gw.port, timeout=60
+            )
+            conn.request(
+                "POST",
+                "/v1/generate",
+                json.dumps(
+                    {"tokens": _prompts((5,), seed=8)[0], "max_new": 3}
+                ),
+            )
+            resp = conn.getresponse()
+            assert resp.status == status
+            assert resp.getheader("Retry-After") == "7"  # 1 + 4*1.5
+            resp.read()
+            conn.close()
+        finally:
+            gw.stop()
 
 
 class TestScaleHints:
