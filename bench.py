@@ -600,6 +600,20 @@ def main():
     )
     suspect = on_tpu and mfu_palm > 1.0  # >100% of peak = broken timing
 
+    # ---- weight-byte accounting (int8 weight-quant PR headline) ----
+    # tok/s normalized by resident weight GB: the decode-side
+    # quantization work moves THIS ratio, so both benches record it
+    # for cross-run comparison (serve_bench phase 17 is the paired
+    # int8-vs-f32 measurement)
+    _params = getattr(state, "params", state)
+    weight_bytes = sum(
+        leaf.size * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(_params)
+    )
+    tok_per_weight_gb = (
+        tok_per_sec / (weight_bytes / 1e9) if weight_bytes else 0.0
+    )
+
     # ---- checkpoint axes (reference: flash_checkpoint.md 362-408) ----
     # save-blocking ms of the async shm staging, restore stall from shm,
     # and a goodput estimate from those + the measured step time.
@@ -644,6 +658,10 @@ def main():
                     "step_ms": round(elapsed / iters * 1e3, 1),
                     "loss": final_loss,
                     "suspect_timing": suspect,
+                    "weight_bytes_device": int(weight_bytes),
+                    "tok_per_sec_per_weight_gb": round(
+                        tok_per_weight_gb, 1
+                    ),
                     **ckpt,
                 },
             }

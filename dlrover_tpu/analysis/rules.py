@@ -1713,6 +1713,95 @@ class IntegrityChecksumRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# QUANT-001: weight-quantization call-site discipline
+
+
+# the quantization primitives (ops/quantization.py): the per-block
+# int8 pair plus the stochastic-rounding variant, in any spelling
+# (bare imported name or module attribute)
+_QUANT_CALLS = frozenset(
+    {"quantize_int8", "dequantize_int8", "stochastic_round_int8"}
+)
+
+# functions allowed to quantize/dequantize, per file. The engine's
+# _quantize_params is THE designated install site: weights quantize
+# once, at param install (construction / committed refresh), never
+# per-step. models/decode.py is in scope but allows nothing — its
+# forward paths consume QuantizedWeight via matmul_any's fused
+# dequant and must never re-materialize dense weights. Serving files
+# not listed allow nothing.
+_QUANT_ALLOWED: Dict[str, FrozenSet[str]] = {
+    ENGINE_FILE: frozenset({"_quantize_params"}),
+}
+
+
+def weight_quant_sites(
+    tree: ast.AST,
+) -> List[Tuple[int, str, Optional[str]]]:
+    """(lineno, what, enclosing-function-name) for every quantization
+    primitive call: quantize_int8/dequantize_int8/
+    stochastic_round_int8, bare or as a module attribute."""
+    out = []
+    for node, owner in walk_with_owner(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _QUANT_CALLS:
+            out.append((node.lineno, f"{f.id}(...)", owner))
+        elif (
+            isinstance(f, ast.Attribute) and f.attr in _QUANT_CALLS
+        ):
+            out.append(
+                (node.lineno, f"{ast.unparse(f)}(...)", owner)
+            )
+    return out
+
+
+class WeightQuantSiteRule(Rule):
+    id = "QUANT-001"
+    severity = CRITICAL
+    title = (
+        "weight quantize/dequantize only at the designated "
+        "install site"
+    )
+    rationale = (
+        "DEVIATIONS §22: served weights quantize exactly once, at "
+        "param install (engine construction or a committed "
+        "version-fenced refresh) — the whole point is that decode "
+        "then streams int8 bytes from HBM. A quantize call anywhere "
+        "else in the serving path either re-quantizes per step "
+        "(burning the bandwidth the feature exists to save, and "
+        "double-rounding the weights), or silently diverges from "
+        "the installed banks so the kernel-vs-reference parity and "
+        "byte-accounting contracts test a tree that is not the one "
+        "serving. A dequantize call in the forward path "
+        "re-materializes the dense weights — the fused matmul_any "
+        "path is the only sanctioned consumer."
+    )
+
+    def applies(self, src: SourceFile) -> bool:
+        return _in_serving(src) or _matches_file(
+            src.rel, DECODE_FILE
+        )
+
+    def check(self, src: SourceFile) -> List[Finding]:
+        allowed = _file_config(src.rel, _QUANT_ALLOWED) or frozenset()
+        return [
+            self.finding(
+                src,
+                lineno,
+                f"{what} in {owner or '<module>'}() — weight "
+                f"quantization allowed only in "
+                f"{sorted(allowed) or 'nothing in this file'}; "
+                "quantize at the engine's _quantize_params install "
+                "site and consume via ops.quantization.matmul_any",
+            )
+            for lineno, what, owner in weight_quant_sites(src.tree)
+            if owner not in allowed
+        ]
+
+
+# ---------------------------------------------------------------------------
 # registry
 
 
@@ -1736,6 +1825,7 @@ REGISTRY: List[Rule] = [
     PrefillFrontierRule(),
     HbmTransferRule(),
     IntegrityChecksumRule(),
+    WeightQuantSiteRule(),
 ]
 
 

@@ -28,6 +28,7 @@ from dlrover_tpu.models.llama import (
     _mlp_residual,
     _rms_norm,
 )
+from dlrover_tpu.ops.quantization import matmul_any
 from dlrover_tpu.parallel.mesh import SERVING_TP_AXIS
 from dlrover_tpu.parallel.sharding import constrain
 
@@ -258,15 +259,16 @@ def _block(
     serving (see `_forward_cached`)."""
     lp = _compute_weights(cfg, layer_params)
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
-    q, k, v = _attn_qkv(cfg, None, h, lp, positions, lora=lora)
+    tp = _mesh_tp(mesh)
+    q, k, v = _attn_qkv(cfg, None, h, lp, positions, lora=lora, tp=tp)
     attn, layer_cache = _write_cache_and_attend(
         q, k, v, layer_cache, positions, start, cfg.head_dim,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
         plain_causal=plain_causal,
         mesh=mesh,
     )
-    x = _attn_residual(cfg, None, x, attn, lp, lora=lora)
-    x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
+    x = _attn_residual(cfg, None, x, attn, lp, lora=lora, tp=tp)
+    x, _aux = _mlp_residual(cfg, None, x, layer_params, lp, tp=tp)
     return x, layer_cache
 
 
@@ -282,15 +284,16 @@ def _block_gpt(
     decode-specific parts (positions are consumed at embedding time)."""
     from dlrover_tpu.models import gpt
 
-    q, k, v = gpt._attn_qkv(cfg, x, lp)
+    tp = _mesh_tp(mesh)
+    q, k, v = gpt._attn_qkv(cfg, x, lp, tp=tp)
     attn, layer_cache = _write_cache_and_attend(
         q, k, v, layer_cache, positions, start, cfg.head_dim,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
         plain_causal=plain_causal,
         mesh=mesh,
     )
-    x = gpt._attn_residual(cfg, x, attn, lp)
-    x = gpt._mlp_residual(cfg, x, lp)
+    x = gpt._attn_residual(cfg, x, attn, lp, tp=tp)
+    x = gpt._mlp_residual(cfg, x, lp, tp=tp)
     return x, layer_cache
 
 
@@ -388,7 +391,7 @@ def _forward_cached(
             x, params["final_norm"]["scale"], cfg.norm_eps
         )
         head = _head_matrix(cfg, params)
-    logits = (x @ head).astype(jnp.float32)
+    logits = matmul_any(x, head, tp=_mesh_tp(mesh)).astype(jnp.float32)
     return logits, cache_new
 
 
@@ -891,14 +894,15 @@ def _block_paged(
     write + view differ."""
     lp = _compute_weights(cfg, layer_params)
     h = _rms_norm(x, layer_params["attn_norm"], cfg.norm_eps)
-    q, k, v = _attn_qkv(cfg, None, h, lp, positions, lora=lora)
+    tp = _mesh_tp(mesh)
+    q, k, v = _attn_qkv(cfg, None, h, lp, positions, lora=lora, tp=tp)
     attn, layer_pool = _write_pages_and_attend(
         q, k, v, layer_pool, table, positions, cfg.head_dim,
         mesh=mesh,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
     )
-    x = _attn_residual(cfg, None, x, attn, lp, lora=lora)
-    x, _aux = _mlp_residual(cfg, None, x, layer_params, lp)
+    x = _attn_residual(cfg, None, x, attn, lp, lora=lora, tp=tp)
+    x, _aux = _mlp_residual(cfg, None, x, layer_params, lp, tp=tp)
     return x, layer_pool
 
 
@@ -907,14 +911,15 @@ def _block_gpt_paged(
 ):
     from dlrover_tpu.models import gpt
 
-    q, k, v = gpt._attn_qkv(cfg, x, lp)
+    tp = _mesh_tp(mesh)
+    q, k, v = gpt._attn_qkv(cfg, x, lp, tp=tp)
     attn, layer_pool = _write_pages_and_attend(
         q, k, v, layer_pool, table, positions, cfg.head_dim,
         mesh=mesh,
         attn_impl=getattr(cfg, "attn_impl", "auto"),
     )
-    x = gpt._attn_residual(cfg, x, attn, lp)
-    x = gpt._mlp_residual(cfg, x, lp)
+    x = gpt._attn_residual(cfg, x, attn, lp, tp=tp)
+    x = gpt._mlp_residual(cfg, x, lp, tp=tp)
     return x, layer_pool
 
 
@@ -971,7 +976,7 @@ def _forward_paged(
             x, params["final_norm"]["scale"], cfg.norm_eps
         )
         head = _head_matrix(cfg, params)
-    logits = (x @ head).astype(jnp.float32)
+    logits = matmul_any(x, head, tp=_mesh_tp(mesh)).astype(jnp.float32)
     return logits, pool_new
 
 

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from dlrover_tpu.ops.attention import dot_product_attention
+from dlrover_tpu.ops.quantization import QuantizedWeight, matmul_any
 from dlrover_tpu.parallel.sharding import constrain
 from dlrover_tpu.models.normalization import layer_norm_gb as _layer_norm
 
@@ -132,15 +133,25 @@ def partition_rules(cfg: GptConfig):
 
 
 
-def _attn_qkv(cfg: GptConfig, x, lp):
+def _wcast(w, dtype):
+    """Compute-dtype cast for a dense weight; a QuantizedWeight passes
+    through untouched (its dequant fuses into matmul_any). Dense
+    weights keep the exact legacy `.astype` so weight_quant="none"
+    stays byte-identical."""
+    if isinstance(w, QuantizedWeight):
+        return w
+    return w.astype(dtype)
+
+
+def _attn_qkv(cfg: GptConfig, x, lp, tp: int = 1):
     """LN1 + fused qkv projection — shared with the KV-cache decoder
     (models/decode.py) so there is one definition of the block math."""
     B, S, _ = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
     h = _layer_norm(x, lp["ln1_g"], lp["ln1_b"], cfg.norm_eps)
-    qkv = h @ lp["wqkv"].astype(cfg.dtype) + lp["b_qkv"].astype(
-        cfg.dtype
-    )
+    qkv = matmul_any(h, _wcast(lp["wqkv"], cfg.dtype), tp=tp) + lp[
+        "b_qkv"
+    ].astype(cfg.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     return (
         q.reshape(B, S, H, hd),
@@ -149,21 +160,26 @@ def _attn_qkv(cfg: GptConfig, x, lp):
     )
 
 
-def _attn_residual(cfg: GptConfig, x, attn, lp):
+def _attn_residual(cfg: GptConfig, x, attn, lp, tp: int = 1):
     B, S, _ = x.shape
     return x + (
-        attn.reshape(B, S, cfg.dim) @ lp["wo"].astype(cfg.dtype)
+        matmul_any(
+            attn.reshape(B, S, cfg.dim), _wcast(lp["wo"], cfg.dtype),
+            tp=tp,
+        )
         + lp["b_o"].astype(cfg.dtype)
     )
 
 
-def _mlp_residual(cfg: GptConfig, x, lp):
+def _mlp_residual(cfg: GptConfig, x, lp, tp: int = 1):
     h = _layer_norm(x, lp["ln2_g"], lp["ln2_b"], cfg.norm_eps)
-    up = h @ lp["w_up"].astype(cfg.dtype) + lp["b_up"].astype(cfg.dtype)
-    up = jax.nn.gelu(up)
-    return x + up @ lp["w_down"].astype(cfg.dtype) + lp[
-        "b_down"
+    up = matmul_any(h, _wcast(lp["w_up"], cfg.dtype), tp=tp) + lp[
+        "b_up"
     ].astype(cfg.dtype)
+    up = jax.nn.gelu(up)
+    return x + matmul_any(
+        up, _wcast(lp["w_down"], cfg.dtype), tp=tp
+    ) + lp["b_down"].astype(cfg.dtype)
 
 
 def _block(cfg: GptConfig, mesh, x, lp):

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from dlrover_tpu.ops.attention import dot_product_attention
+from dlrover_tpu.ops.quantization import QuantizedWeight, matmul_any
 from dlrover_tpu.parallel.remat import checkpoint_name
 from dlrover_tpu.parallel.sharding import constrain
 
@@ -275,6 +276,14 @@ def _compute_weights(cfg: LlamaConfig, layer_params) -> Dict:
     for k, v in layer_params.items():
         if k.endswith("_norm") or "_lora_" in k:
             continue
+        if isinstance(v, QuantizedWeight):
+            # int8-quantized serving weight: dequant is fused into the
+            # matmul (matmul_any), and serving LoRA is the per-slot
+            # BGMV delta added AFTER the base projection — merged
+            # `_lora_` leaves never coexist with a quantized base
+            # (engine install quantizes the bare tree).
+            out[k] = v
+            continue
         w = v.astype(cfg.dtype)
         a = layer_params.get(k + "_lora_a")
         if a is not None:
@@ -300,7 +309,9 @@ def _slot_lora_delta(h, a, b, idx, scale):
     return scale[idx].astype(h.dtype)[:, None, None] * d
 
 
-def _attn_qkv(cfg: LlamaConfig, mesh, h, lp, positions, lora=None):
+def _attn_qkv(
+    cfg: LlamaConfig, mesh, h, lp, positions, lora=None, tp: int = 1
+):
     """Projections + RoPE of one block — shared by the training layer
     and the KV-cache decoder (models/decode.py), so there is exactly
     one definition of the attention inputs.
@@ -312,7 +323,9 @@ def _attn_qkv(cfg: LlamaConfig, mesh, h, lp, positions, lora=None):
     merged-weight projection."""
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     b, s, _ = h.shape
-    hq, hk, hv = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    hq = matmul_any(h, lp["wq"], tp=tp)
+    hk = matmul_any(h, lp["wk"], tp=tp)
+    hv = matmul_any(h, lp["wv"], tp=tp)
     if lora is not None:
         bank, idx, scale = lora
         hq = hq + _slot_lora_delta(
@@ -335,7 +348,9 @@ def _attn_qkv(cfg: LlamaConfig, mesh, h, lp, positions, lora=None):
     return q, k, v
 
 
-def _attn_residual(cfg: LlamaConfig, mesh, x, attn, lp, lora=None):
+def _attn_residual(
+    cfg: LlamaConfig, mesh, x, attn, lp, lora=None, tp: int = 1
+):
     """Output projection + residual (shared with decode). `lora` adds
     the per-slot wo delta to the projection (same triple as
     `_attn_qkv`)."""
@@ -343,7 +358,7 @@ def _attn_residual(cfg: LlamaConfig, mesh, x, attn, lp, lora=None):
     attn = checkpoint_name(
         attn.reshape(b, s, cfg.n_heads * cfg.head_dim), "attn_out"
     )
-    o = checkpoint_name(attn @ lp["wo"], "attn_proj")
+    o = checkpoint_name(matmul_any(attn, lp["wo"], tp=tp), "attn_proj")
     if lora is not None:
         bank, idx, scale = lora
         o = o + _slot_lora_delta(
@@ -352,7 +367,7 @@ def _attn_residual(cfg: LlamaConfig, mesh, x, attn, lp, lora=None):
     return x + constrain(o, mesh, ("data", "fsdp"), "seq", None)
 
 
-def _mlp_residual(cfg: LlamaConfig, mesh, x, layer_params, lp):
+def _mlp_residual(cfg: LlamaConfig, mesh, x, layer_params, lp, tp: int = 1):
     """Dense-SwiGLU / MoE feed-forward + residual (shared with decode).
     Returns (x, moe aux loss — zero for dense)."""
     h = _rms_norm(x, layer_params["mlp_norm"], cfg.norm_eps)
@@ -369,13 +384,15 @@ def _mlp_residual(cfg: LlamaConfig, mesh, x, layer_params, lp):
         )
         x = x + constrain(ff_out, mesh, ("data", "fsdp"), "seq", None)
         return x, moe_metrics["moe_aux_loss"]
-    gate = jax.nn.silu(checkpoint_name(h @ lp["w_gate"], "mlp_gate"))
-    up = checkpoint_name(h @ lp["w_up"], "mlp_up")
+    gate = jax.nn.silu(
+        checkpoint_name(matmul_any(h, lp["w_gate"], tp=tp), "mlp_gate")
+    )
+    up = checkpoint_name(matmul_any(h, lp["w_up"], tp=tp), "mlp_up")
     ff = constrain(
         gate * up, mesh, ("data", "fsdp"), "seq", "tensor"
     )
     x = x + constrain(
-        checkpoint_name(ff @ lp["w_down"], "mlp_down"),
+        checkpoint_name(matmul_any(ff, lp["w_down"], tp=tp), "mlp_down"),
         mesh, ("data", "fsdp"), "seq", None,
     )
     return x, jnp.zeros((), jnp.float32)
@@ -486,10 +503,19 @@ def apply(
     return logits
 
 
-def _head_matrix(cfg: LlamaConfig, params: Params) -> jax.Array:
+def _head_matrix(cfg: LlamaConfig, params: Params):
+    """The unembedding operand for `matmul_any(x, head)`. Tied
+    embeddings are NEVER quantized (the token gather at embedding
+    time needs the dense table anyway, so there are no bytes to
+    save); an untied lm_head may arrive int8-quantized from the
+    serving install and is returned as-is — its dequant fuses into
+    the logits matmul."""
     if cfg.tie_embeddings:
         return params["embed"]["weight"].astype(cfg.dtype).T
-    return params["lm_head"]["weight"].astype(cfg.dtype)
+    w = params["lm_head"]["weight"]
+    if isinstance(w, QuantizedWeight):
+        return w
+    return w.astype(cfg.dtype)
 
 
 def loss_fn(

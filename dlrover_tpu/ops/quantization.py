@@ -184,6 +184,193 @@ def stochastic_round_int8(
 
 
 # ---------------------------------------------------------------------------
+# int8 weight-quantized matmul (serving decode path)
+# ---------------------------------------------------------------------------
+#
+# Decode is weight-HBM-bandwidth bound: every step streams the full
+# projection/MLP/unembed weights through the MXU once. Storing them as
+# per-block int8 + f32 scales reads ~0.27x the f32 bytes (int8 values
+# + 4B/block scales), and the dequant runs on the VPU between the
+# HBM->VMEM stage and the MXU dot — bandwidth, not FLOPs, pays.
+#
+# Layout: OUTPUT-MAJOR, blocks along the CONTRACTION dim. A weight
+# w [K, O] (activations contract K) is stored transposed as
+# q8 [O, K] int8 with s8 [O, K/block] f32 — one scale per contiguous
+# K-block of one output row. Two properties fall out:
+#   * tp column-sharding splits O, never K, so a shard boundary can
+#     never straddle a quant block — resharding at a new tp (elastic
+#     resize) moves q8+s8 as-is, NO requantize;
+#   * the contraction dim is never split, preserving the serving
+#     byte-parity argument (models/decode.py): per-output-element
+#     reduction order is identical at every tp.
+
+
+@jax.tree_util.register_pytree_with_keys_class
+class QuantizedWeight:
+    """Per-block int8 weight in output-major (transposed) layout.
+
+    q8: int8 ``[..., O, K]`` (leading dims: stacked layers), blocks of
+    size `block` along the last (contraction) dim; s8: f32
+    ``[..., O, K/block]``. Registered as a keyed pytree node so the
+    pair flows through ``lax.scan`` (per-layer slicing of the leading
+    axis), ``shard_tree`` (children path like ``layers/wq/q8`` match
+    the serving placement rules), jit, and device_put like any other
+    param subtree."""
+
+    __slots__ = ("q8", "s8", "block")
+
+    def __init__(self, q8, s8, block: int):
+        self.q8 = q8
+        self.s8 = s8
+        self.block = int(block)
+
+    def tree_flatten_with_keys(self):
+        return (
+            (
+                (jax.tree_util.GetAttrKey("q8"), self.q8),
+                (jax.tree_util.GetAttrKey("s8"), self.s8),
+            ),
+            self.block,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q8, s8 = children
+        return cls(q8, s8, aux)
+
+    @property
+    def shape(self):
+        """Shape of the DENSE weight this stands in for ([..., K, O])."""
+        *lead, o, k = self.q8.shape
+        return tuple(lead) + (k, o)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (
+            f"QuantizedWeight(q8={getattr(self.q8, 'shape', None)}, "
+            f"s8={getattr(self.s8, 'shape', None)}, "
+            f"block={self.block})"
+        )
+
+
+def weight_quant_block(k: int, cap: int = DEFAULT_BLOCK) -> int:
+    """Quant block for a contraction dim of size `k`: the largest
+    power-of-two divisor of k, capped at `cap`. Returns 0 when k has
+    no even divisor >= 8 (leave such a weight dense rather than
+    per-element scales). Real-TPU Mosaic wants >= 128; tiny test
+    configs (k=64) only ever run the interpret/reference paths, same
+    convention as the quantize kernels above."""
+    b = 1
+    while b < cap and k % (b * 2) == 0:
+        b *= 2
+    return b if b >= 8 else 0
+
+
+def use_quant_matmul_kernel(tp: int = 1) -> bool:
+    """Kernel-vs-reference gate for the fused dequant matmul, the
+    KERNEL-001 shape shared with attention dispatch: the Pallas path
+    is dispatchable on TPU or when force_kernels() opts the
+    interpret-mode kernel in on CPU. tp > 1 stays on the XLA
+    reference — the weights are GSPMD-sharded over the output axis
+    and XLA partitions dequant+dot natively (per-shard pallas
+    dispatch for sharded weights is a real-TPU follow-up)."""
+    from dlrover_tpu.ops.flash_attention import force_kernels
+
+    if tp > 1:
+        return False
+    if jax.default_backend() == "tpu":
+        return True
+    return force_kernels()
+
+
+def _dq_weight(q8: jax.Array, s8: jax.Array, block: int, dtype):
+    """Dequantize one output-major weight [O, K] to `dtype`. The ONE
+    dequant formulation both the kernel body and the XLA reference
+    run — broadcast scales over their block, multiply in f32, cast —
+    so the two paths stay byte-identical on the same backend."""
+    o, k = q8.shape
+    g = s8.shape[-1]
+    s = jnp.broadcast_to(s8[:, :, None], (o, g, block)).reshape(o, k)
+    return (q8.astype(jnp.float32) * s).astype(dtype)
+
+
+def _dqmm_dot(x: jax.Array, wt: jax.Array) -> jax.Array:
+    """x [T, K] . wt [O, K] -> [T, O], f32 accumulation on the MXU."""
+    return jax.lax.dot_general(
+        x,
+        wt,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _dqmm_kernel(x_ref, q_ref, s_ref, o_ref, *, block):
+    wt = _dq_weight(q_ref[...], s_ref[...], block, x_ref.dtype)
+    o_ref[...] = _dqmm_dot(x_ref[...], wt).astype(o_ref.dtype)
+
+
+# output-tile for the fused kernel: q8 bytes + f32 dequant staging at
+# bo=256, K<=8192 stays ~10 MB VMEM alongside the x operand
+_DQMM_BO = 256
+
+
+def quantized_matmul_kernel(x: jax.Array, w: QuantizedWeight):
+    """Pallas fused dequant-matmul: grid tiles ONLY the output dim
+    (full K per instance — one pass over x, whole-row reduction), the
+    int8 block + its scales dequantize in VMEM right before the dot.
+    In interpret mode the grid collapses to one instance, so the body
+    runs the exact op sequence of `quantized_matmul_reference` —
+    that is the byte-parity oracle the tests and bench phase lock."""
+    t, k = x.shape
+    o = w.q8.shape[0]
+    bo = o if (_interpret() or o % _DQMM_BO) else _DQMM_BO
+    return pl.pallas_call(
+        functools.partial(_dqmm_kernel, block=w.block),
+        grid=(o // bo,),
+        in_specs=[
+            pl.BlockSpec((t, k), lambda i: (0, 0)),
+            pl.BlockSpec((bo, k), lambda i: (i, 0)),
+            pl.BlockSpec((bo, w.s8.shape[-1]), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, bo), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((t, o), x.dtype),
+        interpret=_interpret(),
+    )(x, w.q8, w.s8)
+
+
+def quantized_matmul_reference(x: jax.Array, w: QuantizedWeight):
+    """XLA reference formulation: dequantize the whole weight, then
+    one dot. Same `_dq_weight` + `_dqmm_dot` sequence as the kernel
+    body; under tp > 1 XLA partitions it over the output axis with
+    zero collectives (O is the sharded dim, K is whole)."""
+    wt = _dq_weight(w.q8, w.s8, w.block, x.dtype)
+    return _dqmm_dot(x, wt).astype(x.dtype)
+
+
+def quantized_matmul(
+    x: jax.Array, w: QuantizedWeight, tp: int = 1
+) -> jax.Array:
+    """Dequant-fused ``x @ dense(w)`` for an output-major quantized
+    weight; x may carry leading batch dims ([..., K] -> [..., O])."""
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    if use_quant_matmul_kernel(tp=tp):
+        y = quantized_matmul_kernel(x2, w)
+    else:
+        y = quantized_matmul_reference(x2, w)
+    return y.reshape(*lead, y.shape[-1])
+
+
+def matmul_any(x: jax.Array, w, tp: int = 1) -> jax.Array:
+    """The models' one matmul dispatch: dense weights take the exact
+    legacy primitive (``x @ w`` — weight_quant="none" stays
+    byte-identical by construction), QuantizedWeight takes the fused
+    dequant path."""
+    if isinstance(w, QuantizedWeight):
+        return quantized_matmul(x, w, tp=tp)
+    return x @ w
+
+
+# ---------------------------------------------------------------------------
 # compressed collectives (the quant_reduce equivalent)
 # ---------------------------------------------------------------------------
 
@@ -195,7 +382,14 @@ def _ring_reduce_scatter_q(x, axis_name: str, block: int):
     [c, ...]. Each of the n-1 hops sends one quantized chunk to the next
     rank (ppermute), which dequantizes and accumulates its local data.
     """
-    n = jax.lax.axis_size(axis_name)
+    # jax.lax.axis_size only landed after 0.4.x; psum of the literal 1
+    # folds to the static Python int (the `range(n)` perms below need
+    # a static size)
+    n = (
+        jax.lax.axis_size(axis_name)
+        if hasattr(jax.lax, "axis_size")
+        else jax.lax.psum(1, axis_name)
+    )
     rank = jax.lax.axis_index(axis_name)
     if x.shape[0] % n != 0:
         raise ValueError(

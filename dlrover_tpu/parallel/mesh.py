@@ -382,6 +382,30 @@ def serving_adapter_specs(mesh: Mesh) -> Dict[str, PartitionSpec]:
     }
 
 
+def serving_weight_quant_specs() -> Tuple[Tuple[str, PartitionSpec], ...]:
+    """(path-regex, PartitionSpec) placement rules for the int8
+    weight-quantized serving tree (engine weight_quant="int8").
+
+    Quantized weights are stored OUTPUT-MAJOR ([L, O, K] int8 values,
+    [L, O, K/block] f32 scales — ops/quantization.QuantizedWeight), so
+    the column split the dense serving rules put on wq/wk/wv's output
+    axis (their LAST dim) lands on axis 1 here, and the scales ride
+    the SAME "tp" axis as their int8 blocks: a shard boundary can
+    never straddle a quant block, which is what lets an elastic
+    resize reshard q8+s8 at any tp without requantizing. Everything
+    the dense rules replicate (wo, MLP, unembed) stays replicated by
+    the default rule, so these three families are the whole table.
+    The dense rules are ``$``-anchored (``layers/wq$``) and cannot
+    match the ``.../q8`` children — the weight_quant="none" tree is
+    untouched by construction."""
+    col = PartitionSpec(None, SERVING_TP_AXIS, None)
+    return (
+        (r"layers/wq/(q8|s8)$", col),
+        (r"layers/wk/(q8|s8)$", col),
+        (r"layers/wv/(q8|s8)$", col),
+    )
+
+
 def largest_serving_tp(
     n_chips: int,
     n_kv_heads: Optional[int] = None,

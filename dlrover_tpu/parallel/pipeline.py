@@ -30,9 +30,13 @@ def _shard_map_manual(f, mesh, in_specs, out_specs, axis: str):
     """shard_map with only `axis` manual (jax>=0.9 axis_names API)."""
     import inspect
 
-    sig = inspect.signature(jax.shard_map)
-    if "axis_names" in sig.parameters:
-        return jax.shard_map(
+    # jax.shard_map is absent on 0.4.x (the module __getattr__ raises,
+    # so probe with getattr, not hasattr-then-touch)
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None and "axis_names" in inspect.signature(
+        sm
+    ).parameters:
+        return sm(
             f,
             mesh=mesh,
             in_specs=in_specs,

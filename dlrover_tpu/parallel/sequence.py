@@ -41,6 +41,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
+def _axis_size(axis_name: str) -> int:
+    """Static mapped-axis size. jax.lax.axis_size only landed after
+    0.4.x; psum of the literal 1 is the portable spelling (a non-tracer
+    operand folds to the Python int, so `range(sp)` / `h % sp` below
+    stay static under shard_map + jit)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 # ---------------------------------------------------------------------------
 # Ulysses: scatter heads, gather sequence
 # ---------------------------------------------------------------------------
@@ -84,7 +94,7 @@ def ulysses_attention(
     attention on H/sp local heads, and converts back. Requires H % sp == 0;
     KV heads are broadcast up to a multiple of sp first if needed.
     """
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     h = q.shape[2]
     if h % sp:
         raise ValueError(f"ulysses needs n_heads % sp == 0 ({h} % {sp})")
@@ -124,7 +134,7 @@ def ring_attention(
     step folds one chunk into an online-softmax accumulator. Handles GQA
     (H % KV == 0) and causal masking in global coordinates.
     """
-    sp = jax.lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5

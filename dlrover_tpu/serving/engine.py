@@ -110,12 +110,20 @@ from dlrover_tpu.models.decode import (
     spec_accept_sampled,
     verify_step,
 )
+from dlrover_tpu.ops.quantization import (
+    QuantizedWeight,
+    quantize_int8,
+    stochastic_round_int8,
+    use_quant_matmul_kernel,
+    weight_quant_block,
+)
 from dlrover_tpu.parallel.mesh import (
     named,
     serving_adapter_specs,
     serving_kv_spec,
     serving_mesh,
     serving_mesh_spec,
+    serving_weight_quant_specs,
 )
 from dlrover_tpu.parallel.sharding import replicated, shard_tree
 from dlrover_tpu.serving.adapters import DeviceAdapterCache
@@ -145,14 +153,30 @@ _SERVING_PARAM_RULES = (
     (r"layers/wv$", ("tp",)),
 )
 
+# The large matmul weights weight_quant="int8" re-stores as per-block
+# int8 (ops/quantization.QuantizedWeight). Name-based on the stacked
+# layer dict, covering both families: llama (wq/wk/wv/wo + SwiGLU
+# gate/up/down) and GPT-2 (fused wqkv/wo + GELU up/down). Everything
+# else — norms, biases, embeddings, MoE expert stacks — stays dense:
+# gathers need the dense table, and small vectors have no bytes worth
+# saving. The untied llama lm_head quantizes separately below.
+_WQ_LAYER_WEIGHTS = frozenset(
+    ("wq", "wk", "wv", "wo", "wqkv", "w_gate", "w_up", "w_down")
+)
+
 
 def _serving_param_shardings():
     from jax.sharding import PartitionSpec
 
+    # quant specs FIRST is not required — the dense rules are
+    # $-anchored, so a QuantizedWeight's q8/s8 sub-paths
+    # (layers/wq/q8) can only match the quant rules; dense trees
+    # never produce those paths. Quantized wo/MLP/head leaves match
+    # nothing and replicate, exactly like their dense forms.
     return [
         (pat, PartitionSpec(None, None, *axes))
         for pat, axes in _SERVING_PARAM_RULES
-    ]
+    ] + list(serving_weight_quant_specs())
 
 
 def _parse_mesh_tp(mesh_spec) -> int:
@@ -1173,6 +1197,8 @@ class ContinuousBatcher:
         swap_to_host: bool = True,   # preempted runs demote, not drop
         kv_tier_promote: str = "always",  # | "swap_only" | "never"
         kv_checksums: int = 0,   # 1 = content-verify KV in transit
+        weight_quant: str = "none",  # | "int8" | "int8_stochastic":
+                                 # per-block int8 matmul weights
     ):
         if eos_id is not None and eos_id == pad_id:
             raise ValueError(
@@ -1216,6 +1242,11 @@ class ContinuousBatcher:
                 f"kv_checksums must be 0 (off) or 1 (verify KV in "
                 f"transit), got {kv_checksums}"
             )
+        if weight_quant not in ("none", "int8", "int8_stochastic"):
+            raise ValueError(
+                f"weight_quant must be 'none', 'int8' or "
+                f"'int8_stochastic', got {weight_quant!r}"
+            )
         _check_positional_capacity(cfg, max_len)
         # ---- serving mesh (GSPMD tensor slice) --------------------------
         # tp=1 (or the knob unset) keeps mesh=None: the compiled
@@ -1256,7 +1287,26 @@ class ContinuousBatcher:
         self._elastic_downtime_ms = 0.0
         self._elastic_replayed = 0
         self.cfg = cfg
-        self.params = self._shard_params(params)
+        # ---- int8 weight quantization (ops/quantization.py) -------------
+        # weight_quant="int8" re-stores the large matmul weights as
+        # per-block int8 + f32 scales AT INSTALL TIME (here and at
+        # every committed refresh); decode's matmuls dequant-fuse via
+        # matmul_any. "none" skips quantization entirely — the served
+        # tree, the compiled programs and every program-cache key are
+        # byte-identical to pre-quantization builds.
+        self.weight_quant = weight_quant
+        self._wq_seed = seed
+        self._wq_stats = {"leaves": 0, "skipped": 0}
+        # weight refreshes arrive as DENSE host trees; they validate
+        # against the pre-quantization skeleton, not the (possibly
+        # QuantizedWeight-bearing) served tree
+        self._refresh_skeleton = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                tuple(x.shape), jnp.dtype(x.dtype)
+            ),
+            params,
+        )
+        self.params = self._shard_params(self._quantize_params(params))
         self.n_slots = n_slots
         self.max_len = max_len
         self.max_new = max_new_tokens
@@ -1544,7 +1594,7 @@ class ContinuousBatcher:
             key = (
                 (cfg, self.pad_id, self.eos_id, temperature, top_k,
                  top_p, self.spec_draft_len, self.mesh, version)
-                + _kernel_cache_tag() + self._adapter_tag()
+                + _kernel_cache_tag() + self._adapter_tag() + self._wq_tag()
             )
             self._bound_keys.append((_SPEC_PROGRAMS, key))
             self._run_spec = _cached_program(
@@ -1559,7 +1609,7 @@ class ContinuousBatcher:
         key = (
             (cfg, self.pad_id, self.eos_id, temperature, top_k, top_p,
              self.mesh, version)
-            + _kernel_cache_tag() + self._adapter_tag()
+            + _kernel_cache_tag() + self._adapter_tag() + self._wq_tag()
         )
         self._bound_keys.append((_CHUNK_PROGRAMS, key))
         self._run_chunk = _cached_program(
@@ -1579,7 +1629,7 @@ class ContinuousBatcher:
             key = (
                 (cfg, self.pad_id, self.eos_id, temperature, top_k,
                  top_p, self.mesh, version, "prefill")
-                + _kernel_cache_tag() + self._adapter_tag()
+                + _kernel_cache_tag() + self._adapter_tag() + self._wq_tag()
             )
             self._bound_keys.append((_CHUNK_PROGRAMS, key))
             self._run_pf = _cached_program(
@@ -1593,7 +1643,7 @@ class ContinuousBatcher:
             )[self.kv_layout]
         key = (
             (cfg, self.max_len, self.mesh, version)
-            + _kernel_cache_tag() + self._adapter_tag()
+            + _kernel_cache_tag() + self._adapter_tag() + self._wq_tag()
         )
         self._bound_keys.append((_ADMIT_PROGRAMS, key))
         admit = _cached_program(
@@ -1614,6 +1664,17 @@ class ContinuousBatcher:
         self._page_copy_fn = admit["page_copy"]
         self._admit_lora_fn = admit.get("admit_lora")
         self._paged_cold_lora_fn = admit.get("paged_cold_lora")
+
+    def _wq_tag(self) -> tuple:
+        """Program-cache key component for weight quantization: the
+        mode string when on (a quantized tree traces different
+        programs — QuantizedWeight operands, fused dequant). Empty
+        when weight_quant="none", so default-path keys stay
+        byte-identical to pre-quantization builds — the program-cache
+        census in tests/test_serving_weight_quant.py locks this."""
+        if self.weight_quant == "none":
+            return ()
+        return ("wq", self.weight_quant)
 
     def _adapter_tag(self) -> tuple:
         """Program-cache key component for multi-adapter serving: the
@@ -1664,6 +1725,121 @@ class ContinuousBatcher:
                 probe_q, probe_pool, probe_table, tp=self.mesh_tp
             ):
                 self.kernel_path = "kernel"
+
+    # -- weight quantization -----------------------------------------------
+
+    def _quantize_params(self, params):
+        """Install-time int8 weight quantization — the ONE designated
+        quantize site in serving/ (graftlint QUANT-001). Each matmul
+        weight [.., K, O] re-stores OUTPUT-MAJOR as q8 int8 [.., O, K]
+        + s8 f32 [.., O, K/block] (blocks along the contraction dim;
+        see the layout note in ops/quantization.py). Idempotent:
+        already-quantized leaves pass through untouched, so an elastic
+        resize resharding the served tree never requantizes — the
+        exact bits move to the new mesh. weight_quant="none" is the
+        identity (same object, not a copy)."""
+        if self.weight_quant == "none":
+            return params
+        if not isinstance(params, dict) or "layers" not in params:
+            return params
+        stochastic = self.weight_quant == "int8_stochastic"
+        leaves = skipped = 0
+
+        lay = dict(params["layers"])
+        targets = [
+            ("layers", name, salt)
+            for salt, name in enumerate(sorted(lay))
+            if name in _WQ_LAYER_WEIGHTS
+        ]
+        head = params.get("lm_head")
+        if isinstance(head, dict) and "weight" in head:
+            # untied unembed [D, V]: the single biggest weight read of
+            # a decode step. Tied heads never reach here (no lm_head
+            # key) — the gather keeps the dense embedding table.
+            head = dict(head)
+            targets.append(("lm_head", "weight", len(lay)))
+        for group, name, salt in targets:
+            w = lay[name] if group == "layers" else head[name]
+            if isinstance(w, QuantizedWeight):
+                leaves += 1  # resize/reshard path: keep the bits
+                continue
+            shape = tuple(w.shape)
+            blk = weight_quant_block(shape[-2]) if len(shape) > 1 else 0
+            if blk == 0:
+                skipped += 1
+                continue
+            *lead, k_dim, o_dim = shape
+            wt = jnp.swapaxes(jnp.asarray(w, jnp.float32), -1, -2)
+            flat = wt.reshape((-1, k_dim))
+            if stochastic:
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(self._wq_seed), salt
+                )
+                q, s = stochastic_round_int8(flat, key, blk)
+            else:
+                q, s = quantize_int8(flat, blk)
+            q = q.reshape(tuple(lead) + (o_dim, k_dim))
+            s = s.reshape(tuple(lead) + (o_dim, k_dim // blk))
+            leaves += 1
+            qw = QuantizedWeight(q, s, blk)
+            if group == "layers":
+                lay[name] = qw
+            else:
+                head[name] = qw
+        out = dict(params)
+        out["layers"] = lay
+        if isinstance(head, dict) and "weight" in head:
+            out["lm_head"] = head
+        self._wq_stats = {"leaves": leaves, "skipped": skipped}
+        return out
+
+    def weight_bytes_device(self) -> int:
+        """Served-weight bytes resident PER CHIP: each leaf's local
+        shard shape (the full shape when replicated or meshless) times
+        its itemsize. THE headline this PR moves — decode streams
+        these bytes from HBM every step."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            shape = tuple(getattr(leaf, "shape", ()))
+            sh = getattr(leaf, "sharding", None)
+            if self.mesh is not None and sh is not None:
+                try:
+                    shape = tuple(sh.shard_shape(shape))
+                except Exception:  # graftlint: allow(EXC-001) reason=telemetry fallback: a leaf whose sharding cannot express a shard shape (e.g. host-resident during a refresh window) counts its full bytes rather than failing the stats pump
+                    pass
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * jnp.dtype(leaf.dtype).itemsize
+        return total
+
+    @property
+    def weight_quant_path(self) -> str:
+        """Which matmul body the quantized programs trace: "int8:kernel"
+        (fused Pallas dequant-matmul) or "int8:reference" (XLA
+        dequant-then-dot — also the tp>1 path, where GSPMD partitions
+        the reference natively). "none" when quantization is off.
+        Mirrors kernel_path for /healthz and the bench contract."""
+        if self.weight_quant == "none":
+            return "none"
+        kind = (
+            "kernel"
+            if use_quant_matmul_kernel(self.mesh_tp)
+            else "reference"
+        )
+        return f"{self.weight_quant}:{kind}"
+
+    def weight_quant_stats(self) -> Dict[str, float]:
+        """Weight-quantization exposition (scheduler pump → metrics →
+        gateway): mode flag, per-chip weight bytes, leaf counts."""
+        return {
+            "weight_quant_int8": (
+                0.0 if self.weight_quant == "none" else 1.0
+            ),
+            "weight_bytes_device": float(self.weight_bytes_device()),
+            "weight_quant_leaves": float(self._wq_stats["leaves"]),
+            "weight_quant_skipped": float(self._wq_stats["skipped"]),
+        }
 
     # -- mesh placement ----------------------------------------------------
 
@@ -1859,8 +2035,13 @@ class ContinuousBatcher:
     def _check_refresh_tree(self, params) -> None:
         """A poisoned refresh must fail BEFORE any engine state
         changes: same tree structure, same leaf shapes and dtypes as
-        the currently served params."""
-        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        the tree the engine was CONSTRUCTED with. Refresh trees arrive
+        dense — they validate against the pre-quantization skeleton
+        (with weight_quant="none" that skeleton IS the served tree's
+        shape signature), then quantize behind the fence at commit."""
+        old_leaves, old_def = jax.tree_util.tree_flatten(
+            self._refresh_skeleton
+        )
         new_leaves, new_def = jax.tree_util.tree_flatten(params)
         if old_def != new_def:
             raise ValueError(
@@ -1891,7 +2072,12 @@ class ContinuousBatcher:
         try:
             self._check_refresh_tree(params)
             self.drain_inflight()
-            self.params = self._shard_params(params)
+            # quantize behind the fence: the incoming dense tree
+            # re-quantizes here, and a rollback below restores the OLD
+            # quantized banks — no mixed-precision tree ever serves
+            self.params = self._shard_params(
+                self._quantize_params(params)
+            )
             self._weight_version = old_version + 1
             self._bind_programs()
         except Exception:

@@ -340,6 +340,18 @@ class ServingGateway:
         kp = getattr(engine, "kernel_path", None)
         if kp is not None:
             out["kernel_path"] = kp
+        # int8 weight quantization: which matmul body the quantized
+        # programs traced ("int8:kernel" | "int8:reference" | "none")
+        # plus the byte/leaf stats — duck-typed like kernel_path so
+        # test doubles and pool backends skip the block
+        wqp = getattr(engine, "weight_quant_path", None)
+        if wqp is not None:
+            out["weight_quant_path"] = wqp
+            wqstats = getattr(engine, "weight_quant_stats", None)
+            if callable(wqstats):
+                wq = wqstats()
+                if wq:
+                    out["weight_quant"] = wq
         role = getattr(engine, "replica_role", None)
         if role is not None:
             out["replica_role"] = role

@@ -303,6 +303,13 @@ class ServingMetrics:
         self._stragglers_flagged_total = 0
         self._straggler_ejections_total = 0
         self._preflight_failed = 0
+        # int8 weight quantization (engine weight_quant knob):
+        # per-chip served-weight bytes (gauge — decode streams these
+        # from HBM every step), the on/off flag, and the traced
+        # matmul-path string. Defaults match the knob off.
+        self._weight_quant_on = 0
+        self._weight_bytes_device = 0
+        self._weight_quant_path = "none"
 
     # ---- ingestion -------------------------------------------------------
 
@@ -525,6 +532,23 @@ class ServingMetrics:
                 self._kv_quarantines,
                 int(stats.get("integrity_quarantines", 0)),
             )
+
+    def update_weight_quant(
+        self, stats: Dict[str, float], path: str = "none"
+    ):
+        """Refresh weight-quantization telemetry from the engine's
+        weight_quant_stats(). Both values are gauges set directly: a
+        weight refresh or elastic reshard legitimately changes the
+        resident byte count, and a restarted engine may flip the
+        mode."""
+        with self._lock:
+            self._weight_quant_on = int(
+                stats.get("weight_quant_int8", 0)
+            )
+            self._weight_bytes_device = int(
+                stats.get("weight_bytes_device", 0)
+            )
+            self._weight_quant_path = str(path)
 
     def update_straggler(self, stats: Dict[str, float]):
         """Refresh straggler-sentinel telemetry from the pool's
@@ -1380,6 +1404,27 @@ class ServingMetrics:
                 "Replicas currently failing their preflight device "
                 "self-check.",
                 self._preflight_failed,
+            )
+            gauge(
+                "serving_weight_bytes",
+                "Served-weight bytes resident per chip (the HBM "
+                "stream a decode step pays).",
+                self._weight_bytes_device,
+            )
+            gauge(
+                "serving_weight_quant_int8",
+                "1 when the served matmul weights are per-block "
+                "int8-quantized, 0 for full precision.",
+                self._weight_quant_on,
+            )
+            lines.append(
+                "# HELP serving_weight_quant_info Weight-quantization "
+                "matmul path of this replica (info-style gauge)."
+            )
+            lines.append("# TYPE serving_weight_quant_info gauge")
+            lines.append(
+                f'serving_weight_quant_info'
+                f'{{path="{self._weight_quant_path}"}} 1'
             )
             gauge(
                 "serving_mesh_tp",

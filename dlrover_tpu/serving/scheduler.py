@@ -951,6 +951,16 @@ class RequestScheduler:
                 h = hstats()
                 if h:
                     self.metrics.update_kv_integrity(h)
+            wqstats = getattr(self.engine, "weight_quant_stats", None)
+            if wqstats is not None:
+                wq = wqstats()
+                if wq:
+                    self.metrics.update_weight_quant(
+                        wq,
+                        getattr(
+                            self.engine, "weight_quant_path", "none"
+                        ),
+                    )
             busy = bool(self._running) or any(
                 self._waiting[t] for t in TIERS
             )

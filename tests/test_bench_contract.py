@@ -50,9 +50,13 @@ def test_bench_smoke_emits_driver_contract():
         "restore_stall_measured_s",
         "goodput_pct",
         "suspect_timing",
+        "weight_bytes_device",
+        "tok_per_sec_per_weight_gb",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["ckpt_roundtrip_ok"] is True
+    assert detail["weight_bytes_device"] > 0
+    assert detail["tok_per_sec_per_weight_gb"] > 0
 
 
 @pytest.mark.slow
@@ -330,6 +334,22 @@ def test_serve_bench_smoke_emits_driver_contract():
         "health_straggler_patience",
         "health_preflight_ok",
         "n_health_requests",
+        # weight-quant phase: the int8 weight-only decode axes
+        "weight_bytes_device",
+        "tok_per_sec_per_weight_gb",
+        "wq_success_rate",
+        "wq_greedy_agreement",
+        "wq_weight_bytes_f32",
+        "wq_weight_bytes_int8",
+        "wq_weight_bytes_ratio",
+        "wq_kernel_parity_ok",
+        "wq_path",
+        "wq_f32_tpot_ms_p50",
+        "wq_tpot_ms_p50",
+        "wq_tpot_ratio",
+        "wq_train_steps",
+        "wq_train_loss",
+        "n_wq_requests",
     ):
         assert key in detail, f"missing detail axis: {key}"
     assert detail["shed_total"] == 0
@@ -624,3 +644,23 @@ def test_serve_bench_smoke_emits_driver_contract():
         <= detail["health_straggler_patience"] + 2
     )
     assert detail["n_health_requests"] > 0
+    # the weight-quant acceptance floor: every request completes on
+    # BOTH arms, the briefly-trained model's greedy streams agree at
+    # >= 0.99 token-level (random-init near-ties are the only thing
+    # the training run removes — real quantization error would fail
+    # this on any weights), resident weight bytes drop to nearly a
+    # quarter (int8 payload + f32 block scales + the never-quantized
+    # embedding table keep it above exactly 0.25), and the interpret
+    # kernel reproduces the XLA reference byte-for-byte. The TPOT
+    # ratio is RECORDED evidence only: on CPU the dequant work
+    # dominates the saved bytes, so no <1 lock here — the bytes
+    # ratio IS the HBM claim the paper-scale chip converts to TPOT.
+    assert detail["wq_success_rate"] == 1.0
+    assert detail["wq_greedy_agreement"] >= 0.99
+    assert detail["wq_weight_bytes_ratio"] <= 0.55
+    assert detail["wq_kernel_parity_ok"] is True
+    assert detail["wq_path"].startswith("int8:")
+    assert detail["wq_tpot_ratio"] > 0
+    assert detail["weight_bytes_device"] > 0
+    assert detail["tok_per_sec_per_weight_gb"] > 0
+    assert detail["n_wq_requests"] > 0

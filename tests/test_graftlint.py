@@ -52,10 +52,12 @@ from dlrover_tpu.analysis.rules import (
     RawMeshRule,
     RlImportRule,
     TierPreemptionRule,
+    WeightQuantSiteRule,
     frontier_write_sites,
     get_rules,
     hbm_transfer_sites,
     integrity_checksum_sites,
+    weight_quant_sites,
 )
 
 pytestmark = pytest.mark.lint
@@ -1328,6 +1330,114 @@ def test_integ_rule_not_vacuous_on_real_tree():
         "export_run", "adopt_into_slot", "on_prefill_done"
     } <= owners["handoff.py"]
     assert "_block_digest" in owners["affinity.py"]
+
+
+# ---------------------------------------------------------------------------
+# QUANT-001: weight-quantization call-site discipline
+
+
+def test_quant_rule_flags_stray_quantize_in_serving(tmp_path):
+    # every spelling of every primitive counts: bare imported names,
+    # module attributes, and the stochastic variant
+    code = """
+    from dlrover_tpu.ops import quantization
+    from dlrover_tpu.ops.quantization import (
+        dequantize_int8,
+        quantize_int8,
+        stochastic_round_int8,
+    )
+
+    def per_step_requant(w):
+        return quantize_int8(w, 64)
+
+    def rematerialize(q, s):
+        return dequantize_int8(q, s, q.shape, 0)
+
+    def noisy(w, key):
+        return quantization.stochastic_round_int8(w, key, 64)
+    """
+    src = probe(tmp_path, code)
+    found = hits(WeightQuantSiteRule(), src)
+    assert len(found) == 3
+    assert all(f.severity == "CRITICAL" for f in found)
+    assert any("per_step_requant" in f.message for f in found)
+
+
+def test_quant_rule_vacuity_of_allowlist(tmp_path):
+    # _quantize_params in engine.py is the ONE designated site; the
+    # SAME calls in any other engine function are findings — the
+    # file is not exempt wholesale
+    code = """
+    from dlrover_tpu.ops.quantization import (
+        quantize_int8,
+        stochastic_round_int8,
+    )
+
+    def _quantize_params(self, params):
+        return quantize_int8(params, 64)
+
+    def _decode_step_fn(self, w, key):
+        return stochastic_round_int8(w, key, 64)
+    """
+    src = probe(tmp_path, code, rel=ENGINE_REL)
+    found = hits(WeightQuantSiteRule(), src)
+    assert len(found) == 1
+    assert "_decode_step_fn" in found[0].message
+
+
+def test_quant_rule_decode_file_allows_nothing(tmp_path):
+    # models/decode.py is in scope but allows nothing: the forward
+    # paths consume QuantizedWeight via matmul_any's fused dequant
+    src = probe(
+        tmp_path,
+        """
+        from dlrover_tpu.ops.quantization import dequantize_int8
+
+        def _forward_cached(q, s):
+            return dequantize_int8(q, s, q.shape, 0)
+        """,
+        rel="dlrover_tpu/models/decode.py",
+        name="decode_probe.py",
+    )
+    found = hits(WeightQuantSiteRule(), src)
+    assert len(found) == 1
+    assert "_forward_cached" in found[0].message
+
+
+def test_quant_rule_ignores_outside_scope(tmp_path):
+    # ops/quantization.py (the primitives' home) and the KV-cache
+    # quant path in training-side code are not this rule's business
+    src = probe(
+        tmp_path,
+        """
+        def quantize_any(x, block=128):
+            return quantize_int8(x, block)
+        """,
+        rel="dlrover_tpu/ops/quantization.py",
+        name="ops_probe.py",
+    )
+    assert not hits(WeightQuantSiteRule(), src)
+
+
+def test_quant_rule_not_vacuous_on_real_tree():
+    # the walker must see the real install sites in engine.py (the
+    # rule has something to protect), _quantize_params must own every
+    # one of them, and the real files must stay clean
+    root = pathlib.Path(analysis.__file__).resolve().parents[2]
+    eng = SourceFile.parse(
+        root / "dlrover_tpu" / "serving" / "engine.py",
+        rel="dlrover_tpu/serving/engine.py",
+    )
+    sites = weight_quant_sites(eng.tree)
+    assert sites, "no quantization sites seen in engine.py"
+    assert {o for _, _, o in sites} == {"_quantize_params"}
+    assert not hits(WeightQuantSiteRule(), eng)
+    dec = SourceFile.parse(
+        root / "dlrover_tpu" / "models" / "decode.py",
+        rel="dlrover_tpu/models/decode.py",
+    )
+    assert not weight_quant_sites(dec.tree)
+    assert not hits(WeightQuantSiteRule(), dec)
 
 
 # ---------------------------------------------------------------------------

@@ -17,6 +17,10 @@ at f32 resolution, not byte equality.
 
 import numpy as np
 import pytest
+
+# hypothesis is an optional dev dependency: without it this module
+# must SKIP at collection, not error the whole tier-1 run
+pytest.importorskip("hypothesis")
 from hypothesis import settings
 from hypothesis import strategies as st
 from hypothesis.stateful import (

@@ -13,6 +13,11 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+# hypothesis is an optional dev dependency: without it this module
+# must SKIP at collection, not error the whole tier-1 run
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
